@@ -1,0 +1,101 @@
+"""Benchmark: Higgs-style binary classification training throughput.
+
+Mirrors the reference's headline config (docs/Experiments.rst:82-91 — 255 leaves,
+lr=0.1, max_bin=255, binary objective on Higgs 10.5M x 28).  Data is synthetic
+Higgs-scale-per-feature (28 features); rows are scaled to fit the bench budget
+and throughput is normalized to row-iterations/second so it is comparable to the
+reference's published wall-clock:
+
+    reference CPU (16 threads): 10.5M rows x 500 iters / 130.094 s = 40.4M row-iters/s
+    (BASELINE.md; docs/Experiments.rst:113)
+
+Prints ONE JSON line with vs_baseline = ours / reference.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+ROWS = int(os.environ.get("BENCH_ROWS", 1_000_000))
+FEATURES = 28
+ITERS = int(os.environ.get("BENCH_ITERS", 20))
+NUM_LEAVES = 255
+REFERENCE_ROW_ITERS_PER_SEC = 10_500_000 * 500 / 130.094
+
+
+def make_higgs_like(n, f, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f).astype(np.float32)
+    w = rng.randn(f) / np.sqrt(f)
+    logits = X @ w + 0.5 * np.sin(X[:, 0] * 2) * X[:, 1]
+    p = 1 / (1 + np.exp(-logits))
+    y = (rng.rand(n) < p).astype(np.float64)
+    return X, y
+
+
+def main():
+    import lightgbm_tpu as lgb
+
+    X, y = make_higgs_like(ROWS, FEATURES)
+    params = {
+        "objective": "binary",
+        "num_leaves": NUM_LEAVES,
+        "learning_rate": 0.1,
+        "max_bin": 255,
+        "min_data_in_leaf": 0,
+        "min_sum_hessian_in_leaf": 100.0,
+        "metric": "none",
+        "verbosity": -1,
+    }
+    ds = lgb.Dataset(X, label=y)
+    t_bin0 = time.time()
+    ds.construct(params)
+    bin_time = time.time() - t_bin0
+
+    # Warmup: compile the training step (excluded from timing, like the
+    # reference excludes data loading).
+    bst = lgb.Booster(params=params, train_set=ds)
+    bst.update()
+
+    t0 = time.time()
+    for _ in range(ITERS):
+        bst.update()
+    import jax
+    jax.block_until_ready(bst._gbdt.scores)
+    elapsed = time.time() - t0
+
+    iters_per_sec = ITERS / elapsed
+    row_iters_per_sec = ROWS * iters_per_sec
+    auc = None
+    try:
+        from lightgbm_tpu.metrics import _auc
+        sample = np.random.RandomState(1).choice(ROWS, size=min(ROWS, 200_000),
+                                                 replace=False)
+        pred = bst.predict(X[sample], raw_score=True)
+        auc = _auc(y[sample], pred, None, None)
+    except Exception:
+        pass
+
+    print(json.dumps({
+        "metric": "binary_255leaves_row_iters_per_sec",
+        "value": round(row_iters_per_sec, 1),
+        "unit": "rows*iters/s",
+        "vs_baseline": round(row_iters_per_sec / REFERENCE_ROW_ITERS_PER_SEC, 4),
+        "detail": {
+            "rows": ROWS, "features": FEATURES, "iters": ITERS,
+            "num_leaves": NUM_LEAVES,
+            "train_time_s": round(elapsed, 3),
+            "iters_per_sec": round(iters_per_sec, 3),
+            "bin_time_s": round(bin_time, 3),
+            "train_auc_sample": None if auc is None else round(auc, 6),
+            "reference": "LightGBM CPU 16t Higgs 10.5Mx28 500it in 130.094s "
+                         "(docs/Experiments.rst:113)",
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

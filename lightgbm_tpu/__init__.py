@@ -1,0 +1,40 @@
+"""lightgbm_tpu — a TPU-native gradient boosting framework.
+
+A from-scratch re-design of LightGBM's capabilities (reference: h2oai/LightGBM)
+for TPU hardware: histogram GBDT/DART/RF with a fully device-resident training
+loop expressed as XLA programs (one-hot MXU histogram contractions, vectorized
+split scans, static-shape leaf-wise growth), data/feature-parallel scaling via
+``jax.sharding`` meshes, and a lightgbm-compatible Python API.
+"""
+
+import os as _os
+
+if _os.environ.get("LIGHTGBM_TPU_PLATFORM"):
+    # Honor an explicit platform override (e.g. cpu for hermetic CI) even when
+    # a PJRT plugin boot hook has force-set jax_platforms.
+    import jax as _jax
+
+    _jax.config.update("jax_platforms", _os.environ["LIGHTGBM_TPU_PLATFORM"])
+
+from .basic import Booster, Dataset
+from .callback import EarlyStopException, early_stopping, log_evaluation, \
+    record_evaluation, reset_parameter
+from .config import Config
+from .engine import cv, train
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Booster", "Dataset", "Config", "train", "cv",
+    "early_stopping", "log_evaluation", "record_evaluation",
+    "reset_parameter", "EarlyStopException",
+    "LGBMModel", "LGBMClassifier", "LGBMRegressor", "LGBMRanker",
+]
+
+
+def __getattr__(name):
+    # sklearn wrappers are imported lazily to keep base import light.
+    if name in ("LGBMModel", "LGBMClassifier", "LGBMRegressor", "LGBMRanker"):
+        from . import sklearn as _sk
+        return getattr(_sk, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

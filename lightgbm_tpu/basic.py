@@ -1,0 +1,256 @@
+"""User-facing ``Dataset`` and ``Booster`` (lightgbm-compatible surface).
+
+Reference: ``python-package/lightgbm/basic.py`` (``Dataset:1764``, ``Booster:3586``).
+There is no ctypes boundary here — the "C API" equivalent is the in-process
+:class:`~lightgbm_tpu.models.gbdt.GBDT` driver whose compute runs as XLA programs;
+a C-ABI shim for external bindings lives in ``capi/``.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from .config import Config
+from .dataset import TrainData
+from .models.gbdt import GBDT
+from .models.dart import DART
+from .models.rf import RandomForest
+
+
+def _as_2d(data) -> np.ndarray:
+    arr = np.asarray(data, dtype=np.float64)
+    if arr.ndim == 1:
+        arr = arr.reshape(-1, 1)
+    return arr
+
+
+class Dataset:
+    """Lazily-constructed training dataset (reference ``basic.py:1764``)."""
+
+    def __init__(
+        self,
+        data,
+        label=None,
+        reference: Optional["Dataset"] = None,
+        weight=None,
+        group=None,
+        init_score=None,
+        feature_name: Union[str, List[str]] = "auto",
+        categorical_feature: Union[str, List[int], List[str]] = "auto",
+        params: Optional[Dict[str, Any]] = None,
+        free_raw_data: bool = False,
+    ):
+        self.data = _as_2d(data)
+        self.label = None if label is None else np.asarray(label)
+        self.reference = reference
+        self.weight = None if weight is None else np.asarray(weight, np.float64)
+        self.group = None if group is None else np.asarray(group, np.int64)
+        self.init_score = None if init_score is None else np.asarray(init_score)
+        self.params = dict(params or {})
+        self.feature_name = feature_name
+        self.categorical_feature = categorical_feature
+        self.free_raw_data = free_raw_data
+        self._train_data: Optional[TrainData] = None
+
+    def construct(self, params: Optional[Dict[str, Any]] = None) -> "TrainData":
+        if self._train_data is None:
+            merged = dict(self.params)
+            merged.update(params or {})
+            cfg = Config(merged)
+            cats: Sequence[int] = ()
+            if isinstance(self.categorical_feature, (list, tuple)):
+                names = self._feature_names()
+                cats = [c if isinstance(c, int) else names.index(c)
+                        for c in self.categorical_feature]
+            elif cfg.categorical_feature:
+                cats = [int(c) for c in cfg.categorical_feature.split(",")]
+            ref_td = (self.reference.construct(params)
+                      if self.reference is not None else None)
+            self._train_data = TrainData.build(
+                self.data, self.label if self.label is not None
+                else np.zeros(len(self.data)), cfg,
+                weight=self.weight, group=self.group,
+                init_score=self.init_score,
+                categorical_features=cats,
+                feature_names=self._feature_names(),
+                reference=ref_td,
+            )
+        return self._train_data
+
+    def _feature_names(self) -> List[str]:
+        if isinstance(self.feature_name, list):
+            return list(self.feature_name)
+        return [f"Column_{i}" for i in range(self.data.shape[1])]
+
+    def num_data(self) -> int:
+        return self.data.shape[0]
+
+    def num_feature(self) -> int:
+        return self.data.shape[1]
+
+    def get_label(self):
+        return self.label
+
+    def get_weight(self):
+        return self.weight
+
+    def get_group(self):
+        return self.group
+
+    def set_label(self, label):
+        self.label = np.asarray(label)
+        self._train_data = None
+        return self
+
+    def set_weight(self, weight):
+        self.weight = None if weight is None else np.asarray(weight, np.float64)
+        self._train_data = None
+        return self
+
+    def set_group(self, group):
+        self.group = None if group is None else np.asarray(group, np.int64)
+        self._train_data = None
+        return self
+
+    def create_valid(self, data, label=None, weight=None, group=None,
+                     init_score=None, params=None) -> "Dataset":
+        return Dataset(data, label=label, reference=self, weight=weight,
+                       group=group, init_score=init_score,
+                       params=params or self.params)
+
+
+class Booster:
+    """Gradient-boosting model handle (reference ``basic.py:3586``)."""
+
+    def __init__(
+        self,
+        params: Optional[Dict[str, Any]] = None,
+        train_set: Optional[Dataset] = None,
+        model_file: Optional[str] = None,
+        model_str: Optional[str] = None,
+        valid_sets: Sequence[Tuple[str, Dataset]] = (),
+    ):
+        self.params = dict(params or {})
+        self.best_iteration = -1
+        self.best_score: Dict = {}
+        if model_file is not None or model_str is not None:
+            from .serialization import load_model_string
+            if model_file is not None:
+                with open(model_file) as fh:
+                    model_str = fh.read()
+            self._gbdt = load_model_string(model_str)
+            self.cfg = self._gbdt.cfg
+            return
+        if train_set is None:
+            raise ValueError("either train_set or a model must be provided")
+        self.cfg = Config(self.params)
+        td = train_set.construct(self.params)
+        valid_td = [(nm, ds.construct(self.params)) for nm, ds in valid_sets]
+        if self.cfg.boosting == "dart":
+            cls = DART
+        elif self.cfg.boosting == "rf":
+            cls = RandomForest
+        else:
+            cls = GBDT
+        self._gbdt = cls(self.cfg, td, valid_td)
+        self.train_set = train_set
+
+    # ------------------------------------------------------------------- train
+    def update(self, train_set=None, fobj=None) -> bool:
+        """One boosting iteration; returns True if training should stop
+        (reference ``Booster.update`` -> ``LGBM_BoosterUpdateOneIter``)."""
+        if fobj is not None:
+            score = self._gbdt.scores
+            import jax
+            grad, hess = fobj(np.asarray(jax.device_get(score)),
+                              self.train_set)
+            return self._gbdt.train_one_iter(np.asarray(grad), np.asarray(hess))
+        return self._gbdt.train_one_iter()
+
+    def rollback_one_iter(self) -> "Booster":
+        self._gbdt.rollback_one_iter()
+        return self
+
+    def reset_parameter(self, params: Dict[str, Any]) -> "Booster":
+        self.params.update(params)
+        self._gbdt.cfg.update(params)
+        return self
+
+    def _evals(self, feval=None) -> List[Tuple[str, str, float, bool]]:
+        res = self._gbdt.eval_set()
+        if feval is not None:
+            import jax
+            for i, (name, data) in enumerate([("training", self._gbdt.train_data)]
+                                             + list(self._gbdt.valids)):
+                scores = (self._gbdt.scores if name == "training"
+                          else self._gbdt.valid_scores[i - 1])
+                sc = np.asarray(jax.device_get(scores))
+                out = feval(sc, data)
+                if out is not None:
+                    if not isinstance(out, list):
+                        out = [out]
+                    for metric, value, hb in out:
+                        res.append((name, metric, value, hb))
+        return res
+
+    # ----------------------------------------------------------------- predict
+    def predict(self, data, start_iteration: int = 0,
+                num_iteration: Optional[int] = None, raw_score: bool = False,
+                pred_leaf: bool = False, pred_contrib: bool = False,
+                **kwargs) -> np.ndarray:
+        if num_iteration is None and self.best_iteration > 0:
+            num_iteration = self.best_iteration
+        if pred_leaf or pred_contrib:
+            from .explain import predict_leaf_index, predict_contrib
+            fn = predict_leaf_index if pred_leaf else predict_contrib
+            return fn(self._gbdt, _as_2d(data), start_iteration, num_iteration)
+        return self._gbdt.predict(_as_2d(data), raw_score=raw_score,
+                                  num_iteration=num_iteration,
+                                  start_iteration=start_iteration)
+
+    # -------------------------------------------------------------------- misc
+    @property
+    def current_iteration(self) -> int:
+        return self._gbdt.iter_
+
+    def num_trees(self) -> int:
+        return self._gbdt.num_trees
+
+    def num_model_per_iteration(self) -> int:
+        return self._gbdt.num_class
+
+    def num_feature(self) -> int:
+        return self._gbdt.train_data.num_features
+
+    def feature_name(self) -> List[str]:
+        names = self._gbdt.train_data.feature_names
+        return names or [f"Column_{i}"
+                         for i in range(self._gbdt.train_data.num_features)]
+
+    def feature_importance(self, importance_type: str = "split",
+                           iteration=None) -> np.ndarray:
+        return self._gbdt.feature_importance(importance_type)
+
+    def model_to_string(self, num_iteration: Optional[int] = None,
+                        start_iteration: int = 0) -> str:
+        from .serialization import model_to_string
+        return model_to_string(self._gbdt, num_iteration=num_iteration,
+                               start_iteration=start_iteration)
+
+    def save_model(self, filename: str, num_iteration: Optional[int] = None,
+                   start_iteration: int = 0) -> "Booster":
+        with open(filename, "w") as fh:
+            fh.write(self.model_to_string(num_iteration, start_iteration))
+        return self
+
+    def eval(self, data: Dataset, name: str, feval=None):
+        raise NotImplementedError("use valid_sets at construction (round 1)")
+
+    def eval_train(self, feval=None):
+        return [e for e in self._evals(feval) if e[0] == "training"]
+
+    def eval_valid(self, feval=None):
+        return [e for e in self._evals(feval) if e[0] != "training"]

@@ -1,0 +1,288 @@
+"""Feature discretization: value -> bin mapping.
+
+TPU-native re-design of the reference ``BinMapper`` (``include/LightGBM/bin.h:85``,
+``src/io/bin.cpp:1072`` — greedy equal-count bin finding with ``min_data_in_bin``,
+categorical vocabularies, ``MissingType`` None/Zero/NaN).  Differences from the
+reference, chosen for the TPU storage model:
+
+- Bins are stored **dense** per feature as ``uint8``/``uint16`` device arrays; there is
+  no most-frequent-bin elision (``GetMostFreqBin``/``FixHistogram``) because dense HBM
+  histograms do not need it.
+- The NaN bin, when present, is always the **last** bin of a feature, so the split
+  scan can peel it off with a static slice instead of per-feature bin bookkeeping.
+- Categorical bins are ordered by descending category frequency (rare categories
+  beyond ``max_bin`` collapse into the last bin).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+MISSING_NONE = 0
+MISSING_ZERO = 1
+MISSING_NAN = 2
+
+_KZERO_LO, _KZERO_HI = -1e-35, 1e-35  # reference uses kZeroThreshold = 1e-35
+
+
+@dataclasses.dataclass
+class BinMapper:
+    """Per-feature value->bin discretizer (reference ``bin.h:85``)."""
+
+    num_bins: int
+    missing_type: int
+    is_categorical: bool
+    # Numerical: inclusive upper bound of each *value* bin (excludes the NaN bin).
+    upper_bounds: Optional[np.ndarray] = None
+    # Categorical: category integer value per bin index.
+    categories: Optional[np.ndarray] = None
+    is_trivial: bool = False  # single-bin feature; carries no signal
+    default_bin: int = 0      # bin of value 0.0 (used by sparse paths later)
+
+    @property
+    def has_nan_bin(self) -> bool:
+        return self.missing_type != MISSING_NONE
+
+    @property
+    def nan_bin(self) -> int:
+        return self.num_bins - 1 if self.has_nan_bin else -1
+
+    def value_to_bin(self, values: np.ndarray) -> np.ndarray:
+        """Vectorized ValueToBin (reference ``bin.h:173``)."""
+        v = np.asarray(values, dtype=np.float64)
+        if self.is_categorical:
+            cats = self.categories
+            # Map category value -> bin by table lookup; unseen/negative -> last bin.
+            out = np.full(v.shape, self.num_bins - 1, dtype=np.int32)
+            vi = np.where(np.isfinite(v), v, -1).astype(np.int64)
+            lut_size = int(cats.max()) + 1 if cats.size else 1
+            lut = np.full(lut_size, self.num_bins - 1, dtype=np.int32)
+            lut[cats] = np.arange(len(cats), dtype=np.int32)
+            in_range = (vi >= 0) & (vi < lut_size)
+            out[in_range] = lut[vi[in_range]]
+            return out
+        if self.missing_type == MISSING_ZERO:
+            v = np.where((v > _KZERO_LO) & (v < _KZERO_HI), np.nan, v)
+        n_value_bins = self.num_bins - (1 if self.has_nan_bin else 0)
+        # bin b holds values <= upper_bounds[b]; clip overflow into last value bin.
+        bins = np.searchsorted(self.upper_bounds[: n_value_bins - 1], v, side="left")
+        bins = bins.astype(np.int32)
+        if self.has_nan_bin:
+            bins = np.where(np.isnan(v), self.nan_bin, bins)
+        else:
+            bins = np.where(np.isnan(v), 0, bins)
+        return bins
+
+    def bin_to_threshold(self, bin_idx: int) -> float:
+        """Real-valued split threshold for ``bin <= bin_idx`` (go-left) decisions."""
+        if self.is_categorical:
+            return float(bin_idx)
+        n_value_bins = self.num_bins - (1 if self.has_nan_bin else 0)
+        b = min(int(bin_idx), n_value_bins - 1)
+        return float(self.upper_bounds[b])
+
+
+def _greedy_find_boundaries(
+    distinct: np.ndarray,
+    counts: np.ndarray,
+    max_bins: int,
+    total_cnt: int,
+    min_data_in_bin: int,
+) -> List[float]:
+    """Greedy equal-count boundary search (reference ``bin.cpp`` GreedyFindBin).
+
+    Walks distinct values accumulating counts; closes a bin once it holds at least
+    ``max(mean_size, min_data_in_bin)`` samples, re-estimating the mean from the
+    remainder.  Heavy hitters (count >= mean) always get their own bin.
+    """
+    n = len(distinct)
+    if n == 0:
+        return [np.inf]
+    if n <= max_bins:
+        # Every distinct value gets a bin; boundary = midpoint to next value.
+        bounds = [(distinct[i] + distinct[i + 1]) / 2.0 for i in range(n - 1)]
+        bounds.append(np.inf)
+        return bounds
+    bounds: List[float] = []
+    rest_cnt = total_cnt
+    rest_bins = max_bins
+    cur = 0
+    i = 0
+    while i < n:
+        mean_size = rest_cnt / max(rest_bins, 1)
+        target = max(mean_size, float(min_data_in_bin))
+        cur += counts[i]
+        rest_cnt -= counts[i]
+        # Close the bin if full, or if the remaining values just fit remaining bins.
+        if cur >= target or (n - i - 1) <= (rest_bins - 1 - len(bounds) - 1):
+            if i + 1 < n:
+                bounds.append((distinct[i] + distinct[i + 1]) / 2.0)
+            cur = 0
+            rest_bins -= 1
+            if len(bounds) >= max_bins - 1:
+                break
+        i += 1
+    bounds.append(np.inf)
+    return bounds
+
+
+def find_bin(
+    sample_values: np.ndarray,
+    max_bin: int,
+    min_data_in_bin: int = 3,
+    *,
+    is_categorical: bool = False,
+    use_missing: bool = True,
+    zero_as_missing: bool = False,
+    min_data_per_category: int = 1,
+) -> BinMapper:
+    """Construct a :class:`BinMapper` from sampled values (reference ``FindBin``,
+    ``bin.cpp:~150``)."""
+    v = np.asarray(sample_values, dtype=np.float64).ravel()
+    na_mask = np.isnan(v)
+    if zero_as_missing:
+        zmask = (v > _KZERO_LO) & (v < _KZERO_HI)
+        na_mask = na_mask | zmask
+    num_na = int(na_mask.sum())
+    vv = v[~na_mask]
+
+    if is_categorical:
+        cats_f = vv[vv >= 0]
+        cats, counts = np.unique(cats_f.astype(np.int64), return_counts=True)
+        order = np.argsort(-counts, kind="stable")
+        cats, counts = cats[order], counts[order]
+        keep = counts >= min_data_per_category
+        if keep.any():
+            cats, counts = cats[keep], counts[keep]
+        cats = cats[: max_bin - 1] if len(cats) >= max_bin else cats
+        num_bins = len(cats) + 1  # final bin: rare/unseen/missing
+        if num_bins < 2:
+            return BinMapper(num_bins=1, missing_type=MISSING_NONE,
+                             is_categorical=True, categories=cats.astype(np.int64),
+                             is_trivial=True)
+        return BinMapper(
+            num_bins=num_bins,
+            missing_type=MISSING_NAN if (use_missing and num_na > 0) else MISSING_NONE,
+            is_categorical=True,
+            categories=cats.astype(np.int64),
+        )
+
+    missing_type = MISSING_NONE
+    if use_missing and zero_as_missing and num_na > 0:
+        missing_type = MISSING_ZERO
+    elif use_missing and num_na > 0:
+        missing_type = MISSING_NAN
+
+    has_nan_bin = missing_type != MISSING_NONE
+    max_value_bins = max_bin - (1 if has_nan_bin else 0)
+    distinct, counts = np.unique(vv, return_counts=True)
+    bounds = _greedy_find_boundaries(
+        distinct, counts, max_value_bins, len(vv), min_data_in_bin
+    )
+    num_bins = len(bounds) + (1 if has_nan_bin else 0)
+    trivial = num_bins <= 1 or (len(distinct) <= 1 and not has_nan_bin)
+    ub = np.asarray(bounds, dtype=np.float64)
+    default_bin = int(np.searchsorted(ub[:-1], 0.0, side="left")) if len(ub) else 0
+    return BinMapper(
+        num_bins=max(num_bins, 1),
+        missing_type=missing_type,
+        is_categorical=False,
+        upper_bounds=ub,
+        is_trivial=trivial,
+        default_bin=default_bin,
+    )
+
+
+def bin_dataset(
+    X: np.ndarray,
+    max_bin: int = 255,
+    min_data_in_bin: int = 3,
+    categorical_features: Sequence[int] = (),
+    *,
+    use_missing: bool = True,
+    zero_as_missing: bool = False,
+    sample_cnt: int = 200000,
+    random_state: int = 1,
+) -> "BinnedData":
+    """Bin a full feature matrix. Sampling mirrors the reference's
+    ``DatasetLoader::SampleTextDataFromFile`` (``dataset_loader.cpp:1022``): bin
+    boundaries come from a row subsample, then the full matrix is discretized."""
+    X = np.asarray(X)
+    n, f = X.shape
+    if n > sample_cnt:
+        rng = np.random.RandomState(random_state)
+        idx = rng.choice(n, size=sample_cnt, replace=False)
+        sample = X[idx]
+    else:
+        sample = X
+    cat_set = set(int(c) for c in categorical_features)
+    mappers: List[BinMapper] = []
+    for j in range(f):
+        mappers.append(
+            find_bin(
+                sample[:, j], max_bin, min_data_in_bin,
+                is_categorical=(j in cat_set),
+                use_missing=use_missing, zero_as_missing=zero_as_missing,
+            )
+        )
+    return BinnedData.from_mappers(X, mappers)
+
+
+@dataclasses.dataclass
+class BinnedData:
+    """Dense binned matrix + per-feature metadata, ready for device upload."""
+
+    bins: np.ndarray                 # (N, F) uint8/uint16
+    mappers: List[BinMapper]
+    max_num_bins: int                # B: padded bin axis for device histograms
+    upper_bounds_padded: np.ndarray  # (F, B) f32: threshold per (feature, bin)
+    nan_bins: np.ndarray             # (F,) int32: NaN bin index or B (none)
+    num_bins_per_feature: np.ndarray  # (F,) int32
+    is_categorical: np.ndarray       # (F,) bool
+
+    @classmethod
+    def from_mappers(cls, X: np.ndarray, mappers: List[BinMapper]) -> "BinnedData":
+        n, f = X.shape
+        max_b = max(max(m.num_bins for m in mappers), 2)
+        dtype = np.uint8 if max_b <= 256 else np.uint16
+        bins = np.empty((n, f), dtype=dtype)
+        ub = np.full((f, max_b), np.inf, dtype=np.float32)
+        nan_bins = np.full(f, max_b, dtype=np.int32)
+        nbpf = np.empty(f, dtype=np.int32)
+        is_cat = np.zeros(f, dtype=bool)
+        for j, m in enumerate(mappers):
+            bins[:, j] = m.value_to_bin(X[:, j]).astype(dtype)
+            nbpf[j] = m.num_bins
+            is_cat[j] = m.is_categorical
+            if m.is_categorical:
+                ub[j, : m.num_bins] = np.arange(m.num_bins, dtype=np.float32)
+            elif m.upper_bounds is not None:
+                k = len(m.upper_bounds)
+                ub[j, :k] = m.upper_bounds.astype(np.float32)
+            if m.has_nan_bin:
+                nan_bins[j] = m.nan_bin
+        return cls(
+            bins=bins, mappers=mappers, max_num_bins=max_b,
+            upper_bounds_padded=ub, nan_bins=nan_bins,
+            num_bins_per_feature=nbpf, is_categorical=is_cat,
+        )
+
+    @property
+    def num_data(self) -> int:
+        return self.bins.shape[0]
+
+    @property
+    def num_features(self) -> int:
+        return self.bins.shape[1]
+
+    def apply(self, X: np.ndarray) -> np.ndarray:
+        """Bin new data (e.g. a validation set) with the training mappers —
+        reference ``LoadFromFileAlignWithOtherDataset`` (``dataset_loader.cpp:299``)."""
+        X = np.asarray(X)
+        out = np.empty((X.shape[0], self.num_features), dtype=self.bins.dtype)
+        for j, m in enumerate(self.mappers):
+            out[:, j] = m.value_to_bin(X[:, j]).astype(self.bins.dtype)
+        return out

@@ -1,0 +1,95 @@
+"""Command-line application: ``python -m lightgbm_tpu config=train.conf``.
+
+Reference: ``src/main.cpp:13`` -> ``Application::Run`` (``application.h:78``)
+dispatching on ``task`` in {train, predict, convert_model, refit, save_binary};
+config files are ``key=value`` lines with ``#`` comments, command-line
+``key=value`` args override the file (``Config::KV2Map`` precedence).
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Dict, List
+
+import numpy as np
+
+from .basic import Booster, Dataset
+from .config import Config
+from .engine import train as train_fn
+from .io.parser import load_data_file
+from .utils.log import Log
+
+
+def parse_cli_params(argv: List[str]) -> Dict[str, str]:
+    params: Dict[str, str] = {}
+    file_params: Dict[str, str] = {}
+    for arg in argv:
+        key, _, val = arg.partition("=")
+        params[key.strip()] = val.strip()
+    if "config" in params or "config_file" in params:
+        path = params.pop("config", None) or params.pop("config_file")
+        with open(path) as fh:
+            for line in fh:
+                line = line.split("#", 1)[0].strip()
+                if not line or "=" not in line:
+                    continue
+                key, _, val = line.partition("=")
+                file_params[key.strip()] = val.strip()
+    # precedence: explicit CLI args > config file (reference config.cpp).
+    merged = dict(file_params)
+    merged.update(params)
+    return merged
+
+
+def run(argv: List[str]) -> int:
+    params = parse_cli_params(argv)
+    task = params.pop("task", "train")
+    cfg = Config(dict(params))
+    if task == "train":
+        data_path = params.pop("data", None)
+        if not data_path:
+            Log.fatal("task=train requires data=<file>")
+        X, y, w, g = load_data_file(data_path, cfg.label_column, cfg.header)
+        ds = Dataset(X, label=y, weight=w, group=g, params=params)
+        valid_sets, valid_names = [], []
+        valid = params.pop("valid", params.pop("valid_data", ""))
+        for i, vp in enumerate(p for p in valid.split(",") if p):
+            Xv, yv, wv, gv = load_data_file(vp, cfg.label_column, cfg.header)
+            valid_sets.append(Dataset(Xv, label=yv, weight=wv, group=gv,
+                                      reference=ds, params=params))
+            valid_names.append(f"valid_{i}")
+        from .callback import log_evaluation
+        bst = train_fn(dict(params), ds, num_boost_round=cfg.num_iterations,
+                       valid_sets=valid_sets, valid_names=valid_names,
+                       callbacks=[log_evaluation(cfg.metric_freq)])
+        out = params.get("output_model", "LightGBM_model.txt")
+        bst.save_model(out)
+        Log.info(f"Finished training; model saved to {out}")
+        return 0
+    if task == "predict":
+        model_path = params.get("input_model", "LightGBM_model.txt")
+        data_path = params.get("data")
+        if not data_path:
+            Log.fatal("task=predict requires data=<file>")
+        bst = Booster(model_file=model_path)
+        X, _, _, _ = load_data_file(data_path, cfg.label_column, cfg.header)
+        pred = bst.predict(X, raw_score=cfg.predict_raw_score)
+        out = params.get("output_result", "LightGBM_predict_result.txt")
+        np.savetxt(out, np.atleast_2d(pred.T).T, fmt="%.9g")
+        Log.info(f"Finished prediction; results saved to {out}")
+        return 0
+    if task == "convert_model":
+        Log.fatal("convert_model (C++ codegen) is not supported on the TPU "
+                  "build yet")
+    if task == "refit":
+        Log.fatal("refit task lands with the refit API")
+    Log.fatal(f"unknown task {task}")
+    return 1
+
+
+def main() -> None:
+    sys.exit(run(sys.argv[1:]))
+
+
+if __name__ == "__main__":
+    main()

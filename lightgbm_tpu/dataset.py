@@ -1,0 +1,116 @@
+"""Internal training dataset: binned features + metadata, device-resident.
+
+Reference: ``Dataset``/``Metadata`` (``include/LightGBM/dataset.h:487,~80``).  The
+reference stores per-group ``Bin`` columns with EFB bundling for CPU cache
+behavior; on TPU the natural layout is one dense (N, F) uint8/uint16 HBM array
+(rows × features), which feeds both the histogram contraction and the partition
+predicate directly.  Metadata (label/weight/group/init_score) mirrors
+``src/io/metadata.cpp``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .binning import BinnedData, bin_dataset
+from .config import Config
+
+
+@dataclasses.dataclass
+class TrainData:
+    """Device-ready dataset (reference ``Dataset`` + ``CUDARowData``)."""
+
+    binned: BinnedData
+    label: np.ndarray
+    weight: Optional[np.ndarray] = None
+    group: Optional[np.ndarray] = None          # query sizes (ranking)
+    init_score: Optional[np.ndarray] = None
+    feature_names: Optional[List[str]] = None
+    monotone_constraints: Optional[np.ndarray] = None
+    # device arrays (lazily uploaded)
+    _bins_dev: Optional[jnp.ndarray] = None
+    _meta_dev: Optional[dict] = None
+
+    @classmethod
+    def build(
+        cls,
+        X: np.ndarray,
+        label: np.ndarray,
+        cfg: Config,
+        *,
+        weight: Optional[np.ndarray] = None,
+        group: Optional[np.ndarray] = None,
+        init_score: Optional[np.ndarray] = None,
+        categorical_features: Sequence[int] = (),
+        feature_names: Optional[List[str]] = None,
+        reference: Optional["TrainData"] = None,
+    ) -> "TrainData":
+        X = np.asarray(X)
+        if reference is not None:
+            binned = dataclasses.replace(
+                reference.binned, bins=reference.binned.apply(X))
+        else:
+            binned = bin_dataset(
+                X,
+                max_bin=cfg.max_bin,
+                min_data_in_bin=cfg.min_data_in_bin,
+                categorical_features=categorical_features,
+                use_missing=cfg.use_missing,
+                zero_as_missing=cfg.zero_as_missing,
+                sample_cnt=cfg.bin_construct_sample_cnt,
+                random_state=cfg.data_random_seed,
+            )
+        mono = None
+        if cfg.monotone_constraints:
+            mono = np.zeros(binned.num_features, np.int32)
+            mc = np.asarray(cfg.monotone_constraints, np.int32)
+            mono[: len(mc)] = mc
+        return cls(
+            binned=binned,
+            label=np.asarray(label),
+            weight=None if weight is None else np.asarray(weight, np.float32),
+            group=None if group is None else np.asarray(group, np.int64),
+            init_score=None if init_score is None else np.asarray(init_score),
+            feature_names=feature_names,
+            monotone_constraints=mono,
+        )
+
+    @property
+    def num_data(self) -> int:
+        return self.binned.num_data
+
+    @property
+    def num_features(self) -> int:
+        return self.binned.num_features
+
+    def bins_device(self, sharding=None) -> jnp.ndarray:
+        if self._bins_dev is None:
+            arr = jnp.asarray(self.binned.bins)
+            if sharding is not None:
+                arr = jax.device_put(arr, sharding)
+            self._bins_dev = arr
+        return self._bins_dev
+
+    def feature_meta_device(self) -> dict:
+        if self._meta_dev is None:
+            mono = (self.monotone_constraints
+                    if self.monotone_constraints is not None
+                    else np.zeros(self.num_features, np.int32))
+            self._meta_dev = {
+                "num_bins_per_feature": jnp.asarray(
+                    self.binned.num_bins_per_feature, jnp.int32),
+                "nan_bins": jnp.asarray(self.binned.nan_bins, jnp.int32),
+                "is_categorical": jnp.asarray(self.binned.is_categorical),
+                "monotone": jnp.asarray(mono, jnp.int32),
+            }
+        return self._meta_dev
+
+    def query_boundaries(self) -> Optional[np.ndarray]:
+        if self.group is None:
+            return None
+        return np.concatenate([[0], np.cumsum(self.group)])

@@ -1,0 +1,205 @@
+"""Training entry points: ``train`` and ``cv``.
+
+Reference: ``python-package/lightgbm/engine.py`` (``train:109`` — the iteration
+loop at ``engine.py:309-322``; ``cv:611`` with stratified/group folds).
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Callable, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from . import callback as callback_mod
+from .basic import Booster, Dataset
+from .callback import CallbackEnv, EarlyStopException
+
+
+def train(
+    params: Dict[str, Any],
+    train_set: Dataset,
+    num_boost_round: int = 100,
+    valid_sets: Optional[Sequence[Dataset]] = None,
+    valid_names: Optional[Sequence[str]] = None,
+    feval: Optional[Callable] = None,
+    init_model: Optional[Union[str, Booster]] = None,
+    keep_training_booster: bool = False,
+    callbacks: Optional[List[Callable]] = None,
+) -> Booster:
+    """Train a booster (reference ``engine.train``)."""
+    # Callable objective (reference: params["objective"] may be a function
+    # (grad, hess) = fobj(preds, train_data) since lightgbm 4.x).
+    fobj = None
+    if callable(params.get("objective")):
+        fobj = params["objective"]
+        params = {**params, "objective": "custom"}
+    params = copy.deepcopy(params)
+    if "num_iterations" in params or "num_boost_round" in params:
+        num_boost_round = int(params.pop("num_boost_round",
+                              params.pop("num_iterations", num_boost_round)))
+    # early stopping via params (reference: _ConfigAliases handling).
+    early_stopping_rounds = None
+    for alias in ("early_stopping_round", "early_stopping_rounds",
+                  "early_stopping", "n_iter_no_change"):
+        if params.get(alias):
+            early_stopping_rounds = int(params[alias])
+    first_metric_only = bool(params.get("first_metric_only", False))
+
+    valid_sets = list(valid_sets or [])
+    names = list(valid_names or [])
+    valid_pairs = []
+    for i, vs in enumerate(valid_sets):
+        if vs is train_set:
+            continue
+        nm = names[i] if i < len(names) else f"valid_{i}"
+        valid_pairs.append((nm, vs))
+
+    booster = Booster(params=params, train_set=train_set,
+                      valid_sets=valid_pairs)
+    if init_model is not None:
+        raise NotImplementedError(
+            "init_model continuation lands with model serialization round")
+
+    cbs = list(callbacks or [])
+    if early_stopping_rounds is not None and valid_pairs:
+        cbs.append(callback_mod.early_stopping(
+            early_stopping_rounds, first_metric_only=first_metric_only,
+            verbose=params.get("verbosity", 1) > 0))
+    cbs_before = [cb for cb in cbs if getattr(cb, "before_iteration", False)]
+    cbs_after = [cb for cb in cbs if not getattr(cb, "before_iteration", False)]
+    cbs_before.sort(key=lambda cb: getattr(cb, "order", 0))
+    cbs_after.sort(key=lambda cb: getattr(cb, "order", 0))
+
+    for it in range(num_boost_round):
+        for cb in cbs_before:
+            cb(CallbackEnv(booster, params, it, 0, num_boost_round, None))
+        finished = booster.update(fobj=fobj)
+        # Skip metric evaluation entirely when nothing consumes it — avoids a
+        # host transfer + metric sort per iteration.
+        if cbs_after or feval is not None:
+            evals = booster._evals(feval)
+            try:
+                for cb in cbs_after:
+                    cb(CallbackEnv(booster, params, it, 0, num_boost_round,
+                                   evals))
+            except EarlyStopException as e:
+                booster.best_iteration = e.best_iteration + 1
+                booster.best_score = e.best_score
+                break
+        if finished:
+            break
+    return booster
+
+
+def cv(
+    params: Dict[str, Any],
+    train_set: Dataset,
+    num_boost_round: int = 100,
+    folds=None,
+    nfold: int = 5,
+    stratified: bool = True,
+    shuffle: bool = True,
+    metrics=None,
+    seed: int = 0,
+    callbacks: Optional[List[Callable]] = None,
+    eval_train_metric: bool = False,
+    return_cv_booster: bool = False,
+) -> Dict[str, List[float]]:
+    """K-fold cross-validation (reference ``engine.cv:611``)."""
+    params = copy.deepcopy(params)
+    if metrics is not None:
+        params["metric"] = metrics
+    train_set.construct(params)
+    X, y = train_set.data, train_set.label
+    n = len(y)
+    rng = np.random.RandomState(seed)
+
+    group = train_set.group
+    if folds is None and group is not None:
+        # Query-aware folds: split whole queries (reference _make_n_folds
+        # group handling) so ranking objectives keep their query structure.
+        nq = len(group)
+        bounds = np.concatenate([[0], np.cumsum(group)])
+        q_idx = np.arange(nq)
+        if shuffle:
+            rng.shuffle(q_idx)
+        q_parts = np.array_split(q_idx, nfold)
+        folds = []
+        for i in range(nfold):
+            va_q = np.sort(q_parts[i])
+            tr_q = np.sort(np.concatenate(
+                [p for j, p in enumerate(q_parts) if j != i]))
+            va_rows = np.concatenate([np.arange(bounds[q], bounds[q + 1])
+                                      for q in va_q])
+            tr_rows = np.concatenate([np.arange(bounds[q], bounds[q + 1])
+                                      for q in tr_q])
+            folds.append((tr_rows, va_rows, group[tr_q], group[va_q]))
+        results: Dict[str, List[float]] = {}
+        boosters, fold_histories = [], []
+        for tr_idx, va_idx, tr_g, va_g in folds:
+            dtr = Dataset(X[tr_idx], label=np.asarray(y)[tr_idx], group=tr_g,
+                          params=params)
+            dva = Dataset(X[va_idx], label=np.asarray(y)[va_idx], group=va_g,
+                          reference=dtr, params=params)
+            history: Dict[str, Dict[str, List[float]]] = {}
+            cbs = list(callbacks or []) + [callback_mod.record_evaluation(history)]
+            bst = train(params, dtr, num_boost_round, valid_sets=[dva],
+                        valid_names=["valid"], callbacks=cbs)
+            boosters.append(bst)
+            fold_histories.append(history.get("valid", {}))
+        return _collect_cv(results, fold_histories, boosters,
+                           return_cv_booster)
+
+    if folds is None:
+        idx = np.arange(n)
+        if stratified and params.get("objective") in ("binary", "multiclass",
+                                                      "multiclassova"):
+            folds_idx = [[] for _ in range(nfold)]
+            for cls in np.unique(y):
+                cls_idx = idx[y == cls]
+                if shuffle:
+                    rng.shuffle(cls_idx)
+                for i, part in enumerate(np.array_split(cls_idx, nfold)):
+                    folds_idx[i].extend(part)
+            folds = [(np.setdiff1d(idx, np.array(f)), np.array(sorted(f)))
+                     for f in folds_idx]
+        else:
+            if shuffle:
+                rng.shuffle(idx)
+            parts = np.array_split(idx, nfold)
+            folds = [(np.concatenate([p for j, p in enumerate(parts) if j != i]),
+                      parts[i]) for i in range(nfold)]
+
+    results: Dict[str, List[float]] = {}
+    boosters = []
+    fold_histories = []
+    for tr_idx, va_idx in folds:
+        dtr = Dataset(X[tr_idx], label=np.asarray(y)[tr_idx],
+                      weight=None if train_set.weight is None
+                      else train_set.weight[tr_idx],
+                      params=params)
+        dva = Dataset(X[va_idx], label=np.asarray(y)[va_idx],
+                      weight=None if train_set.weight is None
+                      else train_set.weight[va_idx],
+                      reference=dtr, params=params)
+        history: Dict[str, Dict[str, List[float]]] = {}
+        cbs = list(callbacks or []) + [callback_mod.record_evaluation(history)]
+        bst = train(params, dtr, num_boost_round, valid_sets=[dva],
+                    valid_names=["valid"], callbacks=cbs)
+        boosters.append(bst)
+        fold_histories.append(history.get("valid", {}))
+
+    return _collect_cv(results, fold_histories, boosters, return_cv_booster)
+
+
+def _collect_cv(results, fold_histories, boosters, return_cv_booster):
+    metric_names = sorted({m for h in fold_histories for m in h})
+    for m in metric_names:
+        rounds = min(len(h[m]) for h in fold_histories if m in h)
+        vals = np.array([h[m][:rounds] for h in fold_histories if m in h])
+        results[f"valid {m}-mean"] = list(vals.mean(axis=0))
+        results[f"valid {m}-stdv"] = list(vals.std(axis=0))
+    if return_cv_booster:
+        results["cvbooster"] = boosters
+    return results

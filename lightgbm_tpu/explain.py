@@ -1,0 +1,204 @@
+"""Prediction explanations: leaf indices and SHAP feature contributions.
+
+Reference: ``Tree::PredictLeafIndex`` and ``Tree::PredictContrib`` (TreeSHAP,
+``src/io/tree.cpp``; surfaced via ``GBDT::PredictContrib``, ``gbdt.cpp:640``).
+Branchy recursion — kept host-side exactly as the reference keeps it on CPU
+even in CUDA mode.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+
+def _tree_children(tree):
+    return tree.left_child, tree.right_child
+
+
+def _decide_left(tree, node: int, bins_row: np.ndarray,
+                 nan_bins: np.ndarray) -> bool:
+    f = tree.split_feature[node]
+    col = int(bins_row[f])
+    if col == nan_bins[f] and not tree.is_cat[node]:
+        return bool(tree.default_left[node])
+    if tree.is_cat[node]:
+        b = min(col, tree.cat_mask.shape[1] - 1)
+        return bool(tree.cat_mask[node, b])
+    return col <= tree.split_bin[node]
+
+
+def predict_leaf_index(gbdt, X: np.ndarray, start_iteration: int = 0,
+                       num_iteration: Optional[int] = None) -> np.ndarray:
+    """(N, num_trees) leaf index matrix (reference ``predict_leaf_index``)."""
+    bins = gbdt.train_data.binned.apply(X)
+    nan_bins = gbdt.train_data.binned.nan_bins
+    all_trees = []
+    for k in range(gbdt.num_class):
+        trees = gbdt.models[k]
+        end = len(trees) if num_iteration is None else min(
+            len(trees), start_iteration + num_iteration)
+        all_trees.append(trees[start_iteration:end])
+    n = bins.shape[0]
+    t_per_class = max(len(t) for t in all_trees) if all_trees else 0
+    out = np.zeros((n, t_per_class * gbdt.num_class), np.int32)
+    for ti in range(t_per_class):
+        for k in range(gbdt.num_class):
+            tree = all_trees[k][ti]
+            col = ti * gbdt.num_class + k
+            if tree.num_leaves <= 1:
+                continue
+            node = np.zeros(n, np.int32)
+            active = np.ones(n, bool)
+            while active.any():
+                idx = np.nonzero(active)[0]
+                for i in idx:
+                    nd = node[i]
+                    go_left = _decide_left(tree, nd, bins[i], nan_bins)
+                    nxt = tree.left_child[nd] if go_left else tree.right_child[nd]
+                    if nxt < 0:
+                        out[i, col] = ~nxt
+                        active[i] = False
+                    else:
+                        node[i] = nxt
+    return out
+
+
+# --------------------------------------------------------------------- TreeSHAP
+class _PathElement:
+    __slots__ = ("feature_index", "zero_fraction", "one_fraction", "pweight")
+
+    def __init__(self, feature_index, zero_fraction, one_fraction, pweight):
+        self.feature_index = feature_index
+        self.zero_fraction = zero_fraction
+        self.one_fraction = one_fraction
+        self.pweight = pweight
+
+    def copy(self):
+        return _PathElement(self.feature_index, self.zero_fraction,
+                            self.one_fraction, self.pweight)
+
+
+def _extend(path: List[_PathElement], zero_fraction, one_fraction,
+            feature_index):
+    path.append(_PathElement(feature_index, zero_fraction, one_fraction,
+                             1.0 if len(path) == 0 else 0.0))
+    m = len(path) - 1
+    for i in range(m - 1, -1, -1):
+        path[i + 1].pweight += one_fraction * path[i].pweight * (i + 1) / (m + 1)
+        path[i].pweight = zero_fraction * path[i].pweight * (m - i) / (m + 1)
+
+
+def _unwind(path: List[_PathElement], i: int):
+    m = len(path) - 1
+    one_fraction = path[i].one_fraction
+    zero_fraction = path[i].zero_fraction
+    n = path[m].pweight
+    for j in range(m - 1, -1, -1):
+        if one_fraction != 0.0:
+            t = path[j].pweight
+            path[j].pweight = n * (m + 1) / ((j + 1) * one_fraction)
+            n = t - path[j].pweight * zero_fraction * (m - j) / (m + 1)
+        else:
+            path[j].pweight = path[j].pweight * (m + 1) / (zero_fraction * (m - j))
+    for j in range(i, m):
+        path[j].feature_index = path[j + 1].feature_index
+        path[j].zero_fraction = path[j + 1].zero_fraction
+        path[j].one_fraction = path[j + 1].one_fraction
+    path.pop()
+
+
+def _unwound_sum(path: List[_PathElement], i: int) -> float:
+    m = len(path) - 1
+    one_fraction = path[i].one_fraction
+    zero_fraction = path[i].zero_fraction
+    total = 0.0
+    n = path[m].pweight
+    for j in range(m - 1, -1, -1):
+        if one_fraction != 0.0:
+            t = n * (m + 1) / ((j + 1) * one_fraction)
+            total += t
+            n = path[j].pweight - t * zero_fraction * (m - j) / (m + 1)
+        else:
+            total += path[j].pweight / (zero_fraction * (m - j) / (m + 1))
+    return total
+
+
+def _tree_shap_recurse(tree, bins_row, nan_bins, phi, node, path,
+                       parent_zero, parent_one, parent_feature, cover):
+    path = [p.copy() for p in path]
+    _extend(path, parent_zero, parent_one, parent_feature)
+    if node < 0:  # leaf
+        leaf = ~node
+        for i in range(1, len(path)):
+            w = _unwound_sum(path, i)
+            el = path[i]
+            phi[el.feature_index] += w * (el.one_fraction - el.zero_fraction) \
+                * tree.leaf_value[leaf]
+        return
+    f = tree.split_feature[node]
+    go_left = _decide_left(tree, node, bins_row, nan_bins)
+    lc, rc = tree.left_child[node], tree.right_child[node]
+    hot, cold = (lc, rc) if go_left else (rc, lc)
+
+    def _cover(child):
+        if child < 0:
+            return float(tree.leaf_count[~child])
+        return float(tree.internal_count[child])
+
+    hot_cover, cold_cover = _cover(hot), _cover(cold)
+    node_cover = cover if cover > 0 else hot_cover + cold_cover
+    incoming_zero, incoming_one = 1.0, 1.0
+    path_idx = next((i for i in range(1, len(path))
+                     if path[i].feature_index == f), -1)
+    if path_idx >= 0:
+        incoming_zero = path[path_idx].zero_fraction
+        incoming_one = path[path_idx].one_fraction
+        _unwind(path, path_idx)
+    _tree_shap_recurse(tree, bins_row, nan_bins, phi, hot, path,
+                       incoming_zero * hot_cover / max(node_cover, 1e-30),
+                       incoming_one, f, hot_cover)
+    _tree_shap_recurse(tree, bins_row, nan_bins, phi, cold, path,
+                       incoming_zero * cold_cover / max(node_cover, 1e-30),
+                       0.0, f, cold_cover)
+
+
+def _tree_expected_value(tree) -> float:
+    nl = tree.num_leaves
+    counts = np.maximum(tree.leaf_count[:nl], 0)
+    total = counts.sum()
+    if total <= 0:
+        return 0.0
+    return float((tree.leaf_value[:nl] * counts).sum() / total)
+
+
+def predict_contrib(gbdt, X: np.ndarray, start_iteration: int = 0,
+                    num_iteration: Optional[int] = None) -> np.ndarray:
+    """(N, (F+1)*K) SHAP values; last column per class is the expected value
+    (reference ``PredictContrib``)."""
+    bins = gbdt.train_data.binned.apply(X)
+    nan_bins = gbdt.train_data.binned.nan_bins
+    n = bins.shape[0]
+    nf = gbdt.train_data.num_features
+    k = gbdt.num_class
+    out = np.zeros((n, (nf + 1) * k))
+    for kk in range(k):
+        trees = gbdt.models[kk]
+        end = len(trees) if num_iteration is None else min(
+            len(trees), start_iteration + num_iteration)
+        base = gbdt.init_scores[kk]
+        col0 = kk * (nf + 1)
+        for tree in trees[start_iteration:end]:
+            ev = _tree_expected_value(tree)
+            base += ev
+            if tree.num_leaves <= 1:
+                continue
+            for i in range(n):
+                phi = np.zeros(nf + 1)
+                _tree_shap_recurse(tree, bins[i], nan_bins, phi,
+                                   # root is node 0 (as internal), encode >=0
+                                   0, [], 1.0, 1.0, -1, 0.0)
+                out[i, col0: col0 + nf] += phi[:nf]
+        out[:, col0 + nf] = base
+    return out

@@ -1,0 +1,99 @@
+"""Text data file ingestion: CSV/TSV/LibSVM with format auto-detection.
+
+Reference: ``Parser::CreateParser`` (``dataset.h:436``, ``src/io/parser.cpp``) —
+sniffs the first lines to choose CSV vs TSV vs LibSVM; label column selection by
+index or ``name:<col>``; side files ``<data>.weight`` / ``<data>.query``
+(reference ``Metadata`` file side-loads, ``src/io/metadata.cpp``).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+def _sniff_format(lines) -> str:
+    """Reference parser.cpp: count separators on sample lines."""
+    for line in lines:
+        if not line.strip():
+            continue
+        tokens = line.split("\t") if "\t" in line else line.split(",")
+        for tok in tokens[1:3]:
+            if ":" in tok:
+                return "libsvm"
+        if "\t" in line:
+            return "tsv"
+        if "," in line:
+            return "csv"
+    return "csv"
+
+
+def _parse_libsvm(lines, num_features: Optional[int] = None):
+    labels, rows = [], []
+    max_f = -1
+    for line in lines:
+        parts = line.split()
+        if not parts:
+            continue
+        labels.append(float(parts[0]))
+        row = {}
+        for tok in parts[1:]:
+            k, _, v = tok.partition(":")
+            fi = int(k)
+            row[fi] = float(v)
+            max_f = max(max_f, fi)
+        rows.append(row)
+    nf = num_features or (max_f + 1)
+    X = np.zeros((len(rows), nf))
+    for i, row in enumerate(rows):
+        for k, v in row.items():
+            if k < nf:
+                X[i, k] = v
+    return X, np.asarray(labels)
+
+
+def load_data_file(
+    path: str,
+    label_column: str = "",
+    header: bool = False,
+    num_features: Optional[int] = None,
+) -> Tuple[np.ndarray, np.ndarray, Optional[np.ndarray], Optional[np.ndarray]]:
+    """Returns (X, y, weight, group).  Weight/group come from ``<path>.weight``
+    and ``<path>.query`` side files when present (reference metadata.cpp)."""
+    with open(path) as fh:
+        lines = fh.read().splitlines()
+    start = 1 if header else 0
+    fmt = _sniff_format(lines[start: start + 10])
+    if fmt == "libsvm":
+        X, y = _parse_libsvm(lines[start:], num_features)
+    else:
+        sep = "\t" if fmt == "tsv" else ","
+        data = np.asarray(
+            [[_atof(v) for v in line.split(sep)]
+             for line in lines[start:] if line.strip()])
+        label_idx = 0
+        if label_column.startswith("name:") and header:
+            names = lines[0].split(sep)
+            label_idx = names.index(label_column[5:])
+        elif label_column:
+            try:
+                label_idx = int(label_column)
+            except ValueError:
+                label_idx = 0
+        y = data[:, label_idx]
+        X = np.delete(data, label_idx, axis=1)
+    weight = group = None
+    if os.path.exists(path + ".weight"):
+        weight = np.loadtxt(path + ".weight")
+    if os.path.exists(path + ".query"):
+        group = np.loadtxt(path + ".query").astype(np.int64)
+    return X, y, weight, group
+
+
+def _atof(tok: str) -> float:
+    tok = tok.strip()
+    if tok == "" or tok.lower() in ("na", "nan", "null", "none"):
+        return np.nan
+    return float(tok)

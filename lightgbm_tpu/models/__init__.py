@@ -1,0 +1,1 @@
+from . import grower, tree  # noqa: F401

@@ -1,0 +1,96 @@
+"""DART boosting (Dropouts meet Multiple Additive Regression Trees).
+
+Reference: ``src/boosting/dart.hpp:23`` — per iteration, a random subset of
+existing trees is "dropped" (their contribution removed from the scores before
+computing gradients), the new tree is fit to the residual, and the dropped trees
+plus the new tree are re-normalized by ``k/(k+1)`` and ``1/(k+1)``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .gbdt import GBDT
+from .tree import predict_tree_bins_device
+
+
+class DART(GBDT):
+    def __init__(self, cfg, train, valids=()):
+        super().__init__(cfg, train, valids)
+        self.drop_rng = np.random.RandomState(cfg.drop_seed)
+
+    def _tree_pred(self, k: int, tree, bins) -> jnp.ndarray:
+        dev = self._device_tree(tree)
+        return predict_tree_bins_device(dev, bins, self.meta_dev["nan_bins"])
+
+    def _scale_tree_scores(self, k: int, idx: int, factor: float) -> None:
+        """Scale tree ``idx``'s stored leaf values and adjust all score arrays."""
+        tree = self.models[k][idx]
+        delta = factor - 1.0
+        pred = self._tree_pred(k, tree, self.bins_dev) * delta
+        if self._shape_k:
+            self.scores = self.scores.at[:, k].add(pred)
+        else:
+            self.scores = self.scores + pred
+        for i, vbins in enumerate(self.valid_bins):
+            vp = self._tree_pred(k, tree, vbins) * delta
+            if self._shape_k:
+                self.valid_scores[i] = self.valid_scores[i].at[:, k].add(vp)
+            else:
+                self.valid_scores[i] = self.valid_scores[i] + vp
+        tree.leaf_value = tree.leaf_value * factor
+        tree.internal_value = tree.internal_value * factor
+
+    def train_one_iter(self, grad=None, hess=None) -> bool:
+        cfg = self.cfg
+        n_trees = len(self.models[0])
+        drop_idx: list = []
+        if n_trees > 0 and self.drop_rng.rand() >= cfg.skip_drop:
+            if cfg.uniform_drop:
+                picks = self.drop_rng.rand(n_trees) < cfg.drop_rate
+                drop_idx = list(np.nonzero(picks)[0])
+            else:
+                k_drop = max(int(round(n_trees * cfg.drop_rate)), 1)
+                drop_idx = list(self.drop_rng.choice(
+                    n_trees, size=min(k_drop, n_trees), replace=False))
+            if cfg.max_drop > 0:
+                drop_idx = drop_idx[: cfg.max_drop]
+        # Remove dropped trees' contribution before computing gradients.
+        for k in range(self.num_class):
+            for idx in drop_idx:
+                pred = self._tree_pred(k, self.models[k][idx], self.bins_dev)
+                if self._shape_k:
+                    self.scores = self.scores.at[:, k].add(-pred)
+                else:
+                    self.scores = self.scores - pred
+        stop = super().train_one_iter(grad, hess)
+        # Normalize (reference DART::Normalize): dropped trees come back scaled
+        # by k/(k+1); the new tree is scaled by 1/(k+1).
+        kd = len(drop_idx)
+        if kd > 0:
+            factor_old = kd / (kd + 1.0)
+            factor_new = 1.0 / (kd + 1.0)
+            for k in range(self.num_class):
+                new_idx = len(self.models[k]) - 1
+                self._scale_tree_scores(k, new_idx, factor_new)
+                for idx in drop_idx:
+                    tree = self.models[k][idx]
+                    # Tree was fully removed above; re-add at the reduced scale.
+                    pred = self._tree_pred(k, tree, self.bins_dev) * factor_old
+                    if self._shape_k:
+                        self.scores = self.scores.at[:, k].add(pred)
+                    else:
+                        self.scores = self.scores + pred
+                    for i, vbins in enumerate(self.valid_bins):
+                        vp = self._tree_pred(k, tree, vbins) * (factor_old - 1.0)
+                        if self._shape_k:
+                            self.valid_scores[i] = self.valid_scores[i].at[:, k].add(vp)
+                        else:
+                            self.valid_scores[i] = self.valid_scores[i] + vp
+                    tree.leaf_value = tree.leaf_value * factor_old
+                    tree.internal_value = tree.internal_value * factor_old
+        return stop

@@ -1,0 +1,321 @@
+"""GBDT boosting driver.
+
+Reference: ``GBDT`` (``src/boosting/gbdt.cpp`` — ``Train:237``, ``TrainOneIter:344``
+boost-from-average -> gradients -> bagging -> one tree per class -> RenewTreeOutput
+-> Shrinkage -> UpdateScore; ``gbdt_model_text.cpp`` for serialization).
+
+TPU layout: scores, gradients, binned rows and the whole tree-growth loop live in
+HBM; one boosting iteration is a handful of fused XLA programs (objective grads ->
+grow_tree -> score gather).  Host work per iteration is O(1) scalars plus the
+optional percentile leaf renewal (branchy, host-friendly — kept on CPU exactly as
+the reference keeps SHAP/categorical logic host-side).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import Config
+from ..dataset import TrainData
+from ..metrics import Metric, create_metric, default_metric_for_objective
+from ..objectives import ObjectiveFunction, create_objective
+from ..sampling import FeatureSampler, SampleStrategy
+from ..ops.split import SplitConfig
+from .grower import GrowerConfig, TreeArrays, make_grower
+from .tree import Tree, predict_tree_bins_device, stack_trees, \
+    predict_ensemble_bins_device
+
+
+def _split_config(cfg: Config) -> SplitConfig:
+    return SplitConfig(
+        lambda_l1=cfg.lambda_l1,
+        lambda_l2=cfg.lambda_l2,
+        min_data_in_leaf=cfg.min_data_in_leaf,
+        min_sum_hessian_in_leaf=cfg.min_sum_hessian_in_leaf,
+        min_gain_to_split=cfg.min_gain_to_split,
+        max_delta_step=cfg.max_delta_step,
+        cat_l2=cfg.cat_l2,
+        cat_smooth=cfg.cat_smooth,
+        max_cat_threshold=cfg.max_cat_threshold,
+        max_cat_to_onehot=cfg.max_cat_to_onehot,
+        path_smooth=cfg.path_smooth,
+    )
+
+
+@jax.jit
+def _add_leaf_outputs(scores, row_leaf, leaf_values):
+    return scores + leaf_values[row_leaf]
+
+
+class GBDT:
+    """Boosting driver (reference ``GBDT``, ``gbdt.h:630``)."""
+
+    def __init__(self, cfg: Config, train: TrainData,
+                 valids: Sequence[Tuple[str, TrainData]] = ()):
+        self.cfg = cfg
+        self.train_data = train
+        self.valids = list(valids)
+        self.num_class = cfg.num_model_per_iteration
+        self.objective: Optional[ObjectiveFunction] = create_objective(cfg)
+        if self.objective is not None:
+            self.objective.init(train.label, train.weight, train.group, cfg)
+        self.metrics = self._create_metrics()
+        self.models: List[List[Tree]] = [[] for _ in range(self.num_class)]
+        self.iter_ = 0
+        self.best_iteration = -1
+
+        # Distributed layout: sharding the inputs IS the parallel tree learner
+        # (see parallel/mesh.py; reference §2.9 data/feature/voting learners).
+        from ..parallel.mesh import mesh_for_tree_learner, shard_arrays
+        self.mesh = mesh_for_tree_learner(cfg.tree_learner)
+        hist_impl = cfg.tpu_histogram_impl
+        if hist_impl == "auto" and self.mesh is not None:
+            # GSPMD partitions the einsum path across the mesh; the pallas
+            # kernel is single-device (shard_map wrapping is future work).
+            hist_impl = "onehot" if jax.default_backend() == "tpu" else "segment"
+        self.grower_cfg = GrowerConfig(
+            num_leaves=cfg.num_leaves,
+            max_depth=cfg.max_depth,
+            num_bins=train.binned.max_num_bins,
+            split=_split_config(cfg),
+            histogram_impl=hist_impl,
+            rows_block=cfg.tpu_rows_block,
+        )
+        self.grow = make_grower(self.grower_cfg)
+        self.bins_dev = train.bins_device()
+        self.meta_dev = train.feature_meta_device()
+        if self.mesh is not None:
+            self.bins_dev = shard_arrays(self.mesh, self.bins_dev)
+        self.sample_strategy = SampleStrategy(
+            cfg, train.num_data, train.label, train.query_boundaries())
+        self.feature_sampler = FeatureSampler(cfg, train.num_features)
+
+        self.init_scores = np.zeros(self.num_class, np.float64)
+        if cfg.boost_from_average and self.objective is not None:
+            for k in range(self.num_class):
+                self.init_scores[k] = self.objective.boost_from_score(k)
+        self.scores = self._init_scores_array(train)
+        self.valid_bins = [v.bins_device() for _, v in self.valids]
+        self.valid_scores = [self._init_scores_array(v) for _, v in self.valids]
+        self._shape_k = self.num_class > 1 or self.cfg.objective in (
+            "multiclass", "multiclassova")
+
+    # ------------------------------------------------------------------ helpers
+    def _init_scores_array(self, data: TrainData) -> jnp.ndarray:
+        n = data.num_data
+        k = self.num_class
+        base = np.tile(self.init_scores[None, :], (n, 1)).astype(np.float32)
+        if data.init_score is not None:
+            ins = np.asarray(data.init_score, np.float32).reshape(n, -1)
+            base = base + ins
+        if k == 1:
+            return jnp.asarray(base[:, 0])
+        return jnp.asarray(base)
+
+    def _create_metrics(self) -> List[Metric]:
+        names = self.cfg.metric
+        if not names:
+            names = [default_metric_for_objective(self.cfg.objective)]
+        out: List[Metric] = []
+        for nm in names:
+            if nm in ("", "none", "null", "na", "custom"):
+                continue
+            out.extend(create_metric(nm, self.cfg))
+        return out
+
+    # ----------------------------------------------------------------- training
+    def train_one_iter(self, grad: Optional[np.ndarray] = None,
+                       hess: Optional[np.ndarray] = None) -> bool:
+        """One boosting iteration (reference ``GBDT::TrainOneIter``).  Returns
+        True when no tree could be grown (training should stop)."""
+        cfg = self.cfg
+        if grad is None:
+            if self.objective is None:
+                raise ValueError(
+                    "objective='custom' requires gradients: pass a callable "
+                    "objective in params or call update(fobj=...) "
+                    "(reference LGBM_BoosterUpdateOneIterCustom)")
+            g_dev, h_dev = self.objective.get_gradients(self.scores)
+        else:
+            g_dev = jnp.asarray(grad, jnp.float32).reshape(self.scores.shape)
+            h_dev = jnp.asarray(hess, jnp.float32).reshape(self.scores.shape)
+
+        mask_np = None
+        strategy = self.sample_strategy
+        if strategy.is_goss:
+            gm = np.asarray(jax.device_get(g_dev)).reshape(len(self.train_data.label), -1)
+            hm = np.asarray(jax.device_get(h_dev)).reshape(gm.shape)
+            mask_np = strategy.mask(self.iter_, gm.sum(axis=1), hm.sum(axis=1))
+        else:
+            mask_np = strategy.mask(self.iter_)
+        n = self.train_data.num_data
+        mask_dev = (jnp.ones(n, jnp.float32) if mask_np is None
+                    else jnp.asarray(mask_np))
+        fmask = jnp.asarray(self.feature_sampler.tree_mask(self.iter_))
+
+        grew_any = False
+        for k in range(self.num_class):
+            tree, row_leaf = self._grow_one_tree(k, g_dev, h_dev, mask_dev,
+                                                 fmask)
+            if tree.num_leaves <= 1:
+                # No split improved the loss — store a zero constant tree so
+                # predict/rollback see exactly what training applied (reference
+                # stops with "no further splits with positive gain").
+                tree.leaf_value = np.zeros_like(tree.leaf_value)
+                self.models[k].append(tree)
+                continue
+            grew_any = True
+            if (self.objective is not None
+                    and self.objective.need_renew_tree_output):
+                rl = np.asarray(jax.device_get(row_leaf))
+                sc = np.asarray(jax.device_get(
+                    self.scores[:, k] if self._shape_k else self.scores))
+                renewed = self.objective.renew_leaf_values(
+                    sc, rl, tree.num_leaves)
+                if renewed is not None:
+                    tree.leaf_value = renewed
+            tree.shrink(cfg.learning_rate if cfg.boosting != "rf" else 1.0)
+            self.models[k].append(tree)
+            self._update_scores(k, tree, row_leaf)
+        self.iter_ += 1
+        return not grew_any
+
+    def _grow_one_tree(self, k: int, g_dev, h_dev, mask_dev, fmask):
+        """Grow one class-k tree on the device (shared by GBDT/DART/RF)."""
+        gk = g_dev[:, k] if self._shape_k else g_dev
+        hk = h_dev[:, k] if self._shape_k else h_dev
+        arrays, row_leaf = self.grow(
+            self.bins_dev, gk, hk, mask_dev, fmask,
+            self.meta_dev["num_bins_per_feature"],
+            self.meta_dev["nan_bins"],
+            self.meta_dev["is_categorical"],
+            self.meta_dev["monotone"],
+        )
+        tree = Tree.from_arrays(arrays,
+                                self.train_data.binned.upper_bounds_padded)
+        return tree, row_leaf
+
+    def _update_scores(self, k: int, tree: Tree, row_leaf: jnp.ndarray) -> None:
+        lv = jnp.asarray(tree.leaf_value, jnp.float32)
+        if self._shape_k:
+            self.scores = self.scores.at[:, k].set(
+                _add_leaf_outputs(self.scores[:, k], row_leaf, lv))
+        else:
+            self.scores = _add_leaf_outputs(self.scores, row_leaf, lv)
+        dev_tree = self._device_tree(tree)
+        for i, vbins in enumerate(self.valid_bins):
+            pred = predict_tree_bins_device(
+                dev_tree, vbins, self.meta_dev["nan_bins"])
+            if self._shape_k:
+                self.valid_scores[i] = self.valid_scores[i].at[:, k].add(pred)
+            else:
+                self.valid_scores[i] = self.valid_scores[i] + pred
+
+    def _device_tree(self, tree: Tree) -> dict:
+        stacked = stack_trees([tree], self.cfg.num_leaves,
+                              self.train_data.binned.max_num_bins)
+        return jax.tree_util.tree_map(lambda a: a[0], stacked)
+
+    # --------------------------------------------------------------- evaluation
+    def eval_set(self, feval=None) -> List[Tuple[str, str, float, bool]]:
+        """[(dataset_name, metric_name, value, higher_better)] for all datasets
+        (reference ``GBDT::OutputMetric``)."""
+        out = []
+        datasets = [("training", self.train_data, self.scores)]
+        datasets += [
+            (name, data, self.valid_scores[i])
+            for i, (name, data) in enumerate(self.valids)
+        ]
+        for name, data, scores in datasets:
+            if name == "training" and not self.cfg.is_provide_training_metric \
+                    and feval is None and not self._force_train_metric():
+                continue
+            sc = np.asarray(jax.device_get(scores), np.float64)
+            for m in self.metrics:
+                out.append((name, m.name,
+                            m(data.label, sc, data.weight, data.group),
+                            m.higher_better))
+        return out
+
+    def _force_train_metric(self) -> bool:
+        return False
+
+    def eval_valid(self) -> List[Tuple[str, str, float, bool]]:
+        return [e for e in self.eval_set() if e[0] != "training"]
+
+    # --------------------------------------------------------------- prediction
+    def predict_raw(self, X: np.ndarray, num_iteration: Optional[int] = None,
+                    start_iteration: int = 0) -> np.ndarray:
+        """Raw scores for new data: host binning + device ensemble traversal."""
+        X = np.asarray(X)
+        bins = jnp.asarray(self.train_data.binned.apply(X))
+        nan_bins = self.meta_dev["nan_bins"]
+        n = X.shape[0]
+        k = self.num_class
+        out = np.zeros((n, k), np.float64)
+        for kk in range(k):
+            trees = self.models[kk]
+            end = len(trees) if num_iteration is None else min(
+                len(trees), start_iteration + num_iteration)
+            trees = trees[start_iteration:end]
+            if trees:
+                stacked = stack_trees(trees, self.cfg.num_leaves,
+                                      self.train_data.binned.max_num_bins)
+                pred = predict_ensemble_bins_device(stacked, bins, nan_bins)
+                out[:, kk] = np.asarray(jax.device_get(pred), np.float64)
+            out[:, kk] += self.init_scores[kk]
+        return out[:, 0] if k == 1 else out
+
+    def predict(self, X: np.ndarray, raw_score: bool = False,
+                num_iteration: Optional[int] = None,
+                start_iteration: int = 0) -> np.ndarray:
+        raw = self.predict_raw(X, num_iteration, start_iteration)
+        if raw_score or self.objective is None:
+            return raw
+        return np.asarray(jax.device_get(
+            self.objective.convert_output(jnp.asarray(raw))))
+
+    def rollback_one_iter(self) -> None:
+        """reference ``GBDT::RollbackOneIter`` — drop the last iteration's trees
+        and subtract their score contributions."""
+        if self.iter_ == 0:
+            return
+        for k in range(self.num_class):
+            tree = self.models[k].pop()
+            if tree.num_leaves > 1:
+                dev_tree = self._device_tree(tree)
+                pred = predict_tree_bins_device(
+                    dev_tree, self.bins_dev, self.meta_dev["nan_bins"])
+                if self._shape_k:
+                    self.scores = self.scores.at[:, k].add(-pred)
+                else:
+                    self.scores = self.scores - pred
+                for i, vbins in enumerate(self.valid_bins):
+                    vp = predict_tree_bins_device(
+                        dev_tree, vbins, self.meta_dev["nan_bins"])
+                    if self._shape_k:
+                        self.valid_scores[i] = self.valid_scores[i].at[:, k].add(-vp)
+                    else:
+                        self.valid_scores[i] = self.valid_scores[i] - vp
+        self.iter_ -= 1
+
+    @property
+    def num_trees(self) -> int:
+        return sum(len(m) for m in self.models)
+
+    def feature_importance(self, importance_type: str = "split") -> np.ndarray:
+        """reference ``GBDT::FeatureImportance`` (``gbdt.cpp``)."""
+        imp = np.zeros(self.train_data.num_features, np.float64)
+        for cls_models in self.models:
+            for tree in cls_models:
+                k = tree.num_splits()
+                if importance_type == "split":
+                    np.add.at(imp, tree.split_feature[:k], 1.0)
+                else:
+                    np.add.at(imp, tree.split_feature[:k],
+                              tree.split_gain[:k].astype(np.float64))
+        return imp

@@ -1,0 +1,298 @@
+"""Device-resident leaf-wise tree growth.
+
+Reference counterparts: ``SerialTreeLearner::Train`` (``src/treelearner/
+serial_tree_learner.cpp:179`` — pick best leaf, build smaller-sibling histogram,
+subtract for the other, find best thresholds, partition rows) and the CUDA
+device-resident learner (``cuda_single_gpu_tree_learner.cpp:158`` — per-leaf kernel
+sequence with only scalars returning to host).
+
+TPU re-design: the whole per-tree growth loop is ONE compiled XLA program —
+a ``lax.while_loop`` with static trip bound ``num_leaves - 1`` over a static-shape
+state.  Instead of a permutation array + contiguous leaf ranges (reference
+``DataPartition``), rows carry a ``row_leaf`` assignment vector; leaf membership is
+a predicate folded into the histogram contraction, so no dynamic-size gathers
+exist anywhere.  Host sees nothing until the finished tree arrays come back.
+
+Sharding: ``bins``/``grad``/``hess``/``row_leaf`` may be sharded along rows and/or
+the feature axis of ``bins`` across a mesh; all per-leaf reductions cross the mesh
+via compiler-inserted collectives (the reference's histogram ReduceScatter + split
+AllGather, ``data_parallel_tree_learner.cpp:284,441``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.histogram import build_histogram
+from ..ops.split import BestSplit, SplitConfig, best_split, leaf_output
+
+_NEG_INF = -jnp.inf
+
+
+@dataclasses.dataclass(frozen=True)
+class GrowerConfig:
+    num_leaves: int = 31
+    max_depth: int = -1
+    num_bins: int = 256          # padded bin axis B
+    split: SplitConfig = dataclasses.field(default_factory=SplitConfig)
+    histogram_impl: str = "auto"
+    rows_block: int = 16384
+
+
+class TreeArrays(NamedTuple):
+    """Static-shape device tree (reference ``Tree``/``CUDATree``, ``tree.h:26``).
+
+    ``left_child``/``right_child`` >= 0 index internal nodes; negative values are
+    ``~leaf_index`` (the reference's encoding).
+    """
+
+    split_feature: jnp.ndarray   # (M,) i32
+    split_bin: jnp.ndarray       # (M,) i32
+    default_left: jnp.ndarray    # (M,) bool
+    is_cat: jnp.ndarray          # (M,) bool
+    cat_mask: jnp.ndarray        # (M, B) bool — bins routed LEFT
+    left_child: jnp.ndarray      # (M,) i32
+    right_child: jnp.ndarray     # (M,) i32
+    split_gain: jnp.ndarray      # (M,) f32
+    internal_value: jnp.ndarray  # (M,) f32
+    internal_count: jnp.ndarray  # (M,) f32
+    leaf_value: jnp.ndarray      # (L,) f32
+    leaf_count: jnp.ndarray      # (L,) f32
+    leaf_weight: jnp.ndarray     # (L,) f32 (sum of hessians)
+    num_leaves: jnp.ndarray      # () i32
+
+    @property
+    def max_leaves(self) -> int:
+        return self.leaf_value.shape[0]
+
+
+class _GrowState(NamedTuple):
+    num_leaves: jnp.ndarray      # () i32
+    row_leaf: jnp.ndarray        # (N,) i32
+    leaf_hist: jnp.ndarray       # (L, F, B, 3) f32
+    leaf_sum_grad: jnp.ndarray   # (L,)
+    leaf_sum_hess: jnp.ndarray   # (L,)
+    leaf_count: jnp.ndarray      # (L,)
+    leaf_depth: jnp.ndarray      # (L,) i32
+    leaf_parent: jnp.ndarray     # (L,) i32 node index (-1 root)
+    leaf_is_left: jnp.ndarray    # (L,) bool
+    best_gain: jnp.ndarray       # (L,) f32 (-inf inactive / unsplittable)
+    best_feature: jnp.ndarray    # (L,) i32
+    best_bin: jnp.ndarray        # (L,) i32
+    best_default_left: jnp.ndarray  # (L,) bool
+    best_is_cat: jnp.ndarray     # (L,) bool
+    best_cat_mask: jnp.ndarray   # (L, B) bool
+    best_gl: jnp.ndarray         # (L,) split child stats
+    best_hl: jnp.ndarray
+    best_cl: jnp.ndarray
+    tree: TreeArrays
+
+
+def _store_best(state: _GrowState, leaf: jnp.ndarray, bs: BestSplit,
+                depth_ok: jnp.ndarray) -> _GrowState:
+    gain = jnp.where(depth_ok, bs.gain, _NEG_INF)
+    return state._replace(
+        best_gain=state.best_gain.at[leaf].set(gain),
+        best_feature=state.best_feature.at[leaf].set(bs.feature),
+        best_bin=state.best_bin.at[leaf].set(bs.bin),
+        best_default_left=state.best_default_left.at[leaf].set(bs.default_left),
+        best_is_cat=state.best_is_cat.at[leaf].set(bs.is_cat),
+        best_cat_mask=state.best_cat_mask.at[leaf].set(bs.cat_mask),
+        best_gl=state.best_gl.at[leaf].set(bs.sum_grad_left),
+        best_hl=state.best_hl.at[leaf].set(bs.sum_hess_left),
+        best_cl=state.best_cl.at[leaf].set(bs.count_left),
+    )
+
+
+def make_grower(cfg: GrowerConfig):
+    """Build the jitted ``grow(bins, grad, hess, sample_mask, feature_mask, meta...)``
+    function.  All shapes/hyper-params are compile-time; data is traced."""
+
+    L, B = cfg.num_leaves, cfg.num_bins
+    M = max(L - 1, 1)
+
+    def _best_for(hist, pg, ph, pc, meta, feature_mask):
+        nbpf, nan_bins, is_cat, monotone = meta
+        return best_split(
+            hist, pg, ph, pc,
+            num_bins_per_feature=nbpf, nan_bins=nan_bins, is_categorical=is_cat,
+            monotone=monotone, feature_mask=feature_mask, cfg=cfg.split,
+        )
+
+    @functools.partial(jax.jit, donate_argnums=())
+    def grow(
+        bins: jnp.ndarray,          # (N, F) uint8/16 — binned features
+        grad: jnp.ndarray,          # (N,) f32
+        hess: jnp.ndarray,          # (N,) f32
+        sample_mask: jnp.ndarray,   # (N,) f32 bagging/GOSS weights (1.0 = in-bag)
+        feature_mask: jnp.ndarray,  # (F,) bool feature_fraction mask
+        num_bins_per_feature: jnp.ndarray,
+        nan_bins: jnp.ndarray,
+        is_categorical: jnp.ndarray,
+        monotone: jnp.ndarray,      # (F,) i32
+    ) -> Tuple[TreeArrays, jnp.ndarray]:
+        n, f = bins.shape
+        meta = (num_bins_per_feature, nan_bins, is_categorical, monotone)
+        g = grad * sample_mask
+        h = hess * sample_mask
+        in_bag = sample_mask > 0.0
+
+        def hist_for(mask):
+            return build_histogram(
+                bins, g, h, mask, num_bins=B,
+                impl=cfg.histogram_impl, rows_block=cfg.rows_block,
+            )
+
+        root_hist = hist_for(in_bag)
+        root_tot = jnp.sum(root_hist[0], axis=0)  # (3,): feature 0 covers all rows
+        root_g, root_h, root_c = root_tot[0], root_tot[1], root_tot[2]
+
+        tree = TreeArrays(
+            split_feature=jnp.zeros(M, jnp.int32),
+            split_bin=jnp.zeros(M, jnp.int32),
+            default_left=jnp.zeros(M, bool),
+            is_cat=jnp.zeros(M, bool),
+            cat_mask=jnp.zeros((M, B), bool),
+            left_child=jnp.zeros(M, jnp.int32),
+            right_child=jnp.zeros(M, jnp.int32),
+            split_gain=jnp.zeros(M, jnp.float32),
+            internal_value=jnp.zeros(M, jnp.float32),
+            internal_count=jnp.zeros(M, jnp.float32),
+            leaf_value=jnp.zeros(L, jnp.float32),
+            leaf_count=jnp.zeros(L, jnp.float32),
+            leaf_weight=jnp.zeros(L, jnp.float32),
+            num_leaves=jnp.asarray(1, jnp.int32),
+        )
+
+        state = _GrowState(
+            num_leaves=jnp.asarray(1, jnp.int32),
+            row_leaf=jnp.zeros(n, jnp.int32),
+            leaf_hist=jnp.zeros((L, f, B, 3), jnp.float32).at[0].set(root_hist),
+            leaf_sum_grad=jnp.zeros(L, jnp.float32).at[0].set(root_g),
+            leaf_sum_hess=jnp.zeros(L, jnp.float32).at[0].set(root_h),
+            leaf_count=jnp.zeros(L, jnp.float32).at[0].set(root_c),
+            leaf_depth=jnp.zeros(L, jnp.int32),
+            leaf_parent=jnp.full(L, -1, jnp.int32),
+            leaf_is_left=jnp.zeros(L, bool),
+            best_gain=jnp.full(L, _NEG_INF, jnp.float32),
+            best_feature=jnp.zeros(L, jnp.int32),
+            best_bin=jnp.zeros(L, jnp.int32),
+            best_default_left=jnp.zeros(L, bool),
+            best_is_cat=jnp.zeros(L, bool),
+            best_cat_mask=jnp.zeros((L, B), bool),
+            best_gl=jnp.zeros(L, jnp.float32),
+            best_hl=jnp.zeros(L, jnp.float32),
+            best_cl=jnp.zeros(L, jnp.float32),
+            tree=tree,
+        )
+        root_bs = _best_for(root_hist, root_g, root_h, root_c, meta, feature_mask)
+        root_depth_ok = jnp.asarray(cfg.max_depth != 1)
+        state = _store_best(state, jnp.asarray(0), root_bs, root_depth_ok)
+
+        def cond(st: _GrowState):
+            return (st.num_leaves < L) & (jnp.max(st.best_gain) > _NEG_INF)
+
+        def body(st: _GrowState) -> _GrowState:
+            leaf = jnp.argmax(st.best_gain).astype(jnp.int32)
+            node = st.num_leaves - 1
+            new_leaf = st.num_leaves
+
+            feat = st.best_feature[leaf]
+            sbin = st.best_bin[leaf]
+            dleft = st.best_default_left[leaf]
+            scat = st.best_is_cat[leaf]
+            cmask = st.best_cat_mask[leaf]
+
+            # ---- partition rows (reference DataPartition::Split) ----
+            col = jnp.take(bins, feat, axis=1).astype(jnp.int32)
+            is_nan = col == nan_bins[feat]
+            go_left = jnp.where(scat, cmask[col], col <= sbin)
+            go_left = jnp.where(is_nan & ~scat, dleft, go_left)
+            mine = st.row_leaf == leaf
+            row_leaf = jnp.where(mine & ~go_left, new_leaf, st.row_leaf)
+
+            # ---- child stats ----
+            pg, ph, pc = (st.leaf_sum_grad[leaf], st.leaf_sum_hess[leaf],
+                          st.leaf_count[leaf])
+            gl, hl, cl = st.best_gl[leaf], st.best_hl[leaf], st.best_cl[leaf]
+            gr, hr, cr = pg - gl, ph - hl, pc - cl
+
+            # ---- smaller-child histogram + sibling subtraction ----
+            small_is_left = cl <= cr
+            target = jnp.where(small_is_left, leaf, new_leaf)
+            # row_leaf tracks ALL rows (out-of-bag included, they need score
+            # updates later); the histogram must see only in-bag rows or the
+            # count channel diverges from the root histogram.
+            hist_small = hist_for((row_leaf == target) & in_bag)
+            hist_parent = st.leaf_hist[leaf]
+            hist_big = hist_parent - hist_small
+            hist_left = jnp.where(small_is_left, hist_small, hist_big)
+            hist_right = jnp.where(small_is_left, hist_big, hist_small)
+            leaf_hist = st.leaf_hist.at[leaf].set(hist_left).at[new_leaf].set(hist_right)
+
+            # ---- tree bookkeeping ----
+            tr = st.tree
+            parent = st.leaf_parent[leaf]
+            p_safe = jnp.maximum(parent, 0)
+            was_left = st.leaf_is_left[leaf]
+            left_child = tr.left_child.at[p_safe].set(
+                jnp.where((parent >= 0) & was_left, node, tr.left_child[p_safe]))
+            right_child = tr.right_child.at[p_safe].set(
+                jnp.where((parent >= 0) & ~was_left, node, tr.right_child[p_safe]))
+            tr = tr._replace(
+                split_feature=tr.split_feature.at[node].set(feat),
+                split_bin=tr.split_bin.at[node].set(sbin),
+                default_left=tr.default_left.at[node].set(dleft),
+                is_cat=tr.is_cat.at[node].set(scat),
+                cat_mask=tr.cat_mask.at[node].set(cmask),
+                left_child=left_child.at[node].set(~leaf),
+                right_child=right_child.at[node].set(~new_leaf),
+                split_gain=tr.split_gain.at[node].set(st.best_gain[leaf]),
+                internal_value=tr.internal_value.at[node].set(
+                    leaf_output(pg, ph, cfg.split)),
+                internal_count=tr.internal_count.at[node].set(pc),
+            )
+
+            depth = st.leaf_depth[leaf] + 1
+            st = st._replace(
+                num_leaves=st.num_leaves + 1,
+                row_leaf=row_leaf,
+                leaf_hist=leaf_hist,
+                leaf_sum_grad=st.leaf_sum_grad.at[leaf].set(gl).at[new_leaf].set(gr),
+                leaf_sum_hess=st.leaf_sum_hess.at[leaf].set(hl).at[new_leaf].set(hr),
+                leaf_count=st.leaf_count.at[leaf].set(cl).at[new_leaf].set(cr),
+                leaf_depth=st.leaf_depth.at[leaf].set(depth).at[new_leaf].set(depth),
+                leaf_parent=st.leaf_parent.at[leaf].set(node).at[new_leaf].set(node),
+                leaf_is_left=st.leaf_is_left.at[leaf].set(True)
+                                            .at[new_leaf].set(False),
+                tree=tr,
+            )
+
+            # ---- children best splits ----
+            depth_ok = jnp.asarray(True) if cfg.max_depth <= 0 \
+                else depth < cfg.max_depth
+            bs_l = _best_for(hist_left, gl, hl, cl, meta, feature_mask)
+            bs_r = _best_for(hist_right, gr, hr, cr, meta, feature_mask)
+            st = _store_best(st, leaf, bs_l, depth_ok)
+            st = _store_best(st, new_leaf, bs_r, depth_ok)
+            return st
+
+        state = jax.lax.while_loop(cond, body, state)
+
+        leaf_ids = jnp.arange(L)
+        active = leaf_ids < state.num_leaves
+        values = leaf_output(state.leaf_sum_grad, state.leaf_sum_hess, cfg.split)
+        tree = state.tree._replace(
+            leaf_value=jnp.where(active, values, 0.0),
+            leaf_count=jnp.where(active, state.leaf_count, 0.0),
+            leaf_weight=jnp.where(active, state.leaf_sum_hess, 0.0),
+            num_leaves=state.num_leaves,
+        )
+        return tree, state.row_leaf
+
+    return grow

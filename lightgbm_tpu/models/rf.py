@@ -1,0 +1,83 @@
+"""Random Forest mode.
+
+Reference: ``src/boosting/rf.hpp:25`` — mandatory bagging, no shrinkage,
+gradients always computed at the init score (no boosting), and predictions are
+the **average** of tree outputs plus the init score.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .gbdt import GBDT
+from .tree import predict_tree_bins_device
+
+
+class RandomForest(GBDT):
+    def __init__(self, cfg, train, valids=()):
+        if not (cfg.bagging_freq > 0 and (cfg.bagging_fraction < 1.0
+                                          or cfg.feature_fraction < 1.0)):
+            raise ValueError(
+                "rf boosting requires bagging (bagging_freq>0 and "
+                "bagging_fraction<1) or feature_fraction<1  "
+                "(reference rf.hpp constructor check)")
+        super().__init__(cfg, train, valids)
+        # Scores are frozen at the init score; trees are averaged at predict.
+        self._init_train_scores = self.scores
+        self._sum_scores = jnp.zeros_like(self.scores)
+        self._sum_valid = [jnp.zeros_like(v) for v in self.valid_scores]
+        self._init_valid = [v for v in self.valid_scores]
+
+    def train_one_iter(self, grad=None, hess=None) -> bool:
+        if grad is None:
+            g_dev, h_dev = self.objective.get_gradients(self._init_train_scores)
+        else:
+            g_dev = jnp.asarray(grad, jnp.float32).reshape(self.scores.shape)
+            h_dev = jnp.asarray(hess, jnp.float32).reshape(self.scores.shape)
+
+        mask_np = self.sample_strategy.mask(self.iter_)
+        n = self.train_data.num_data
+        mask_dev = (jnp.ones(n, jnp.float32) if mask_np is None
+                    else jnp.asarray(mask_np))
+        fmask = jnp.asarray(self.feature_sampler.tree_mask(self.iter_))
+
+        grew_any = False
+        for k in range(self.num_class):
+            tree, row_leaf = self._grow_one_tree(k, g_dev, h_dev, mask_dev,
+                                                 fmask)
+            if tree.num_leaves <= 1:
+                tree.leaf_value = np.zeros_like(tree.leaf_value)
+            else:
+                grew_any = True
+            self.models[k].append(tree)
+            lv = jnp.asarray(tree.leaf_value, jnp.float32)
+            contrib = lv[row_leaf]
+            if self._shape_k:
+                self._sum_scores = self._sum_scores.at[:, k].add(contrib)
+            else:
+                self._sum_scores = self._sum_scores + contrib
+            dev_tree = self._device_tree(tree)
+            for i, vbins in enumerate(self.valid_bins):
+                vp = predict_tree_bins_device(dev_tree, vbins,
+                                              self.meta_dev["nan_bins"])
+                if self._shape_k:
+                    self._sum_valid[i] = self._sum_valid[i].at[:, k].add(vp)
+                else:
+                    self._sum_valid[i] = self._sum_valid[i] + vp
+        self.iter_ += 1
+        t = float(self.iter_)
+        self.scores = self._init_train_scores + self._sum_scores / t
+        self.valid_scores = [init + s / t for init, s in
+                             zip(self._init_valid, self._sum_valid)]
+        return not grew_any
+
+    def predict_raw(self, X, num_iteration=None, start_iteration=0):
+        raw = super().predict_raw(X, num_iteration, start_iteration)
+        n_iter = len(self.models[0]) if num_iteration is None else num_iteration
+        n_iter = max(min(n_iter, len(self.models[0]) - start_iteration), 1)
+        init = self.init_scores[0] if self.num_class == 1 else self.init_scores
+        return (raw - init) / n_iter + init

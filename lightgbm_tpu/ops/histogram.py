@@ -1,0 +1,117 @@
+"""Gradient/hessian histogram construction — the hottest op in GBDT training.
+
+Reference counterparts: ``DenseBin::ConstructHistogram`` (``src/io/dense_bin.hpp:143``,
+sequential CPU scan) and the CUDA shared-memory scatter-add kernels
+(``src/treelearner/cuda/cuda_histogram_constructor.cu:31-66``).
+
+TPU re-design: the TPU has no atomics and scatters serialize, so the histogram is
+expressed as a **one-hot contraction** that XLA maps onto the MXU:
+
+    hist[f, b, c] = sum_r  (bins[r, f] == b) * vals[r, c]      c in {grad, hess, count}
+
+computed blockwise under ``lax.scan`` so the one-hot never materializes in HBM at
+full size.  Leaf membership / bagging are folded into ``vals`` as multiplicative
+masks, which keeps every shape static under ``jit``.  A ``segment_sum`` (scatter)
+variant is kept for comparison/benchmarking on CPU backends.
+
+Sharding: when ``bins``/``vals`` are sharded along rows, the contraction's reduce
+axis spans the mesh and XLA inserts a ``psum`` of the partial histograms — this IS
+the reference's histogram ReduceScatter (``data_parallel_tree_learner.cpp:284``),
+derived automatically from shardings instead of hand-written collectives.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def pack_values(
+    grad: jnp.ndarray, hess: jnp.ndarray, mask: Optional[jnp.ndarray]
+) -> jnp.ndarray:
+    """Stack (grad, hess, ones) into the (N, 3) channel matrix, pre-masked."""
+    ones = jnp.ones_like(grad)
+    vals = jnp.stack([grad, hess, ones], axis=-1)
+    if mask is not None:
+        vals = vals * mask.astype(vals.dtype)[:, None]
+    return vals
+
+
+@functools.partial(jax.jit, static_argnames=("num_bins", "rows_block"))
+def histogram_onehot(
+    bins: jnp.ndarray,       # (N, F) integer bins
+    vals: jnp.ndarray,       # (N, 3) f32 masked (grad, hess, 1)
+    *,
+    num_bins: int,
+    rows_block: int = 16384,
+) -> jnp.ndarray:            # (F, num_bins, 3) f32
+    n, f = bins.shape
+    pad = (-n) % rows_block
+    if pad:
+        bins = jnp.pad(bins, ((0, pad), (0, 0)))
+        vals = jnp.pad(vals, ((0, pad), (0, 0)))
+    nblocks = (n + pad) // rows_block
+    bins_blk = bins.reshape(nblocks, rows_block, f)
+    vals_blk = vals.reshape(nblocks, rows_block, 3)
+    iota = jnp.arange(num_bins, dtype=jnp.int32)
+
+    def body(acc, blk):
+        b, v = blk
+        onehot = (b.astype(jnp.int32)[:, :, None] == iota[None, None, :])
+        acc = acc + jnp.einsum(
+            "nfb,nc->fbc",
+            onehot.astype(v.dtype),
+            v,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+        return acc, None
+
+    init = jnp.zeros((f, num_bins, 3), dtype=vals.dtype)
+    hist, _ = jax.lax.scan(body, init, (bins_blk, vals_blk))
+    return hist
+
+
+@functools.partial(jax.jit, static_argnames=("num_bins",))
+def histogram_segment(
+    bins: jnp.ndarray, vals: jnp.ndarray, *, num_bins: int
+) -> jnp.ndarray:
+    """Scatter-add variant (useful on CPU; TPU scatters serialize)."""
+    n, f = bins.shape
+    flat_ids = bins.astype(jnp.int32) + jnp.arange(f, dtype=jnp.int32)[None, :] * num_bins
+    hist = jnp.zeros((f * num_bins, 3), dtype=vals.dtype)
+    hist = hist.at[flat_ids].add(vals[:, None, :])
+    return hist.reshape(f, num_bins, 3)
+
+
+def build_histogram(
+    bins: jnp.ndarray,
+    grad: jnp.ndarray,
+    hess: jnp.ndarray,
+    mask: Optional[jnp.ndarray],
+    *,
+    num_bins: int,
+    impl: str = "auto",
+    rows_block: int = 16384,
+) -> jnp.ndarray:
+    """Histogram for the rows selected by ``mask`` (all rows when ``mask=None``)."""
+    vals = pack_values(grad, hess, mask)
+    if impl == "auto":
+        impl = "pallas" if jax.default_backend() == "tpu" else "segment"
+    if impl == "pallas":
+        from .pallas_histogram import histogram_pallas
+        return histogram_pallas(bins, vals, num_bins=num_bins,
+                                rows_block=min(rows_block, 2048))
+    if impl == "onehot":
+        return histogram_onehot(bins, vals, num_bins=num_bins, rows_block=rows_block)
+    if impl == "segment":
+        return histogram_segment(bins, vals, num_bins=num_bins)
+    raise ValueError(f"unknown histogram impl: {impl}")
+
+
+def subtract_histogram(parent: jnp.ndarray, child: jnp.ndarray) -> jnp.ndarray:
+    """Sibling histogram via subtraction (reference ``serial_tree_learner.cpp:369``,
+    ``FeatureHistogram::Subtract``)."""
+    return parent - child

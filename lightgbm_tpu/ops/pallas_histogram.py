@@ -1,0 +1,95 @@
+"""Pallas TPU histogram kernel — the framework's hottest op.
+
+Reference counterpart: the CUDA shared-memory histogram kernels
+(``src/treelearner/cuda/cuda_histogram_constructor.cu:31-66`` — per-block
+shared-mem scatter-add + atomics).  TPUs have no atomics and scatters
+serialize, so the kernel uses a different decomposition:
+
+    hist[c, f*B+b] = sum_n vals[n, c] * (bins[n, f] == b)
+
+i.e. a matmul ``valsᵀ (C × n) @ onehot (n × B)`` per feature, accumulated in
+VMEM across row blocks.  Two properties make this the right TPU shape:
+
+- The channel axis C (grad, hess, count) sits on the MXU's **sublane** side
+  where the padding floor is 8, not on the lane side where it would be 128 —
+  a 16x reduction in wasted MACs vs the naive ``onehotᵀ @ vals`` layout.
+- The one-hot matrix is generated **inside VMEM** from the (blk, F) uint8 bin
+  tile, so HBM traffic is just bins + vals (the XLA einsum fallback
+  materializes the (blk, F, B) one-hot through HBM, ~B× more traffic).
+
+Output layout is (F, C_pad, B); the public wrapper transposes to the (F, B, 3)
+histogram the split scan consumes.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+C_PAD = 8  # f32 sublane tile
+
+
+def _hist_kernel(bins_ref, vals_ref, out_ref, *, num_bins: int,
+                 num_features: int):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    bins_blk = bins_ref[:].astype(jnp.int32)        # (blk, F)
+    vals_blk = vals_ref[:]                          # (blk, C_PAD) f32
+    blk = bins_blk.shape[0]
+    iota_b = jax.lax.broadcasted_iota(jnp.int32, (blk, num_bins), 1)
+    for f in range(num_features):
+        onehot = (bins_blk[:, f][:, None] == iota_b).astype(jnp.float32)
+        # (C_PAD, blk) @ (blk, B) on the MXU, f32 accumulation.
+        partial = jax.lax.dot_general(
+            vals_blk, onehot,
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                           # (C_PAD, B)
+        out_ref[f, :, :] += partial
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("num_bins", "rows_block", "interpret"))
+def histogram_pallas(
+    bins: jnp.ndarray,   # (N, F) uint8/uint16
+    vals: jnp.ndarray,   # (N, 3) f32 masked (grad, hess, count)
+    *,
+    num_bins: int,
+    rows_block: int = 2048,
+    interpret: bool = False,
+) -> jnp.ndarray:        # (F, num_bins, 3) f32
+    n, f = bins.shape
+    pad = (-n) % rows_block
+    if pad:
+        bins = jnp.pad(bins, ((0, pad), (0, 0)))
+        vals = jnp.pad(vals, ((0, pad), (0, 0)))
+    ntot = n + pad
+    vals8 = jnp.pad(vals.astype(jnp.float32), ((0, 0), (0, C_PAD - 3)))
+    nblocks = ntot // rows_block
+
+    out = pl.pallas_call(
+        functools.partial(_hist_kernel, num_bins=num_bins, num_features=f),
+        grid=(nblocks,),
+        in_specs=[
+            pl.BlockSpec((rows_block, f), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((rows_block, C_PAD), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((f, C_PAD, num_bins), lambda i: (0, 0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((f, C_PAD, num_bins), jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),
+        ),
+        interpret=interpret,
+    )(bins, vals8)
+    return jnp.transpose(out[:, :3, :], (0, 2, 1))  # (F, B, 3)

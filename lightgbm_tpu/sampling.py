@@ -1,0 +1,131 @@
+"""Row sampling strategies: bagging and GOSS.
+
+Reference: ``SampleStrategy`` factory (``include/LightGBM/sample_strategy.h:23``,
+``src/boosting/sample_strategy.cpp:14``) with ``BaggingSampleStrategy``
+(``bagging.hpp``) and ``GOSSStrategy`` (``goss.hpp``).
+
+TPU re-design: the reference materializes index subsets and copies rows
+(``Dataset::CopySubrow``); here sampling is a **multiplicative row mask** so every
+shape stays static under jit — out-of-bag rows contribute zero gradient/hessian
+and zero count to histograms, which is numerically identical.  GOSS's amplification
+``(1-top_rate)/other_rate`` becomes a per-row weight in the same mask.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from .config import Config
+
+
+class SampleStrategy:
+    """Produces the per-iteration row mask (1.0 in-bag, 0.0 out, >1.0 GOSS boost)."""
+
+    def __init__(self, cfg: Config, num_data: int,
+                 label: Optional[np.ndarray] = None,
+                 query_boundaries: Optional[np.ndarray] = None):
+        self.cfg = cfg
+        self.num_data = num_data
+        self.label = label
+        self.query_boundaries = query_boundaries
+        self.rng = np.random.RandomState(cfg.bagging_seed)
+        self.is_goss = cfg.data_sample_strategy == "goss"
+        balanced = (cfg.pos_bagging_fraction < 1.0
+                    or cfg.neg_bagging_fraction < 1.0)
+        self.is_bagging = (not self.is_goss) and (
+            (cfg.bagging_fraction < 1.0 and cfg.bagging_freq > 0) or balanced)
+        self.is_balanced = balanced and not self.is_goss
+        self._cached: Optional[np.ndarray] = None
+
+    def needs_resample(self, iteration: int) -> bool:
+        if self.is_goss:
+            return True
+        if not self.is_bagging:
+            return False
+        freq = max(self.cfg.bagging_freq, 1)
+        return iteration % freq == 0 or self._cached is None
+
+    def mask(self, iteration: int, grad: Optional[np.ndarray] = None,
+             hess: Optional[np.ndarray] = None) -> Optional[np.ndarray]:
+        """Return the (N,) f32 mask for this iteration, or None (all rows)."""
+        if self.is_goss:
+            return self._goss_mask(grad, hess)
+        if not self.is_bagging:
+            return None
+        if self.needs_resample(iteration):
+            self._cached = self._bagging_mask()
+        return self._cached
+
+    def _bagging_mask(self) -> np.ndarray:
+        cfg = self.cfg
+        n = self.num_data
+        mask = np.zeros(n, np.float32)
+        if cfg.bagging_by_query and self.query_boundaries is not None:
+            nq = len(self.query_boundaries) - 1
+            take = self.rng.rand(nq) < cfg.bagging_fraction
+            for qi in np.nonzero(take)[0]:
+                mask[self.query_boundaries[qi]: self.query_boundaries[qi + 1]] = 1.0
+            return mask
+        if self.is_balanced and self.label is not None:
+            pos = self.label > 0
+            r = self.rng.rand(n)
+            mask[(pos) & (r < cfg.pos_bagging_fraction)] = 1.0
+            mask[(~pos) & (r < cfg.neg_bagging_fraction)] = 1.0
+            return mask
+        k = int(n * cfg.bagging_fraction)
+        idx = self.rng.choice(n, size=k, replace=False)
+        mask[idx] = 1.0
+        return mask
+
+    def _goss_mask(self, grad: np.ndarray, hess: np.ndarray) -> np.ndarray:
+        """GOSS (reference ``goss.hpp:30-60``): keep the top ``top_rate`` fraction
+        by |grad*hess|, sample ``other_rate`` of the rest and up-weight them."""
+        cfg = self.cfg
+        n = self.num_data
+        score = np.abs(grad * hess)
+        top_k = max(int(n * cfg.top_rate), 1)
+        other_k = max(int(n * cfg.other_rate), 1)
+        order = np.argsort(-score, kind="stable")
+        mask = np.zeros(n, np.float32)
+        mask[order[:top_k]] = 1.0
+        rest = order[top_k:]
+        if len(rest) > 0 and other_k > 0:
+            pick = self.rng.choice(len(rest), size=min(other_k, len(rest)),
+                                   replace=False)
+            mask[rest[pick]] = (1.0 - cfg.top_rate) / cfg.other_rate
+        return mask
+
+
+class FeatureSampler:
+    """``feature_fraction`` per tree + interaction constraints
+    (reference ``ColSampler``, ``col_sampler.hpp``)."""
+
+    def __init__(self, cfg: Config, num_features: int):
+        self.cfg = cfg
+        self.num_features = num_features
+        self.rng = np.random.RandomState(cfg.feature_fraction_seed)
+        self.used = np.ones(num_features, bool)
+        if cfg.interaction_constraints:
+            # Restrict to features present in any constraint group.
+            allowed = set()
+            for grp in cfg.interaction_constraints:
+                for tok in str(grp).strip("[] ").split(","):
+                    if tok.strip():
+                        allowed.add(int(tok))
+            if allowed:
+                self.used = np.zeros(num_features, bool)
+                self.used[sorted(allowed)] = True
+
+    def tree_mask(self, iteration: int) -> np.ndarray:
+        frac = self.cfg.feature_fraction
+        base = self.used.copy()
+        if frac >= 1.0:
+            return base
+        valid = np.nonzero(base)[0]
+        k = max(int(np.ceil(len(valid) * frac)), 1)
+        pick = self.rng.choice(valid, size=k, replace=False)
+        mask = np.zeros(self.num_features, bool)
+        mask[pick] = True
+        return mask

@@ -1,0 +1,338 @@
+"""Model text serialization, following the reference's model-file layout.
+
+Reference: ``src/boosting/gbdt_model_text.cpp`` (``SaveModelToString:334``,
+``LoadModelFromString:439``) and ``Tree::ToString`` (``src/io/tree.cpp``).
+The format mirrors the reference's section structure (header key=value lines,
+``Tree=i`` blocks with array lines, ``end of trees``, feature importances,
+parameters) and its ``decision_type`` bit layout (bit0 categorical, bit1
+default-left, bits 2-3 missing type), so tooling written against the reference's
+format has a familiar shape.  One extension: an ``init_scores=`` header line
+(the reference folds boost-from-average into tree outputs; we keep it explicit).
+
+Loaded models carry real-valued thresholds and categorical *value* sets, so
+prediction runs on raw features without bin mappers (reference ``Tree::Predict``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .config import Config
+
+_CAT_MASK = 1
+_DEFAULT_LEFT_MASK = 2
+
+
+def _fmt_arr(arr, fmt="%.17g") -> str:
+    return " ".join(fmt % v for v in np.asarray(arr).ravel())
+
+
+def _tree_to_string(tree, index: int, mappers) -> str:
+    """Serialize one tree (reference ``Tree::ToString``)."""
+    m = tree.num_splits()
+    lines = [f"Tree={index}", f"num_leaves={tree.num_leaves}"]
+    cat_nodes = np.nonzero(tree.is_cat[:m])[0]
+    lines.append(f"num_cat={len(cat_nodes)}")
+    decision_type = np.zeros(m, np.int64)
+    decision_type[tree.is_cat[:m]] |= _CAT_MASK
+    decision_type[tree.default_left[:m]] |= _DEFAULT_LEFT_MASK
+    for i in range(m):
+        mt = mappers[tree.split_feature[i]].missing_type if mappers else 2
+        decision_type[i] |= (mt & 3) << 2
+    # Categorical thresholds: bitsets over raw category values, concatenated
+    # with per-node boundaries (reference cat_boundaries_/cat_threshold_).
+    cat_boundaries = [0]
+    cat_threshold: List[int] = []
+    threshold = tree.threshold.astype(np.float64).copy()
+    for ci, node in enumerate(cat_nodes):
+        f = int(tree.split_feature[node])
+        bins_left = np.nonzero(tree.cat_mask[node])[0]
+        if mappers is not None and mappers[f].categories is not None:
+            cats = mappers[f].categories
+            vals = [int(cats[b]) for b in bins_left if b < len(cats)]
+        else:
+            vals = [int(b) for b in bins_left]
+        nwords = (max(vals) // 32 + 1) if vals else 1
+        words = [0] * nwords
+        for v in vals:
+            words[v // 32] |= 1 << (v % 32)
+        cat_threshold.extend(words)
+        cat_boundaries.append(len(cat_threshold))
+        threshold[node] = ci  # categorical nodes store the cat-set index
+    lines.append("split_feature=" + _fmt_arr(tree.split_feature[:m], "%d"))
+    lines.append("split_gain=" + _fmt_arr(tree.split_gain[:m], "%g"))
+    lines.append("threshold=" + _fmt_arr(threshold[:m]))
+    lines.append("decision_type=" + _fmt_arr(decision_type, "%d"))
+    lines.append("left_child=" + _fmt_arr(tree.left_child[:m], "%d"))
+    lines.append("right_child=" + _fmt_arr(tree.right_child[:m], "%d"))
+    lines.append("leaf_value=" + _fmt_arr(tree.leaf_value[: tree.num_leaves]))
+    lines.append("leaf_weight="
+                 + _fmt_arr(tree.leaf_weight[: tree.num_leaves], "%g"))
+    lines.append("leaf_count=" + _fmt_arr(
+        tree.leaf_count[: tree.num_leaves].astype(np.int64), "%d"))
+    lines.append("internal_value=" + _fmt_arr(tree.internal_value[:m], "%g"))
+    lines.append("internal_count=" + _fmt_arr(
+        tree.internal_count[:m].astype(np.int64), "%d"))
+    if len(cat_nodes):
+        lines.append("cat_boundaries=" + _fmt_arr(cat_boundaries, "%d"))
+        lines.append("cat_threshold=" + _fmt_arr(cat_threshold, "%d"))
+    lines.append(f"shrinkage={tree.shrinkage:g}")
+    lines.append("")
+    return "\n".join(lines)
+
+
+def model_to_string(gbdt, num_iteration: Optional[int] = None,
+                    start_iteration: int = 0) -> str:
+    cfg = gbdt.cfg
+    td = gbdt.train_data
+    mappers = td.binned.mappers
+    out = ["tree", "version=v4",
+           f"num_class={gbdt.num_class}",
+           f"num_tree_per_iteration={gbdt.num_class}",
+           "label_index=0",
+           f"max_feature_idx={td.num_features - 1}",
+           f"objective={cfg.objective}",
+           "feature_names=" + " ".join(
+               td.feature_names or
+               [f"Column_{i}" for i in range(td.num_features)]),
+           "feature_infos=" + " ".join(_feature_info(m) for m in mappers),
+           "init_scores=" + _fmt_arr(gbdt.init_scores),
+           ""]
+    end = None if num_iteration is None else start_iteration + num_iteration
+    idx = 0
+    # Trees are interleaved per iteration (iter0/class0, iter0/class1, ...)
+    # matching the reference's model layout and LoadedModel.predict_raw.
+    n_iters = min(len(m) for m in gbdt.models) if gbdt.models else 0
+    iters = range(start_iteration, n_iters if end is None else min(end, n_iters))
+    for t in iters:
+        for k in range(gbdt.num_class):
+            out.append(_tree_to_string(gbdt.models[k][t], idx, mappers))
+            idx += 1
+    out.append("end of trees")
+    out.append("")
+    imp = gbdt.feature_importance("split")
+    names = td.feature_names or [f"Column_{i}" for i in range(td.num_features)]
+    pairs = sorted(zip(imp, names), reverse=True)
+    out.append("feature_importances:")
+    out.extend(f"{n}={int(v)}" for v, n in pairs if v > 0)
+    out.append("")
+    out.append("parameters:")
+    for key, val in sorted(cfg.raw_params.items()):
+        out.append(f"[{key}: {val}]")
+    out.append("end of parameters")
+    return "\n".join(out)
+
+
+def _feature_info(m) -> str:
+    if m.is_categorical:
+        return ":".join(str(int(c)) for c in (m.categories if m.categories is not
+                                              None else [])) or "none"
+    if m.is_trivial or m.upper_bounds is None or len(m.upper_bounds) <= 1:
+        return "none"
+    return f"[{m.upper_bounds[0]:g}:{m.upper_bounds[-2]:g}]"
+
+
+# ------------------------------------------------------------------------- load
+@dataclasses.dataclass
+class LoadedTree:
+    """Raw-threshold tree reconstructed from a model string."""
+
+    num_leaves: int
+    split_feature: np.ndarray
+    threshold: np.ndarray
+    decision_type: np.ndarray
+    left_child: np.ndarray
+    right_child: np.ndarray
+    leaf_value: np.ndarray
+    split_gain: np.ndarray
+    cat_boundaries: Optional[np.ndarray] = None
+    cat_threshold: Optional[np.ndarray] = None
+    shrinkage: float = 1.0
+
+    def predict(self, X: np.ndarray) -> np.ndarray:
+        """Vectorized raw-value traversal (reference ``Tree::Predict``)."""
+        n = X.shape[0]
+        out = np.empty(n, np.float64)
+        if self.num_leaves <= 1:
+            out[:] = self.leaf_value[0] if len(self.leaf_value) else 0.0
+            return out
+        node = np.zeros(n, np.int32)
+        active = np.ones(n, bool)
+        is_cat = (self.decision_type & _CAT_MASK) > 0
+        dleft = (self.decision_type & _DEFAULT_LEFT_MASK) > 0
+        missing_type = (self.decision_type >> 2) & 3
+        while active.any():
+            idx = np.nonzero(active)[0]
+            nd = node[idx]
+            f = self.split_feature[nd]
+            v = X[idx, f]
+            mt = missing_type[nd]
+            nan = np.isnan(v)
+            # Missing semantics must match the bin-space path
+            # (binning.value_to_bin): MissingType None -> NaN maps to the
+            # left-most bin (always left); Zero -> |v|<=kZeroThreshold and NaN
+            # follow the default direction; NaN -> NaN follows default.
+            missing = np.where(mt == 1, nan | (np.abs(v) <= 1e-35), nan)
+            gl = np.zeros(len(idx), bool)
+            num = ~is_cat[nd]
+            gl[num] = v[num] <= self.threshold[nd[num]]
+            catm = is_cat[nd]
+            if catm.any():
+                gl[catm] = self._cat_left(nd[catm], v[catm])
+            default_dir = np.where(mt == 0, True, dleft[nd])
+            gl = np.where(missing & ~is_cat[nd], default_dir, gl)
+            nxt = np.where(gl, self.left_child[nd], self.right_child[nd])
+            leaf = nxt < 0
+            out[idx[leaf]] = self.leaf_value[~nxt[leaf]]
+            node[idx[~leaf]] = nxt[~leaf]
+            active[idx[leaf]] = False
+        return out
+
+    def _cat_left(self, nodes: np.ndarray, values: np.ndarray) -> np.ndarray:
+        res = np.zeros(len(nodes), bool)
+        for i, (nd, v) in enumerate(zip(nodes, values)):
+            if not np.isfinite(v) or v < 0:
+                continue
+            ci = int(self.threshold[nd])
+            lo = self.cat_boundaries[ci]
+            hi = self.cat_boundaries[ci + 1]
+            iv = int(v)
+            word = iv // 32
+            if lo + word < hi:
+                res[i] = bool((self.cat_threshold[lo + word] >> (iv % 32)) & 1)
+        return res
+
+
+class LoadedModel:
+    """Prediction-only booster from a model string (reference ``GBDT::
+    LoadModelFromString`` + ``Predictor``)."""
+
+    def __init__(self, num_class: int, objective: str, trees: List[LoadedTree],
+                 init_scores: np.ndarray, feature_names: List[str],
+                 params: Dict[str, str]):
+        self.num_class = num_class
+        self.objective_name = objective
+        self.trees = trees
+        self.init_scores = init_scores
+        self.feature_names = feature_names
+        self.params = params
+        self.cfg = Config({"objective": objective.split(" ")[0],
+                           "num_class": num_class} if num_class > 1 else
+                          {"objective": objective.split(" ")[0]})
+        from .objectives import create_objective
+        self.objective = create_objective(self.cfg) \
+            if self.cfg.objective != "custom" else None
+
+    @property
+    def iter_(self) -> int:
+        return len(self.trees) // self.num_class
+
+    @property
+    def num_trees(self) -> int:
+        return len(self.trees)
+
+    def predict_raw(self, X: np.ndarray, num_iteration: Optional[int] = None,
+                    start_iteration: int = 0) -> np.ndarray:
+        X = np.asarray(X, np.float64)
+        n = X.shape[0]
+        k = self.num_class
+        out = np.tile(self.init_scores[None, :], (n, 1))
+        per_class = [self.trees[i::k] if k > 1 else self.trees
+                     for i in range(k)]
+        for kk in range(k):
+            trees = per_class[kk]
+            end = len(trees) if num_iteration is None else min(
+                len(trees), start_iteration + num_iteration)
+            for tree in trees[start_iteration:end]:
+                out[:, kk] += tree.predict(X)
+        return out[:, 0] if k == 1 else out
+
+    def predict(self, X, raw_score: bool = False, num_iteration=None,
+                start_iteration: int = 0):
+        raw = self.predict_raw(X, num_iteration, start_iteration)
+        if raw_score or self.objective is None:
+            return raw
+        import jax
+        import jax.numpy as jnp
+        self.objective.cfg = self.cfg
+        return np.asarray(jax.device_get(
+            self.objective.convert_output(jnp.asarray(raw))))
+
+    def feature_importance(self, importance_type: str = "split") -> np.ndarray:
+        nf = len(self.feature_names)
+        imp = np.zeros(nf, np.float64)
+        for t in self.trees:
+            if importance_type == "split":
+                np.add.at(imp, t.split_feature, 1.0)
+            else:
+                np.add.at(imp, t.split_feature, t.split_gain)
+        return imp
+
+
+def load_model_string(s: str) -> LoadedModel:
+    lines = s.splitlines()
+    header: Dict[str, str] = {}
+    i = 0
+    while i < len(lines) and not lines[i].startswith("Tree="):
+        line = lines[i].strip()
+        if "=" in line:
+            key, _, val = line.partition("=")
+            header[key] = val
+        i += 1
+    num_class = int(header.get("num_class", 1))
+    init_scores = np.array(
+        [float(v) for v in header.get("init_scores", "0").split()])
+    if len(init_scores) < num_class:
+        init_scores = np.zeros(num_class)
+    trees: List[LoadedTree] = []
+    while i < len(lines):
+        if not lines[i].startswith("Tree="):
+            if lines[i].startswith("end of trees"):
+                break
+            i += 1
+            continue
+        block: Dict[str, str] = {}
+        i += 1
+        while i < len(lines) and lines[i].strip() and \
+                not lines[i].startswith("Tree=") and \
+                not lines[i].startswith("end of trees"):
+            key, _, val = lines[i].partition("=")
+            block[key] = val
+            i += 1
+        nl = int(block["num_leaves"])
+        geti = lambda k, d=None: (np.array([int(float(x)) for x in
+                                  block[k].split()], np.int32)
+                                  if k in block else d)
+        getf = lambda k, d=None: (np.array([float(x) for x in block[k].split()])
+                                  if k in block else d)
+        m = max(nl - 1, 0)
+        trees.append(LoadedTree(
+            num_leaves=nl,
+            split_feature=geti("split_feature", np.zeros(m, np.int32)),
+            threshold=getf("threshold", np.zeros(m)),
+            decision_type=geti("decision_type", np.zeros(m, np.int32)),
+            left_child=geti("left_child", np.zeros(m, np.int32)),
+            right_child=geti("right_child", np.zeros(m, np.int32)),
+            leaf_value=getf("leaf_value", np.zeros(max(nl, 1))),
+            split_gain=getf("split_gain", np.zeros(m)),
+            cat_boundaries=geti("cat_boundaries"),
+            cat_threshold=geti("cat_threshold"),
+            shrinkage=float(block.get("shrinkage", 1.0)),
+        ))
+    params: Dict[str, str] = {}
+    for line in lines[i:]:
+        line = line.strip()
+        if line.startswith("[") and ":" in line:
+            key, _, val = line[1:-1].partition(": ")
+            params[key] = val
+    return LoadedModel(
+        num_class=num_class,
+        objective=header.get("objective", "regression"),
+        trees=trees,
+        init_scores=init_scores,
+        feature_names=header.get("feature_names", "").split(),
+        params=params,
+    )

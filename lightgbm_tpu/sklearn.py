@@ -1,0 +1,256 @@
+"""scikit-learn compatible estimator wrappers.
+
+Reference: ``python-package/lightgbm/sklearn.py`` (``LGBMModel:486`` +
+Classifier/Regressor/Ranker subclasses) — same constructor surface and
+fit/predict semantics over the :mod:`engine` layer.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import numpy as np
+
+from . import callback as callback_mod
+from .basic import Booster, Dataset
+from .engine import train
+
+
+class LGBMModel:
+    def __init__(
+        self,
+        boosting_type: str = "gbdt",
+        num_leaves: int = 31,
+        max_depth: int = -1,
+        learning_rate: float = 0.1,
+        n_estimators: int = 100,
+        subsample_for_bin: int = 200000,
+        objective: Optional[str] = None,
+        class_weight=None,
+        min_split_gain: float = 0.0,
+        min_child_weight: float = 1e-3,
+        min_child_samples: int = 20,
+        subsample: float = 1.0,
+        subsample_freq: int = 0,
+        colsample_bytree: float = 1.0,
+        reg_alpha: float = 0.0,
+        reg_lambda: float = 0.0,
+        random_state: Optional[int] = None,
+        n_jobs: Optional[int] = None,
+        importance_type: str = "split",
+        **kwargs: Any,
+    ):
+        self.boosting_type = boosting_type
+        self.num_leaves = num_leaves
+        self.max_depth = max_depth
+        self.learning_rate = learning_rate
+        self.n_estimators = n_estimators
+        self.subsample_for_bin = subsample_for_bin
+        self.objective = objective
+        self.class_weight = class_weight
+        self.min_split_gain = min_split_gain
+        self.min_child_weight = min_child_weight
+        self.min_child_samples = min_child_samples
+        self.subsample = subsample
+        self.subsample_freq = subsample_freq
+        self.colsample_bytree = colsample_bytree
+        self.reg_alpha = reg_alpha
+        self.reg_lambda = reg_lambda
+        self.random_state = random_state
+        self.n_jobs = n_jobs
+        self.importance_type = importance_type
+        self._other_params = dict(kwargs)
+        self._Booster: Optional[Booster] = None
+        self._n_classes: Optional[int] = None
+        self._classes: Optional[np.ndarray] = None
+
+    # -------------------------------------------------------- sklearn protocol
+    def get_params(self, deep: bool = True) -> Dict[str, Any]:
+        params = {
+            "boosting_type": self.boosting_type,
+            "num_leaves": self.num_leaves,
+            "max_depth": self.max_depth,
+            "learning_rate": self.learning_rate,
+            "n_estimators": self.n_estimators,
+            "subsample_for_bin": self.subsample_for_bin,
+            "objective": self.objective,
+            "class_weight": self.class_weight,
+            "min_split_gain": self.min_split_gain,
+            "min_child_weight": self.min_child_weight,
+            "min_child_samples": self.min_child_samples,
+            "subsample": self.subsample,
+            "subsample_freq": self.subsample_freq,
+            "colsample_bytree": self.colsample_bytree,
+            "reg_alpha": self.reg_alpha,
+            "reg_lambda": self.reg_lambda,
+            "random_state": self.random_state,
+            "n_jobs": self.n_jobs,
+            "importance_type": self.importance_type,
+        }
+        params.update(self._other_params)
+        return params
+
+    def set_params(self, **params: Any) -> "LGBMModel":
+        for key, value in params.items():
+            if hasattr(self, key):
+                setattr(self, key, value)
+            else:
+                self._other_params[key] = value
+        return self
+
+    def _default_objective(self) -> str:
+        return "regression"
+
+    def _lgb_params(self) -> Dict[str, Any]:
+        p = {
+            "boosting": self.boosting_type,
+            "objective": self.objective or self._default_objective(),
+            "num_leaves": self.num_leaves,
+            "max_depth": self.max_depth,
+            "learning_rate": self.learning_rate,
+            "bin_construct_sample_cnt": self.subsample_for_bin,
+            "min_gain_to_split": self.min_split_gain,
+            "min_sum_hessian_in_leaf": self.min_child_weight,
+            "min_data_in_leaf": self.min_child_samples,
+            "bagging_fraction": self.subsample,
+            "bagging_freq": self.subsample_freq,
+            "feature_fraction": self.colsample_bytree,
+            "lambda_l1": self.reg_alpha,
+            "lambda_l2": self.reg_lambda,
+            "verbosity": -1,
+        }
+        if self.random_state is not None:
+            p["seed"] = int(self.random_state)
+        p.update(self._other_params)
+        return p
+
+    def _class_sample_weight(self, y, sample_weight):
+        if self.class_weight is None:
+            return sample_weight
+        from sklearn.utils.class_weight import compute_sample_weight
+        cw = compute_sample_weight(self.class_weight, y)
+        if sample_weight is not None:
+            cw = cw * np.asarray(sample_weight)
+        return cw
+
+    def fit(self, X, y, sample_weight=None, init_score=None, group=None,
+            eval_set=None, eval_names=None, eval_sample_weight=None,
+            eval_group=None, eval_metric=None, feature_name="auto",
+            categorical_feature="auto", callbacks=None,
+            init_model=None) -> "LGBMModel":
+        params = self._lgb_params()
+        if eval_metric is not None:
+            params["metric"] = eval_metric
+        sample_weight = self._class_sample_weight(y, sample_weight)
+        ds = Dataset(X, label=y, weight=sample_weight, group=group,
+                     init_score=init_score, feature_name=feature_name,
+                     categorical_feature=categorical_feature, params=params)
+        valid_sets: List[Dataset] = []
+        valid_names: List[str] = []
+        if eval_set is not None:
+            for i, (ex, ey) in enumerate(eval_set):
+                vw = (eval_sample_weight[i]
+                      if eval_sample_weight is not None else None)
+                vg = eval_group[i] if eval_group is not None else None
+                valid_sets.append(Dataset(ex, label=ey, weight=vw, group=vg,
+                                          reference=ds, params=params))
+                valid_names.append(
+                    eval_names[i] if eval_names else f"valid_{i}")
+        self._Booster = train(params, ds,
+                              num_boost_round=self.n_estimators,
+                              valid_sets=valid_sets, valid_names=valid_names,
+                              callbacks=callbacks)
+        self.fitted_ = True
+        return self
+
+    def predict(self, X, raw_score=False, start_iteration=0,
+                num_iteration=None, **kwargs):
+        if self._Booster is None:
+            raise ValueError("Model not fitted")
+        return self._Booster.predict(X, raw_score=raw_score,
+                                     start_iteration=start_iteration,
+                                     num_iteration=num_iteration, **kwargs)
+
+    @property
+    def booster_(self) -> Booster:
+        if self._Booster is None:
+            raise ValueError("Model not fitted")
+        return self._Booster
+
+    @property
+    def feature_importances_(self) -> np.ndarray:
+        return self.booster_.feature_importance(self.importance_type)
+
+    @property
+    def best_iteration_(self) -> int:
+        return self.booster_.best_iteration
+
+    @property
+    def n_features_(self) -> int:
+        return self.booster_.num_feature()
+
+    @property
+    def n_features_in_(self) -> int:
+        return self.n_features_
+
+
+class LGBMRegressor(LGBMModel):
+    def _default_objective(self) -> str:
+        return "regression"
+
+
+class LGBMClassifier(LGBMModel):
+    def _default_objective(self) -> str:
+        return "binary" if (self._n_classes or 2) <= 2 else "multiclass"
+
+    def fit(self, X, y, **kwargs):
+        y = np.asarray(y)
+        self._classes = np.unique(y)
+        self._n_classes = len(self._classes)
+        y_enc = np.searchsorted(self._classes, y)
+        if self._n_classes > 2:
+            self._other_params.setdefault("num_class", self._n_classes)
+            if self.objective is None:
+                self.objective = "multiclass"
+        if "eval_set" in kwargs and kwargs["eval_set"] is not None:
+            kwargs["eval_set"] = [
+                (ex, np.searchsorted(self._classes, np.asarray(ey)))
+                for ex, ey in kwargs["eval_set"]]
+        return super().fit(X, y_enc, **kwargs)
+
+    @property
+    def classes_(self):
+        return self._classes
+
+    @property
+    def n_classes_(self):
+        return self._n_classes
+
+    def predict_proba(self, X, raw_score=False, start_iteration=0,
+                      num_iteration=None, **kwargs):
+        result = super().predict(X, raw_score=raw_score,
+                                 start_iteration=start_iteration,
+                                 num_iteration=num_iteration, **kwargs)
+        if raw_score or result.ndim == 2:
+            return result
+        return np.column_stack([1.0 - result, result])
+
+    def predict(self, X, raw_score=False, start_iteration=0,
+                num_iteration=None, **kwargs):
+        if raw_score or kwargs.get("pred_leaf") or kwargs.get("pred_contrib"):
+            return super().predict(X, raw_score=raw_score,
+                                   start_iteration=start_iteration,
+                                   num_iteration=num_iteration, **kwargs)
+        proba = self.predict_proba(X, start_iteration=start_iteration,
+                                   num_iteration=num_iteration)
+        return self._classes[np.argmax(proba, axis=1)]
+
+
+class LGBMRanker(LGBMModel):
+    def _default_objective(self) -> str:
+        return "lambdarank"
+
+    def fit(self, X, y, group=None, **kwargs):
+        if group is None:
+            raise ValueError("LGBMRanker.fit requires group")
+        return super().fit(X, y, group=group, **kwargs)

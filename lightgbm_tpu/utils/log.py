@@ -1,0 +1,59 @@
+"""Leveled logging with a redirectable callback.
+
+Reference: ``include/LightGBM/utils/log.h:88`` — Fatal/Warning/Info/Debug levels,
+``Log::ResetCallBack`` used by the Python/R bindings to reroute output
+(``LGBM_RegisterLogCallback``, ``c_api.h:73``).
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Callable, Optional
+
+FATAL, WARNING, INFO, DEBUG = -1, 0, 1, 2
+
+
+class Log:
+    level: int = INFO
+    _callback: Optional[Callable[[str], None]] = None
+
+    @classmethod
+    def reset_callback(cls, callback: Optional[Callable[[str], None]]) -> None:
+        cls._callback = callback
+
+    @classmethod
+    def set_level(cls, level: int) -> None:
+        cls.level = level
+
+    @classmethod
+    def _write(cls, level_str: str, msg: str) -> None:
+        text = f"[LightGBM-TPU] [{level_str}] {msg}\n"
+        if cls._callback is not None:
+            cls._callback(text)
+        else:
+            sys.stderr.write(text)
+
+    @classmethod
+    def debug(cls, msg: str) -> None:
+        if cls.level >= DEBUG:
+            cls._write("Debug", msg)
+
+    @classmethod
+    def info(cls, msg: str) -> None:
+        if cls.level >= INFO:
+            cls._write("Info", msg)
+
+    @classmethod
+    def warning(cls, msg: str) -> None:
+        if cls.level >= WARNING:
+            cls._write("Warning", msg)
+
+    @classmethod
+    def fatal(cls, msg: str) -> None:
+        cls._write("Fatal", msg)
+        raise RuntimeError(msg)
+
+
+def register_log_callback(callback: Optional[Callable[[str], None]]) -> None:
+    """reference ``LGBM_RegisterLogCallback`` (``c_api.h:73``)."""
+    Log.reset_callback(callback)

@@ -1,0 +1,72 @@
+"""Hierarchical wall-clock timers + device-trace integration.
+
+Reference: ``Common::Timer``/``FunctionTimer`` RAII spans aggregated per name and
+printed at exit under ``USE_TIMETAG`` (``utils/common.h:973-1057``; global
+instance ``src/boosting/gbdt.cpp:22``).
+
+TPU addition: named spans also open ``jax.profiler.TraceAnnotation`` regions so
+the same span set shows up in TPU profiler traces (the reference's hand
+instrumentation of hot paths, e.g. ``serial_tree_learner.cpp:180``)."""
+
+from __future__ import annotations
+
+import atexit
+import collections
+import os
+import time
+from typing import Dict, Optional
+
+
+class Timer:
+    def __init__(self):
+        self.durations: Dict[str, float] = collections.defaultdict(float)
+        self.counts: Dict[str, int] = collections.defaultdict(int)
+        self._starts: Dict[str, float] = {}
+
+    def start(self, name: str) -> None:
+        self._starts[name] = time.perf_counter()
+
+    def stop(self, name: str) -> None:
+        if name in self._starts:
+            self.durations[name] += time.perf_counter() - self._starts.pop(name)
+            self.counts[name] += 1
+
+    def summary(self) -> str:
+        lines = ["LightGBM-TPU timer summary:"]
+        for name in sorted(self.durations, key=lambda n: -self.durations[n]):
+            lines.append(f"  {name}: {self.durations[name]:.3f}s "
+                         f"(x{self.counts[name]})")
+        return "\n".join(lines)
+
+    def print_at_exit(self) -> None:
+        atexit.register(lambda: print(self.summary()))
+
+
+global_timer = Timer()
+if os.environ.get("LGBM_TPU_TIMETAG"):
+    global_timer.print_at_exit()
+
+
+class FunctionTimer:
+    """Context-manager span: host timer + device trace annotation."""
+
+    def __init__(self, name: str, timer: Optional[Timer] = None):
+        self.name = name
+        self.timer = timer or global_timer
+        self._trace = None
+
+    def __enter__(self):
+        self.timer.start(self.name)
+        try:
+            import jax.profiler
+            self._trace = jax.profiler.TraceAnnotation(self.name)
+            self._trace.__enter__()
+        except Exception:
+            self._trace = None
+        return self
+
+    def __exit__(self, *exc):
+        if self._trace is not None:
+            self._trace.__exit__(*exc)
+        self.timer.stop(self.name)
+        return False

@@ -1,0 +1,25 @@
+"""Test harness config: hermetic CPU backend with 8 virtual devices so sharding
+tests run without TPU hardware (mirrors the reference's localhost mock-cluster
+pattern, tests/distributed/_test_distributed.py)."""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# The environment's PJRT plugin boot (sitecustomize) may force
+# jax_platforms to the accelerator; tests are hermetic on CPU.
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(42)

@@ -1,0 +1,82 @@
+"""BinMapper / BinnedData unit tests (reference behavior: bin.cpp FindBin)."""
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.binning import (MISSING_NAN, MISSING_NONE, MISSING_ZERO,
+                                  BinnedData, bin_dataset, find_bin)
+
+
+def test_few_distinct_values_get_own_bins():
+    v = np.array([1.0, 1.0, 2.0, 2.0, 3.0] * 10)
+    m = find_bin(v, max_bin=255, min_data_in_bin=1)
+    assert m.num_bins == 3
+    assert m.missing_type == MISSING_NONE
+    # upper bounds are inclusive midpoints: 1.5 -> bin 0, 2.5 -> bin 1
+    bins = m.value_to_bin(np.array([0.5, 1.0, 1.6, 2.0, 2.6, 3.0, 99.0]))
+    assert list(bins) == [0, 0, 1, 1, 2, 2, 2]
+
+
+def test_greedy_equal_count_binning(rng):
+    v = rng.randn(10000)
+    m = find_bin(v, max_bin=64, min_data_in_bin=3)
+    assert 2 <= m.num_bins <= 64
+    bins = m.value_to_bin(v)
+    counts = np.bincount(bins, minlength=m.num_bins)
+    # Roughly equal-count: no bin more than 5x the mean.
+    assert counts.max() < 5 * counts.mean()
+
+
+def test_nan_goes_to_last_bin(rng):
+    v = rng.randn(1000)
+    v[::7] = np.nan
+    m = find_bin(v, max_bin=32)
+    assert m.missing_type == MISSING_NAN
+    assert m.nan_bin == m.num_bins - 1
+    bins = m.value_to_bin(np.array([np.nan, 0.0]))
+    assert bins[0] == m.nan_bin
+    assert bins[1] != m.nan_bin
+
+
+def test_zero_as_missing(rng):
+    v = rng.randn(1000)
+    v[::5] = 0.0
+    m = find_bin(v, max_bin=32, zero_as_missing=True)
+    assert m.missing_type == MISSING_ZERO
+    bins = m.value_to_bin(np.array([0.0, 1e-40, np.nan]))
+    assert (bins == m.nan_bin).all()
+
+
+def test_monotone_bin_boundaries(rng):
+    v = rng.exponential(size=5000)
+    m = find_bin(v, max_bin=100)
+    ub = m.upper_bounds
+    assert (np.diff(ub[:-1]) > 0).all()
+    assert ub[-1] == np.inf
+    # value_to_bin is monotone in value
+    q = np.sort(rng.exponential(size=100))
+    assert (np.diff(m.value_to_bin(q)) >= 0).all()
+
+
+def test_categorical_mapping():
+    v = np.array([3.0] * 50 + [7.0] * 30 + [1.0] * 20 + [9.0] * 2)
+    m = find_bin(v, max_bin=255, is_categorical=True)
+    assert m.is_categorical
+    # ordered by frequency: 3 -> bin0, 7 -> bin1, 1 -> bin2, 9 -> bin3
+    bins = m.value_to_bin(np.array([3, 7, 1, 9, 12345]))
+    assert bins[0] == 0 and bins[1] == 1 and bins[2] == 2
+    assert bins[4] == m.num_bins - 1  # unseen -> last ("other") bin
+
+
+def test_binned_data_apply_matches_train(rng):
+    X = rng.randn(500, 5)
+    bd = bin_dataset(X, max_bin=32)
+    reb = bd.apply(X)
+    assert (reb == bd.bins).all()
+
+
+def test_bin_dataset_respects_max_bin(rng):
+    X = rng.randn(2000, 3)
+    bd = bin_dataset(X, max_bin=16)
+    assert bd.max_num_bins <= 16
+    assert (bd.num_bins_per_feature <= 16).all()

@@ -1,0 +1,98 @@
+"""Metric tests against sklearn / closed-form oracles."""
+
+import numpy as np
+import pytest
+from sklearn import metrics as skm
+
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.metrics import create_metric
+
+
+def _metric(name, params=None):
+    return create_metric(name, Config(params or {"objective": "regression"}))[0]
+
+
+def test_l2_rmse_l1(rng):
+    y = rng.randn(100)
+    p = y + 0.1 * rng.randn(100)
+    assert _metric("l2")(y, p) == pytest.approx(np.mean((y - p) ** 2))
+    assert _metric("rmse")(y, p) == pytest.approx(
+        np.sqrt(np.mean((y - p) ** 2)))
+    assert _metric("l1")(y, p) == pytest.approx(np.mean(np.abs(y - p)))
+
+
+def test_auc_matches_sklearn(rng):
+    y = (rng.rand(500) > 0.5).astype(float)
+    s = rng.randn(500) + y
+    assert _metric("auc")(y, s) == pytest.approx(skm.roc_auc_score(y, s),
+                                                 abs=1e-9)
+
+
+def test_auc_with_ties():
+    y = np.array([0, 1, 0, 1, 1, 0])
+    s = np.array([0.5, 0.5, 0.2, 0.8, 0.5, 0.1])
+    assert _metric("auc")(y, s) == pytest.approx(skm.roc_auc_score(y, s))
+
+
+def test_weighted_auc(rng):
+    y = (rng.rand(200) > 0.5).astype(float)
+    s = rng.randn(200) + 0.5 * y
+    w = rng.rand(200) + 0.5
+    assert _metric("auc")(y, s, w) == pytest.approx(
+        skm.roc_auc_score(y, s, sample_weight=w), abs=1e-9)
+
+
+def test_binary_logloss(rng):
+    y = (rng.rand(300) > 0.5).astype(float)
+    raw = rng.randn(300)
+    p = 1 / (1 + np.exp(-raw))
+    assert _metric("binary_logloss")(y, raw) == pytest.approx(
+        skm.log_loss(y, p), rel=1e-6)
+
+
+def test_binary_error():
+    y = np.array([0, 0, 1, 1])
+    raw = np.array([-1.0, 1.0, 1.0, -1.0])
+    assert _metric("binary_error")(y, raw) == pytest.approx(0.5)
+
+
+def test_multi_logloss(rng):
+    n, k = 200, 3
+    y = rng.randint(0, k, n)
+    raw = rng.randn(n, k)
+    e = np.exp(raw - raw.max(1, keepdims=True))
+    p = e / e.sum(1, keepdims=True)
+    m = create_metric("multi_logloss",
+                      Config({"objective": "multiclass", "num_class": 3}))[0]
+    assert m(y, raw) == pytest.approx(
+        skm.log_loss(y, p, labels=list(range(k))), rel=1e-6)
+
+
+def test_ndcg(rng):
+    # two queries with known ordering quality
+    group = np.array([5, 5])
+    y = np.array([3, 2, 1, 0, 0,   0, 1, 2, 3, 0])
+    perfect = np.array([5, 4, 3, 2, 1,   1, 2, 3, 4, 0], dtype=float)
+    cfg = Config({"objective": "lambdarank", "eval_at": [3]})
+    m = create_metric("ndcg", cfg)[0]
+    assert m.name == "ndcg@3"
+    assert m(y, perfect, None, group) == pytest.approx(1.0)
+    worst = -perfect
+    assert m(y, worst, None, group) < 0.6
+
+
+def test_map(rng):
+    group = np.array([4])
+    y = np.array([1, 0, 1, 0])
+    s = np.array([4.0, 3.0, 2.0, 1.0])
+    cfg = Config({"objective": "lambdarank", "eval_at": [4]})
+    m = create_metric("map", cfg)[0]
+    # AP = (1/1 + 2/3) / 2
+    assert m(y, s, None, group) == pytest.approx((1.0 + 2.0 / 3.0) / 2.0)
+
+
+def test_average_precision_matches_sklearn(rng):
+    y = (rng.rand(300) > 0.7).astype(float)
+    s = rng.randn(300) + y
+    assert _metric("average_precision")(y, s) == pytest.approx(
+        skm.average_precision_score(y, s), abs=1e-9)

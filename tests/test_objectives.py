@@ -1,0 +1,132 @@
+"""Objective gradient checks against finite differences of the loss."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.objectives import create_objective
+
+
+def _finite_diff_grad(loss_fn, score, eps=1e-4):
+    g = np.zeros_like(score)
+    for i in range(len(score)):
+        s1, s2 = score.copy(), score.copy()
+        s1[i] += eps
+        s2[i] -= eps
+        g[i] = (loss_fn(s1) - loss_fn(s2)) / (2 * eps)
+    return g
+
+
+def _check(objective_name, label, loss_fn, extra_params=None, n=20, rtol=1e-2):
+    params = {"objective": objective_name}
+    params.update(extra_params or {})
+    cfg = Config(params)
+    obj = create_objective(cfg)
+    obj.init(label, None, None, cfg)
+    rng = np.random.RandomState(0)
+    score = rng.randn(n).astype(np.float64) * 0.5
+    grad, _ = obj.get_gradients(jnp.asarray(score, jnp.float32))
+    fd = _finite_diff_grad(loss_fn, score)
+    np.testing.assert_allclose(np.asarray(grad), fd, rtol=rtol, atol=1e-3)
+
+
+def test_l2_gradient():
+    rng = np.random.RandomState(1)
+    y = rng.randn(20)
+    # reference convention: grad = score - label (0.5*(s-y)^2 loss)
+    _check("regression", y, lambda s: 0.5 * np.sum((s - y) ** 2))
+
+
+def test_binary_gradient():
+    rng = np.random.RandomState(2)
+    y = (rng.rand(20) > 0.5).astype(np.float64)
+
+    def loss(s):
+        p = 1 / (1 + np.exp(-s))
+        return -np.sum(y * np.log(p) + (1 - y) * np.log(1 - p))
+
+    _check("binary", y, loss)
+
+
+def test_poisson_gradient():
+    rng = np.random.RandomState(3)
+    y = rng.poisson(2.0, 20).astype(np.float64)
+    _check("poisson", y, lambda s: np.sum(np.exp(s) - y * s),
+           extra_params={"poisson_max_delta_step": 0.0})
+
+
+def test_gamma_gradient():
+    rng = np.random.RandomState(4)
+    y = rng.gamma(2.0, 1.0, 20) + 0.1
+    _check("gamma", y, lambda s: np.sum(y * np.exp(-s) + s))
+
+
+def test_tweedie_gradient():
+    rng = np.random.RandomState(5)
+    y = rng.gamma(2.0, 1.0, 20)
+    rho = 1.5
+    _check("tweedie", y, lambda s: np.sum(
+        -y * np.exp((1 - rho) * s) / (1 - rho) + np.exp((2 - rho) * s) / (2 - rho)))
+
+
+def test_fair_gradient():
+    rng = np.random.RandomState(6)
+    y = rng.randn(20)
+    c = 1.0
+    _check("fair", y, lambda s: np.sum(
+        c ** 2 * (np.abs(s - y) / c - np.log1p(np.abs(s - y) / c))))
+
+
+def test_quantile_gradient_direction():
+    cfg = Config({"objective": "quantile", "alpha": 0.9})
+    obj = create_objective(cfg)
+    y = np.zeros(4)
+    obj.init(y, None, None, cfg)
+    g, _ = obj.get_gradients(jnp.asarray([1.0, -1.0, 2.0, -2.0]))
+    g = np.asarray(g)
+    assert (g[[0, 2]] > 0).all() and (g[[1, 3]] < 0).all()
+    assert abs(g[0]) == pytest.approx(0.1, rel=1e-5)
+    assert abs(g[1]) == pytest.approx(0.9, rel=1e-5)
+
+
+def test_multiclass_softmax_gradient():
+    rng = np.random.RandomState(7)
+    n, k = 10, 3
+    y = rng.randint(0, k, n)
+    cfg = Config({"objective": "multiclass", "num_class": k})
+    obj = create_objective(cfg)
+    obj.init(y, None, None, cfg)
+    score = rng.randn(n, k)
+    grad, hess = obj.get_gradients(jnp.asarray(score, jnp.float32))
+    # oracle: softmax - onehot
+    e = np.exp(score - score.max(1, keepdims=True))
+    p = e / e.sum(1, keepdims=True)
+    onehot = np.eye(k)[y]
+    np.testing.assert_allclose(np.asarray(grad), p - onehot, rtol=1e-4,
+                               atol=1e-5)
+    assert (np.asarray(hess) > 0).all()
+
+
+def test_boost_from_score():
+    cfg = Config({"objective": "binary"})
+    obj = create_objective(cfg)
+    y = np.array([1, 1, 1, 0])
+    obj.init(y, None, None, cfg)
+    assert obj.boost_from_score() == pytest.approx(np.log(3.0), rel=1e-6)
+
+    cfg = Config({"objective": "regression"})
+    obj = create_objective(cfg)
+    obj.init(np.array([1.0, 2.0, 3.0]), None, None, cfg)
+    assert obj.boost_from_score() == pytest.approx(2.0)
+
+
+def test_weights_scale_gradients():
+    cfg = Config({"objective": "regression"})
+    obj = create_objective(cfg)
+    y = np.array([0.0, 0.0])
+    w = np.array([1.0, 5.0])
+    obj.init(y, w, None, cfg)
+    g, h = obj.get_gradients(jnp.asarray([1.0, 1.0]))
+    assert np.asarray(g)[1] == pytest.approx(5 * np.asarray(g)[0])
+    assert np.asarray(h)[1] == pytest.approx(5 * np.asarray(h)[0])

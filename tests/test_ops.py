@@ -1,0 +1,181 @@
+"""Histogram + split-finding op tests against numpy oracles."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lightgbm_tpu.ops.histogram import (build_histogram, histogram_onehot,
+                                        histogram_segment, pack_values)
+from lightgbm_tpu.ops.split import SplitConfig, best_split
+
+
+def _np_histogram(bins, g, h, mask, B):
+    n, f = bins.shape
+    out = np.zeros((f, B, 3))
+    for j in range(f):
+        for r in range(n):
+            if mask is None or mask[r]:
+                b = bins[r, j]
+                out[j, b, 0] += g[r]
+                out[j, b, 1] += h[r]
+                out[j, b, 2] += 1.0
+    return out
+
+
+@pytest.mark.parametrize("impl", ["onehot", "segment"])
+def test_histogram_matches_oracle(rng, impl):
+    n, f, B = 500, 4, 16
+    bins = rng.randint(0, B, size=(n, f)).astype(np.uint8)
+    g = rng.randn(n).astype(np.float32)
+    h = rng.rand(n).astype(np.float32)
+    mask = (rng.rand(n) > 0.3)
+    hist = build_histogram(jnp.asarray(bins), jnp.asarray(g), jnp.asarray(h),
+                           jnp.asarray(mask), num_bins=B, impl=impl,
+                           rows_block=128)
+    oracle = _np_histogram(bins, g, h, mask, B)
+    np.testing.assert_allclose(np.asarray(hist), oracle, rtol=1e-4, atol=1e-4)
+
+
+def test_histogram_impls_agree(rng):
+    n, f, B = 1000, 6, 64
+    bins = rng.randint(0, B, size=(n, f)).astype(np.uint8)
+    vals = pack_values(jnp.asarray(rng.randn(n), dtype=jnp.float32),
+                       jnp.asarray(rng.rand(n), dtype=jnp.float32), None)
+    h1 = histogram_onehot(jnp.asarray(bins), vals, num_bins=B, rows_block=256)
+    h2 = histogram_segment(jnp.asarray(bins), vals, num_bins=B)
+    np.testing.assert_allclose(np.asarray(h1), np.asarray(h2),
+                               rtol=1e-4, atol=1e-4)
+
+
+def _oracle_best_numerical(hist, pg, ph, pc, nbpf, nan_bin, cfg):
+    """Brute-force split search for one numerical feature."""
+    best = (-np.inf, -1, False)
+    B = hist.shape[0]
+    nv = nbpf - (1 if nan_bin < B else 0)
+    Gn = hist[nan_bin, 0] if nan_bin < B else 0.0
+    Hn = hist[nan_bin, 1] if nan_bin < B else 0.0
+    Cn = hist[nan_bin, 2] if nan_bin < B else 0.0
+
+    def lg(g, h):
+        t = np.sign(g) * max(abs(g) - cfg.lambda_l1, 0)
+        return t * t / (h + cfg.lambda_l2 + 1e-15)
+
+    for t in range(nv):
+        GL = hist[: t + 1, 0].sum()
+        HL = hist[: t + 1, 1].sum()
+        CL = hist[: t + 1, 2].sum()
+        if nan_bin <= t:  # nan bin inside: skip (oracle counts value bins only)
+            GL -= Gn; HL -= Hn; CL -= Cn
+        for dl in ([False, True] if nan_bin < B else [False]):
+            gl, hl, cl = (GL + Gn, HL + Hn, CL + Cn) if dl else (GL, HL, CL)
+            gr, hr, cr = pg - gl, ph - hl, pc - cl
+            if cl < max(cfg.min_data_in_leaf, 1) or cr < max(cfg.min_data_in_leaf, 1):
+                continue
+            if hl < cfg.min_sum_hessian_in_leaf or hr < cfg.min_sum_hessian_in_leaf:
+                continue
+            gain = lg(gl, hl) + lg(gr, hr) - lg(pg, ph)
+            if gain > cfg.min_gain_to_split + 1e-15 and gain > best[0]:
+                best = (gain, t, dl)
+    return best
+
+
+@pytest.mark.parametrize("with_nan", [False, True])
+@pytest.mark.parametrize("l1,l2,mindata", [(0.0, 0.0, 1), (0.5, 1.0, 10)])
+def test_best_split_matches_bruteforce(rng, with_nan, l1, l2, mindata):
+    B, F = 16, 3
+    cfg = SplitConfig(lambda_l1=l1, lambda_l2=l2, min_data_in_leaf=mindata,
+                      min_sum_hessian_in_leaf=1e-3)
+    hist = np.zeros((F, B, 3), np.float32)
+    nbpf = np.array([16, 10, 8], np.int32)
+    nan_bins = (np.array([15, 9, 16], np.int32) if with_nan
+                else np.array([16, 16, 16], np.int32))
+    for f in range(F):
+        nb = nbpf[f]
+        hist[f, :nb, 0] = rng.randn(nb) * 3
+        hist[f, :nb, 1] = rng.rand(nb) + 0.1
+        hist[f, :nb, 2] = rng.randint(1, 30, nb)
+    # totals must agree across features (all features see the same rows):
+    # rescale counts/hessians/grads so each feature sums to the same totals.
+    tot = hist[0, :, :].sum(axis=0)
+    for f in range(1, F):
+        cur = hist[f, :, :].sum(axis=0)
+        hist[f] *= (tot / cur)[None, :]
+    pg, ph, pc = tot
+    bs = best_split(
+        jnp.asarray(hist), jnp.asarray(pg), jnp.asarray(ph), jnp.asarray(pc),
+        num_bins_per_feature=jnp.asarray(nbpf),
+        nan_bins=jnp.asarray(nan_bins),
+        is_categorical=jnp.zeros(F, bool),
+        monotone=jnp.zeros(F, jnp.int32),
+        feature_mask=jnp.ones(F, bool),
+        cfg=cfg,
+    )
+    oracle_best = (-np.inf, -1, -1, False)
+    for f in range(F):
+        g, t, dl = _oracle_best_numerical(
+            hist[f].astype(np.float64), pg, ph, pc, nbpf[f],
+            int(nan_bins[f]) if nan_bins[f] < B else B, cfg)
+        if g > oracle_best[0]:
+            oracle_best = (g, f, t, dl)
+    got_gain = float(bs.gain)
+    if oracle_best[0] == -np.inf:
+        assert got_gain == -np.inf
+    else:
+        assert got_gain == pytest.approx(oracle_best[0], rel=1e-3)
+        assert int(bs.feature) == oracle_best[1]
+
+
+def test_split_respects_feature_mask(rng):
+    B, F = 8, 4
+    cfg = SplitConfig(min_data_in_leaf=1)
+    hist = np.abs(rng.randn(F, B, 3)).astype(np.float32) + 0.1
+    tot = hist[0].sum(axis=0)
+    for f in range(1, F):
+        hist[f] *= (tot / hist[f].sum(axis=0))[None, :]
+    mask = np.array([False, True, False, False])
+    bs = best_split(
+        jnp.asarray(hist), *(jnp.asarray(v) for v in tot),
+        num_bins_per_feature=jnp.full(F, B, jnp.int32),
+        nan_bins=jnp.full(F, B, jnp.int32),
+        is_categorical=jnp.zeros(F, bool),
+        monotone=jnp.zeros(F, jnp.int32),
+        feature_mask=jnp.asarray(mask),
+        cfg=cfg,
+    )
+    if float(bs.gain) > -np.inf:
+        assert int(bs.feature) == 1
+
+
+def test_min_data_in_leaf_blocks_small_splits(rng):
+    B, F = 8, 1
+    hist = np.zeros((F, B, 3), np.float32)
+    hist[0, :, 0] = rng.randn(B)
+    hist[0, :, 1] = 1.0
+    hist[0, :, 2] = 5.0  # 40 rows total, 5 per bin
+    tot = hist[0].sum(axis=0)
+    bs = best_split(
+        jnp.asarray(hist), *(jnp.asarray(v) for v in tot),
+        num_bins_per_feature=jnp.full(F, B, jnp.int32),
+        nan_bins=jnp.full(F, B, jnp.int32),
+        is_categorical=jnp.zeros(F, bool),
+        monotone=jnp.zeros(F, jnp.int32),
+        feature_mask=jnp.ones(F, bool),
+        cfg=SplitConfig(min_data_in_leaf=100),
+    )
+    assert float(bs.gain) == -np.inf
+
+
+def test_pallas_histogram_matches_segment(rng):
+    """Pallas kernel (interpret mode on CPU) vs scatter oracle."""
+    from lightgbm_tpu.ops.pallas_histogram import histogram_pallas
+
+    n, f, B = 700, 5, 32
+    bins = rng.randint(0, B, size=(n, f)).astype(np.uint8)
+    vals = pack_values(jnp.asarray(rng.randn(n), dtype=jnp.float32),
+                       jnp.asarray(rng.rand(n), dtype=jnp.float32),
+                       jnp.asarray(rng.rand(n) > 0.5))
+    got = histogram_pallas(jnp.asarray(bins), vals, num_bins=B,
+                           rows_block=256, interpret=True)
+    ref = histogram_segment(jnp.asarray(bins), vals, num_bins=B)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)

@@ -1,0 +1,60 @@
+"""Learning-to-rank objective tests (reference: rank_objective.hpp)."""
+
+import numpy as np
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.metrics import _ndcg_multi
+
+
+def _make_ranking_data(rng, n_queries=60, docs_per_query=20, n_features=10):
+    n = n_queries * docs_per_query
+    X = rng.randn(n, n_features)
+    relevance_score = X[:, 0] * 2 + X[:, 1] + 0.3 * rng.randn(n)
+    # labels 0..4 by within-query quantile of the relevance score
+    y = np.zeros(n, np.int64)
+    group = np.full(n_queries, docs_per_query)
+    for q in range(n_queries):
+        sl = slice(q * docs_per_query, (q + 1) * docs_per_query)
+        ranks = np.argsort(np.argsort(relevance_score[sl]))
+        y[sl] = np.minimum(4, ranks * 5 // docs_per_query)
+    return X, y, group
+
+
+def _ndcg_at5(y, score, group):
+    gains = np.power(2.0, np.arange(32)) - 1
+    return _ndcg_multi(y, score, group, [5], gains)[0]
+
+
+def test_lambdarank_improves_ndcg(rng):
+    X, y, group = _make_ranking_data(rng)
+    ds = lgb.Dataset(X, label=y, group=group)
+    bst = lgb.train({"objective": "lambdarank", "min_data_in_leaf": 5,
+                     "verbosity": -1, "metric": "none"}, ds, 30)
+    pred = bst.predict(X, raw_score=True)
+    random_ndcg = _ndcg_at5(y, rng.randn(len(y)), group)
+    model_ndcg = _ndcg_at5(y, pred, group)
+    assert model_ndcg > random_ndcg + 0.15
+    assert model_ndcg > 0.75
+
+
+def test_rank_xendcg_improves_ndcg(rng):
+    X, y, group = _make_ranking_data(rng)
+    ds = lgb.Dataset(X, label=y, group=group)
+    bst = lgb.train({"objective": "rank_xendcg", "min_data_in_leaf": 5,
+                     "verbosity": -1, "metric": "none"}, ds, 30)
+    pred = bst.predict(X, raw_score=True)
+    model_ndcg = _ndcg_at5(y, pred, group)
+    assert model_ndcg > 0.72
+
+
+def test_ndcg_metric_reported_during_training(rng):
+    X, y, group = _make_ranking_data(rng, n_queries=40)
+    ds = lgb.Dataset(X, label=y, group=group)
+    va = lgb.Dataset(X, label=y, group=group, reference=ds)
+    ev = {}
+    lgb.train({"objective": "lambdarank", "metric": "ndcg",
+               "eval_at": [1, 5], "min_data_in_leaf": 5, "verbosity": -1},
+              ds, 10, valid_sets=[va], callbacks=[lgb.record_evaluation(ev)])
+    assert "ndcg@1" in ev["valid_0"] and "ndcg@5" in ev["valid_0"]
+    assert ev["valid_0"]["ndcg@5"][-1] > ev["valid_0"]["ndcg@5"][0]

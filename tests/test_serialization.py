@@ -1,0 +1,76 @@
+"""Model text round-trip tests (reference: gbdt_model_text.cpp save/load)."""
+
+import numpy as np
+from sklearn.datasets import make_classification, make_regression
+
+import lightgbm_tpu as lgb
+
+
+def test_roundtrip_regression(tmp_path):
+    X, y = make_regression(n_samples=800, n_features=6, noise=0.1,
+                           random_state=0)
+    bst = lgb.train({"objective": "regression", "min_data_in_leaf": 5,
+                     "verbosity": -1}, lgb.Dataset(X, label=y), 20)
+    path = tmp_path / "model.txt"
+    bst.save_model(str(path))
+    bst2 = lgb.Booster(model_file=str(path))
+    np.testing.assert_allclose(bst.predict(X), bst2.predict(X),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_roundtrip_binary_probabilities():
+    X, y = make_classification(n_samples=800, n_features=10, random_state=1)
+    bst = lgb.train({"objective": "binary", "verbosity": -1},
+                    lgb.Dataset(X, label=y), 15)
+    s = bst.model_to_string()
+    bst2 = lgb.Booster(model_str=s)
+    np.testing.assert_allclose(bst.predict(X), bst2.predict(X),
+                               rtol=1e-4, atol=1e-5)
+    assert bst2.num_trees() == bst.num_trees()
+
+
+def test_roundtrip_multiclass():
+    X, y = make_classification(n_samples=900, n_features=10, n_informative=8,
+                               n_classes=3, random_state=2)
+    bst = lgb.train({"objective": "multiclass", "num_class": 3,
+                     "verbosity": -1}, lgb.Dataset(X, label=y), 10)
+    bst2 = lgb.Booster(model_str=bst.model_to_string())
+    np.testing.assert_allclose(bst.predict(X), bst2.predict(X),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_roundtrip_with_nan_and_categorical():
+    rng = np.random.RandomState(3)
+    n = 1000
+    cat = rng.randint(0, 6, n).astype(float)
+    num = rng.randn(n)
+    num[::11] = np.nan
+    X = np.column_stack([cat, num])
+    y = (np.isin(cat, [1, 4]) | np.isnan(num)).astype(int)
+    bst = lgb.train({"objective": "binary", "min_data_in_leaf": 5,
+                     "verbosity": -1},
+                    lgb.Dataset(X, label=y, categorical_feature=[0]), 15)
+    bst2 = lgb.Booster(model_str=bst.model_to_string())
+    np.testing.assert_allclose(bst.predict(X), bst2.predict(X),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_model_string_sections():
+    X, y = make_regression(n_samples=300, n_features=4, random_state=4)
+    bst = lgb.train({"objective": "regression", "min_data_in_leaf": 5,
+                     "verbosity": -1}, lgb.Dataset(X, label=y), 3)
+    s = bst.model_to_string()
+    assert s.startswith("tree\n")
+    for section in ("num_class=1", "max_feature_idx=3", "Tree=0",
+                    "end of trees", "feature_importances:", "parameters:",
+                    "end of parameters"):
+        assert section in s
+
+
+def test_num_iteration_predict():
+    X, y = make_regression(n_samples=500, n_features=5, random_state=5)
+    bst = lgb.train({"objective": "regression", "min_data_in_leaf": 5,
+                     "verbosity": -1}, lgb.Dataset(X, label=y), 20)
+    p5 = bst.predict(X, num_iteration=5)
+    p20 = bst.predict(X)
+    assert np.abs(p20 - y).mean() < np.abs(p5 - y).mean()

@@ -1,0 +1,88 @@
+"""sklearn wrapper tests (reference: tests/python_package_test/test_sklearn.py)."""
+
+import numpy as np
+from sklearn.datasets import make_classification, make_regression
+from sklearn.model_selection import train_test_split
+
+import lightgbm_tpu as lgb
+
+
+def test_regressor():
+    X, y = make_regression(n_samples=1000, n_features=8, noise=0.1,
+                           random_state=0)
+    model = lgb.LGBMRegressor(n_estimators=30, min_child_samples=5)
+    model.fit(X, y)
+    pred = model.predict(X)
+    assert np.mean((y - pred) ** 2) < 0.1 * y.var()
+    assert model.n_features_ == 8
+    assert len(model.feature_importances_) == 8
+
+
+def test_classifier_binary():
+    X, y = make_classification(n_samples=1200, n_features=10, random_state=1)
+    model = lgb.LGBMClassifier(n_estimators=30)
+    model.fit(X, y)
+    proba = model.predict_proba(X)
+    assert proba.shape == (1200, 2)
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, rtol=1e-6)
+    pred = model.predict(X)
+    assert (pred == y).mean() > 0.9
+    assert set(model.classes_) == {0, 1}
+
+
+def test_classifier_multiclass_string_labels():
+    X, y_int = make_classification(n_samples=1200, n_features=10,
+                                   n_informative=8, n_classes=3,
+                                   random_state=2)
+    labels = np.array(["cat", "dog", "fish"])[y_int]
+    model = lgb.LGBMClassifier(n_estimators=20)
+    model.fit(X, labels)
+    pred = model.predict(X)
+    assert set(pred) <= {"cat", "dog", "fish"}
+    assert (pred == labels).mean() > 0.8
+    assert model.n_classes_ == 3
+
+
+def test_classifier_eval_set_early_stopping():
+    X, y = make_classification(n_samples=2000, n_features=10, random_state=3)
+    Xtr, Xva, ytr, yva = train_test_split(X, y, random_state=0)
+    model = lgb.LGBMClassifier(n_estimators=200, learning_rate=0.3)
+    model.fit(Xtr, ytr, eval_set=[(Xva, yva)],
+              callbacks=[lgb.early_stopping(5, verbose=False)])
+    assert model.best_iteration_ > 0
+
+
+def test_ranker():
+    rng = np.random.RandomState(4)
+    n_q, per_q = 40, 15
+    X = rng.randn(n_q * per_q, 8)
+    y = np.zeros(n_q * per_q, np.int64)
+    for q in range(n_q):
+        sl = slice(q * per_q, (q + 1) * per_q)
+        ranks = np.argsort(np.argsort(X[sl, 0]))
+        y[sl] = np.minimum(4, ranks * 5 // per_q)
+    model = lgb.LGBMRanker(n_estimators=20, min_child_samples=5)
+    model.fit(X, y, group=np.full(n_q, per_q))
+    pred = model.predict(X)
+    corr = np.corrcoef(pred, X[:, 0])[0, 1]
+    assert corr > 0.5
+
+
+def test_get_set_params():
+    model = lgb.LGBMRegressor(num_leaves=63, custom_param=7)
+    params = model.get_params()
+    assert params["num_leaves"] == 63
+    assert params["custom_param"] == 7
+    model.set_params(num_leaves=15)
+    assert model.num_leaves == 15
+
+
+def test_class_weight_balanced():
+    X, y = make_classification(n_samples=1500, n_features=10, weights=[0.9],
+                               random_state=5)
+    model = lgb.LGBMClassifier(n_estimators=20, class_weight="balanced")
+    model.fit(X, y)
+    pred = model.predict(X)
+    # balanced weighting should recover a reasonable recall on the minority
+    minority_recall = (pred[y == 1] == 1).mean()
+    assert minority_recall > 0.6
