@@ -59,12 +59,23 @@ class Dataset:
         if self._train_data is None:
             merged = dict(self.params)
             merged.update(params or {})
+            cat_param = None
+            for key in ("categorical_feature", "cat_feature",
+                        "categorical_column", "cat_column",
+                        "categorical_features"):
+                if key in merged:
+                    cat_param = merged.pop(key)
             cfg = Config(merged)
             cats: Sequence[int] = ()
-            if isinstance(self.categorical_feature, (list, tuple)):
+            cat_spec = (self.categorical_feature
+                        if isinstance(self.categorical_feature, (list, tuple))
+                        else cat_param)
+            if isinstance(cat_spec, str) and cat_spec:
+                cat_spec = cat_spec.split(",")
+            if isinstance(cat_spec, (list, tuple)):
                 names = self._feature_names()
-                cats = [c if isinstance(c, int) else names.index(c)
-                        for c in self.categorical_feature]
+                cats = [int(c) if not isinstance(c, str) or c.lstrip("-").isdigit()
+                        else names.index(c) for c in cat_spec]
             elif cfg.categorical_feature:
                 cats = [int(c) for c in cfg.categorical_feature.split(",")]
             ref_td = (self.reference.construct(params)

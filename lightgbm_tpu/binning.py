@@ -21,6 +21,8 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
+from . import native
+
 MISSING_NONE = 0
 MISSING_ZERO = 1
 MISSING_NAN = 2
@@ -64,9 +66,14 @@ class BinMapper:
             in_range = (vi >= 0) & (vi < lut_size)
             out[in_range] = lut[vi[in_range]]
             return out
+        n_value_bins = self.num_bins - (1 if self.has_nan_bin else 0)
+        nb = native.value_to_bin(
+            v.ravel(), self.upper_bounds, n_value_bins,
+            self.nan_bin, self.missing_type == MISSING_ZERO)
+        if nb is not None:
+            return nb.reshape(v.shape)
         if self.missing_type == MISSING_ZERO:
             v = np.where((v > _KZERO_LO) & (v < _KZERO_HI), np.nan, v)
-        n_value_bins = self.num_bins - (1 if self.has_nan_bin else 0)
         # bin b holds values <= upper_bounds[b]; clip overflow into last value bin.
         bins = np.searchsorted(self.upper_bounds[: n_value_bins - 1], v, side="left")
         bins = bins.astype(np.int32)
@@ -178,10 +185,19 @@ def find_bin(
 
     has_nan_bin = missing_type != MISSING_NONE
     max_value_bins = max_bin - (1 if has_nan_bin else 0)
-    distinct, counts = np.unique(vv, return_counts=True)
-    bounds = _greedy_find_boundaries(
-        distinct, counts, max_value_bins, len(vv), min_data_in_bin
-    )
+    uc = native.unique_counts(vv)
+    if uc is not None:
+        distinct, counts = uc
+    else:
+        distinct, counts = np.unique(vv, return_counts=True)
+    nb = native.find_boundaries(distinct, counts, max_value_bins, len(vv),
+                                min_data_in_bin)
+    if nb is not None:
+        bounds = list(nb)
+    else:
+        bounds = _greedy_find_boundaries(
+            distinct, counts, max_value_bins, len(vv), min_data_in_bin
+        )
     num_bins = len(bounds) + (1 if has_nan_bin else 0)
     trivial = num_bins <= 1 or (len(distinct) <= 1 and not has_nan_bin)
     ub = np.asarray(bounds, dtype=np.float64)

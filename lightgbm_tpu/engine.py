@@ -137,10 +137,13 @@ def cv(
             folds.append((tr_rows, va_rows, group[tr_q], group[va_q]))
         results: Dict[str, List[float]] = {}
         boosters, fold_histories = [], []
+        w = train_set.weight
         for tr_idx, va_idx, tr_g, va_g in folds:
             dtr = Dataset(X[tr_idx], label=np.asarray(y)[tr_idx], group=tr_g,
+                          weight=None if w is None else w[tr_idx],
                           params=params)
             dva = Dataset(X[va_idx], label=np.asarray(y)[va_idx], group=va_g,
+                          weight=None if w is None else w[va_idx],
                           reference=dtr, params=params)
             history: Dict[str, Dict[str, List[float]]] = {}
             cbs = list(callbacks or []) + [callback_mod.record_evaluation(history)]

@@ -19,7 +19,7 @@ def _sniff_format(lines) -> str:
     for line in lines:
         if not line.strip():
             continue
-        tokens = line.split("\t") if "\t" in line else line.split(",")
+        tokens = line.replace("\t", " ").replace(",", " ").split()
         for tok in tokens[1:3]:
             if ":" in tok:
                 return "libsvm"
@@ -62,6 +62,15 @@ def load_data_file(
 ) -> Tuple[np.ndarray, np.ndarray, Optional[np.ndarray], Optional[np.ndarray]]:
     """Returns (X, y, weight, group).  Weight/group come from ``<path>.weight``
     and ``<path>.query`` side files when present (reference metadata.cpp)."""
+    from .. import native
+
+    if native.available():
+        res = native.parse_file(path, header=header,
+                                label_column=label_column,
+                                num_features=num_features or 0)
+        if res is not None:
+            X, y = res
+            return (X, y) + _side_files(path)
     with open(path) as fh:
         lines = fh.read().splitlines()
     start = 1 if header else 0
@@ -84,12 +93,16 @@ def load_data_file(
                 label_idx = 0
         y = data[:, label_idx]
         X = np.delete(data, label_idx, axis=1)
+    return (X, y) + _side_files(path)
+
+
+def _side_files(path: str):
     weight = group = None
     if os.path.exists(path + ".weight"):
         weight = np.loadtxt(path + ".weight")
     if os.path.exists(path + ".query"):
         group = np.loadtxt(path + ".query").astype(np.int64)
-    return X, y, weight, group
+    return weight, group
 
 
 def _atof(tok: str) -> float:

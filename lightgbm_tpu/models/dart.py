@@ -59,10 +59,13 @@ class DART(GBDT):
                     n_trees, size=min(k_drop, n_trees), replace=False))
             if cfg.max_drop > 0:
                 drop_idx = drop_idx[: cfg.max_drop]
-        # Remove dropped trees' contribution before computing gradients.
+        # Remove dropped trees' contribution before computing gradients; keep
+        # the predictions — re-adding at the reduced scale reuses them.
+        drop_preds: dict = {}
         for k in range(self.num_class):
             for idx in drop_idx:
                 pred = self._tree_pred(k, self.models[k][idx], self.bins_dev)
+                drop_preds[(k, idx)] = pred
                 if self._shape_k:
                     self.scores = self.scores.at[:, k].add(-pred)
                 else:
@@ -80,7 +83,7 @@ class DART(GBDT):
                 for idx in drop_idx:
                     tree = self.models[k][idx]
                     # Tree was fully removed above; re-add at the reduced scale.
-                    pred = self._tree_pred(k, tree, self.bins_dev) * factor_old
+                    pred = drop_preds[(k, idx)] * factor_old
                     if self._shape_k:
                         self.scores = self.scores.at[:, k].add(pred)
                     else:

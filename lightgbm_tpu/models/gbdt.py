@@ -13,6 +13,7 @@ the reference keeps SHAP/categorical logic host-side).
 
 from __future__ import annotations
 
+import os
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -250,19 +251,31 @@ class GBDT:
     # --------------------------------------------------------------- prediction
     def predict_raw(self, X: np.ndarray, num_iteration: Optional[int] = None,
                     start_iteration: int = 0) -> np.ndarray:
-        """Raw scores for new data: host binning + device ensemble traversal."""
+        """Raw scores for new data: host binning, then either the native C++
+        batch traversal (small batches; no device round-trip) or the device
+        ensemble scan (large batches)."""
+        from .. import native
+
         X = np.asarray(X)
-        bins = jnp.asarray(self.train_data.binned.apply(X))
-        nan_bins = self.meta_dev["nan_bins"]
+        host_bins = self.train_data.binned.apply(X)
+        nan_bins_np = self.train_data.binned.nan_bins
         n = X.shape[0]
         k = self.num_class
+        use_native = native.available() and n <= int(os.environ.get(
+            "LIGHTGBM_TPU_NATIVE_PREDICT_MAX_ROWS", 262144))
+        bins = None if use_native else jnp.asarray(host_bins)
+        nan_bins = None if use_native else self.meta_dev["nan_bins"]
         out = np.zeros((n, k), np.float64)
         for kk in range(k):
             trees = self.models[kk]
             end = len(trees) if num_iteration is None else min(
                 len(trees), start_iteration + num_iteration)
             trees = trees[start_iteration:end]
-            if trees:
+            if trees and use_native:
+                buf = np.zeros(n, np.float64)
+                native.predict_bins(host_bins, nan_bins_np, trees, out=buf)
+                out[:, kk] += buf
+            elif trees:
                 stacked = stack_trees(trees, self.cfg.num_leaves,
                                       self.train_data.binned.max_num_bins)
                 pred = predict_ensemble_bins_device(stacked, bins, nan_bins)

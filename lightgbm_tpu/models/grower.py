@@ -191,8 +191,9 @@ def make_grower(cfg: GrowerConfig):
             tree=tree,
         )
         root_bs = _best_for(root_hist, root_g, root_h, root_c, meta, feature_mask)
-        root_depth_ok = jnp.asarray(cfg.max_depth != 1)
-        state = _store_best(state, jnp.asarray(0), root_bs, root_depth_ok)
+        # Splitting the root puts children at depth 1, legal for any
+        # max_depth >= 1 (and unlimited when <= 0) — max_depth=1 means stumps.
+        state = _store_best(state, jnp.asarray(0), root_bs, jnp.asarray(True))
 
         def cond(st: _GrowState):
             return (st.num_leaves < L) & (jnp.max(st.best_gain) > _NEG_INF)

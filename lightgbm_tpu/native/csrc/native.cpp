@@ -1,0 +1,445 @@
+// lightgbm_tpu native host runtime.
+//
+// C++ equivalents of the reference's host-side C++ components (the TPU compute
+// path stays in XLA/Pallas):
+//   - text data loader: CSV/TSV/LibSVM parsing (reference src/io/parser.cpp,
+//     dataset_loader.cpp — rewritten, not translated)
+//   - bin-boundary search + value->bin discretization (reference src/io/bin.cpp
+//     GreedyFindBin / BinMapper::ValueToBin)
+//   - bin-space batch tree traversal for ensemble prediction (reference
+//     src/io/tree.cpp Tree::Predict*)
+//
+// Exposed as a flat C ABI consumed by ctypes (lightgbm_tpu/native/__init__.py).
+// All matrices are row-major contiguous buffers allocated by the caller except
+// the parser, which owns its buffers behind an opaque handle.
+
+#include <algorithm>
+#include <cctype>
+#include <cmath>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <fstream>
+#include <limits>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr double kZeroThreshold = 1e-35;
+
+inline bool is_zero(double v) { return v > -kZeroThreshold && v < kZeroThreshold; }
+
+// Fast whitespace-tolerant float parse; empty / na / nan / null -> NaN.
+double parse_token(const char* s, const char* end) {
+  while (s < end && std::isspace(static_cast<unsigned char>(*s))) ++s;
+  while (end > s && std::isspace(static_cast<unsigned char>(end[-1]))) --end;
+  if (s == end) return std::numeric_limits<double>::quiet_NaN();
+  size_t len = static_cast<size_t>(end - s);
+  if (len <= 4) {
+    char low[5];
+    for (size_t i = 0; i < len; ++i)
+      low[i] = static_cast<char>(std::tolower(static_cast<unsigned char>(s[i])));
+    low[len] = 0;
+    if (!std::strcmp(low, "na") || !std::strcmp(low, "nan") ||
+        !std::strcmp(low, "null") || !std::strcmp(low, "none"))
+      return std::numeric_limits<double>::quiet_NaN();
+  }
+  char* parse_end = nullptr;
+  std::string tmp(s, len);
+  double v = std::strtod(tmp.c_str(), &parse_end);
+  if (parse_end == tmp.c_str()) return std::numeric_limits<double>::quiet_NaN();
+  return v;
+}
+
+struct ParsedFile {
+  int64_t nrows = 0;
+  int64_t ncols = 0;  // feature columns (label excluded)
+  std::vector<double> X;
+  std::vector<double> y;
+  std::string error;
+};
+
+enum class Format { kCSV, kTSV, kLibSVM };
+
+Format sniff_format(const std::vector<std::string>& lines) {
+  auto is_sep = [](char c) { return c == ',' || c == '\t' || c == ' '; };
+  for (const auto& line : lines) {
+    if (line.find_first_not_of(" \t\r\n") == std::string::npos) continue;
+    // A ':' inside the 2nd/3rd token means libsvm index:value pairs.
+    size_t start = 0;
+    int tok = 0;
+    for (size_t i = 0; i <= line.size() && tok < 3; ++i) {
+      if (i == line.size() || is_sep(line[i])) {
+        if (tok >= 1 && tok <= 2 &&
+            line.substr(start, i - start).find(':') != std::string::npos)
+          return Format::kLibSVM;
+        start = i + 1;
+        ++tok;
+      }
+    }
+    if (line.find('\t') != std::string::npos) return Format::kTSV;
+    if (line.find(',') != std::string::npos) return Format::kCSV;
+  }
+  return Format::kCSV;
+}
+
+void split_line(const std::string& line, char sep, std::vector<std::pair<const char*, const char*>>* out) {
+  out->clear();
+  const char* p = line.data();
+  const char* end = p + line.size();
+  const char* tok = p;
+  for (; p <= end; ++p) {
+    if (p == end || *p == sep) {
+      out->emplace_back(tok, p);
+      tok = p + 1;
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// ---------------------------------------------------------------- data loader
+
+// Parse a CSV/TSV/LibSVM file. label_column: "" or "0"-style index or
+// "name:<col>" (requires header). Returns opaque handle (nullptr on error with
+// message in err). num_features_hint: LibSVM width override (0 = infer).
+void* ltpu_parse_file(const char* path, int has_header, const char* label_column,
+                      int num_features_hint, int64_t* out_nrows,
+                      int64_t* out_ncols, char* err, int err_len) {
+  auto fail = [&](const std::string& msg) -> void* {
+    if (err && err_len > 0) {
+      std::strncpy(err, msg.c_str(), static_cast<size_t>(err_len - 1));
+      err[err_len - 1] = 0;
+    }
+    return nullptr;
+  };
+  std::ifstream in(path, std::ios::binary);
+  if (!in) return fail(std::string("cannot open file: ") + path);
+  std::vector<std::string> lines;
+  {
+    std::string line;
+    while (std::getline(in, line)) {
+      if (!line.empty() && line.back() == '\r') line.pop_back();
+      if (line.find_first_not_of(" \t\r\n") != std::string::npos)
+        lines.push_back(std::move(line));
+    }
+  }
+  size_t start = has_header ? 1 : 0;
+  if (lines.size() <= start) return fail("empty data file");
+  std::vector<std::string> head(lines.begin() + static_cast<long>(start),
+                                lines.begin() + static_cast<long>(std::min(start + 10, lines.size())));
+  Format fmt = sniff_format(head);
+
+  auto* pf = new ParsedFile();
+  if (fmt == Format::kLibSVM) {
+    int64_t max_f = -1;
+    std::vector<std::vector<std::pair<int64_t, double>>> rows;
+    rows.reserve(lines.size() - start);
+    for (size_t li = start; li < lines.size(); ++li) {
+      const std::string& line = lines[li];
+      std::vector<std::pair<int64_t, double>> row;
+      const char* p = line.data();
+      const char* end = p + line.size();
+      // first token = label
+      const char* tok = p;
+      while (p < end && !std::isspace(static_cast<unsigned char>(*p))) ++p;
+      pf->y.push_back(parse_token(tok, p));
+      while (p < end) {
+        while (p < end && std::isspace(static_cast<unsigned char>(*p))) ++p;
+        if (p >= end) break;
+        tok = p;
+        const char* colon = nullptr;
+        while (p < end && !std::isspace(static_cast<unsigned char>(*p))) {
+          if (*p == ':' && !colon) colon = p;
+          ++p;
+        }
+        if (!colon) continue;
+        int64_t fi = std::strtoll(std::string(tok, colon).c_str(), nullptr, 10);
+        double v = parse_token(colon + 1, p);
+        row.emplace_back(fi, v);
+        if (fi > max_f) max_f = fi;
+      }
+      rows.push_back(std::move(row));
+    }
+    int64_t nf = num_features_hint > 0 ? num_features_hint : max_f + 1;
+    pf->nrows = static_cast<int64_t>(rows.size());
+    pf->ncols = nf;
+    pf->X.assign(static_cast<size_t>(pf->nrows * nf), 0.0);
+    for (int64_t i = 0; i < pf->nrows; ++i)
+      for (const auto& kv : rows[static_cast<size_t>(i)])
+        if (kv.first >= 0 && kv.first < nf)
+          pf->X[static_cast<size_t>(i * nf + kv.first)] = kv.second;
+  } else {
+    char sep = fmt == Format::kTSV ? '\t' : ',';
+    int label_idx = 0;
+    std::string lc = label_column ? label_column : "";
+    if (lc.rfind("name:", 0) == 0 && has_header) {
+      std::vector<std::pair<const char*, const char*>> names;
+      split_line(lines[0], sep, &names);
+      std::string want = lc.substr(5);
+      label_idx = -1;
+      for (size_t i = 0; i < names.size(); ++i) {
+        if (std::string(names[i].first, names[i].second) == want) {
+          label_idx = static_cast<int>(i);
+          break;
+        }
+      }
+      if (label_idx < 0) { delete pf; return fail("label column not found: " + want); }
+    } else if (!lc.empty() && lc.rfind("name:", 0) != 0) {
+      label_idx = std::atoi(lc.c_str());
+    }
+    std::vector<std::pair<const char*, const char*>> toks;
+    split_line(lines[start], sep, &toks);
+    int64_t ntok = static_cast<int64_t>(toks.size());
+    if (label_idx >= ntok) { delete pf; return fail("label index out of range"); }
+    pf->nrows = static_cast<int64_t>(lines.size() - start);
+    pf->ncols = ntok - 1;
+    pf->X.resize(static_cast<size_t>(pf->nrows * pf->ncols));
+    pf->y.resize(static_cast<size_t>(pf->nrows));
+    for (int64_t i = 0; i < pf->nrows; ++i) {
+      split_line(lines[start + static_cast<size_t>(i)], sep, &toks);
+      if (static_cast<int64_t>(toks.size()) != ntok) {
+        std::string msg = "inconsistent column count at data row " + std::to_string(i);
+        delete pf;
+        return fail(msg);
+      }
+      double* xrow = pf->X.data() + i * pf->ncols;
+      int64_t c = 0;
+      for (int64_t j = 0; j < ntok; ++j) {
+        double v = parse_token(toks[static_cast<size_t>(j)].first,
+                               toks[static_cast<size_t>(j)].second);
+        if (j == label_idx) pf->y[static_cast<size_t>(i)] = v;
+        else xrow[c++] = v;
+      }
+    }
+  }
+  *out_nrows = pf->nrows;
+  *out_ncols = pf->ncols;
+  return pf;
+}
+
+void ltpu_parse_get(void* handle, double* X, double* y) {
+  auto* pf = static_cast<ParsedFile*>(handle);
+  std::memcpy(X, pf->X.data(), pf->X.size() * sizeof(double));
+  std::memcpy(y, pf->y.data(), pf->y.size() * sizeof(double));
+}
+
+void ltpu_parse_free(void* handle) { delete static_cast<ParsedFile*>(handle); }
+
+// -------------------------------------------------------------------- binning
+
+// Greedy equal-count boundary search over (sorted distinct values, counts).
+// Mirrors lightgbm_tpu.binning._greedy_find_boundaries (reference GreedyFindBin,
+// src/io/bin.cpp). out_bounds must hold max_bins doubles. Returns #bounds.
+int ltpu_find_boundaries(const double* distinct, const int64_t* counts,
+                         int64_t n, int max_bins, int64_t total_cnt,
+                         int min_data_in_bin, double* out_bounds) {
+  const double inf = std::numeric_limits<double>::infinity();
+  if (n == 0) {
+    out_bounds[0] = inf;
+    return 1;
+  }
+  if (n <= max_bins) {
+    for (int64_t i = 0; i + 1 < n; ++i)
+      out_bounds[i] = (distinct[i] + distinct[i + 1]) / 2.0;
+    out_bounds[n - 1] = inf;
+    return static_cast<int>(n);
+  }
+  int nb = 0;
+  double rest_cnt = static_cast<double>(total_cnt);
+  int rest_bins = max_bins;
+  double cur = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    double mean_size = rest_cnt / std::max(rest_bins, 1);
+    double target = std::max(mean_size, static_cast<double>(min_data_in_bin));
+    cur += static_cast<double>(counts[i]);
+    rest_cnt -= static_cast<double>(counts[i]);
+    if (cur >= target || (n - i - 1) <= (rest_bins - 1 - nb - 1)) {
+      if (i + 1 < n) out_bounds[nb++] = (distinct[i] + distinct[i + 1]) / 2.0;
+      cur = 0;
+      rest_bins -= 1;
+      if (nb >= max_bins - 1) break;
+    }
+  }
+  out_bounds[nb++] = inf;
+  return nb;
+}
+
+// Sort + unique + count for double data, NaN excluded. Returns #distinct;
+// out_distinct/out_counts sized n by caller.
+int64_t ltpu_unique_counts(const double* values, int64_t n, double* out_distinct,
+                           int64_t* out_counts) {
+  std::vector<double> v;
+  v.reserve(static_cast<size_t>(n));
+  for (int64_t i = 0; i < n; ++i)
+    if (!std::isnan(values[i])) v.push_back(values[i]);
+  std::sort(v.begin(), v.end());
+  int64_t m = 0;
+  for (size_t i = 0; i < v.size();) {
+    size_t j = i;
+    while (j < v.size() && v[j] == v[i]) ++j;
+    out_distinct[m] = v[i];
+    out_counts[m] = static_cast<int64_t>(j - i);
+    ++m;
+    i = j;
+  }
+  return m;
+}
+
+// Numerical value->bin: binary search over upper_bounds[0..n_value_bins-2]
+// (bin b holds values <= upper_bounds[b]), NaN -> nan_bin (or bin 0 when
+// nan_bin < 0), zero_as_missing folds |v|<1e-35 into NaN.
+void ltpu_value_to_bin(const double* values, int64_t n,
+                       const double* upper_bounds, int n_value_bins,
+                       int nan_bin, int zero_as_missing, int32_t* out) {
+  int nb = n_value_bins - 1;  // number of searchable boundaries
+  for (int64_t i = 0; i < n; ++i) {
+    double v = values[i];
+    if (zero_as_missing && is_zero(v)) v = std::numeric_limits<double>::quiet_NaN();
+    if (std::isnan(v)) {
+      out[i] = nan_bin >= 0 ? nan_bin : 0;
+      continue;
+    }
+    // lower_bound over upper_bounds[:nb] (side="left")
+    int lo = 0, hi = nb;
+    while (lo < hi) {
+      int mid = (lo + hi) >> 1;
+      if (upper_bounds[mid] < v) lo = mid + 1;
+      else hi = mid;
+    }
+    out[i] = lo;
+  }
+}
+
+// Whole-matrix numerical binning: X row-major (n, f); per-feature metadata.
+// upper_bounds row-major (f, max_b). out row-major (n, f) uint16.
+void ltpu_bin_matrix(const double* X, int64_t n, int64_t f,
+                     const double* upper_bounds, int64_t max_b,
+                     const int32_t* n_value_bins, const int32_t* nan_bins,
+                     const uint8_t* zero_as_missing, uint16_t* out) {
+  for (int64_t j = 0; j < f; ++j) {
+    const double* ub = upper_bounds + j * max_b;
+    int nb = n_value_bins[j] - 1;
+    int nanb = nan_bins[j];
+    bool zam = zero_as_missing[j] != 0;
+    for (int64_t i = 0; i < n; ++i) {
+      double v = X[i * f + j];
+      if (zam && is_zero(v)) v = std::numeric_limits<double>::quiet_NaN();
+      uint16_t b;
+      if (std::isnan(v)) {
+        b = nanb >= 0 ? static_cast<uint16_t>(nanb) : 0;
+      } else {
+        int lo = 0, hi = nb;
+        while (lo < hi) {
+          int mid = (lo + hi) >> 1;
+          if (ub[mid] < v) lo = mid + 1;
+          else hi = mid;
+        }
+        b = static_cast<uint16_t>(lo);
+      }
+      out[i * f + j] = b;
+    }
+  }
+}
+
+// ----------------------------------------------------------------- prediction
+
+// Batch ensemble prediction in bin space (mirrors Tree.predict_bins /
+// reference Tree::Predict). Trees are concatenated:
+//   node_offsets[t] .. node_offsets[t+1]  — node range of tree t
+//   leaf_offsets[t] .. leaf_offsets[t+1]  — leaf range of tree t
+// children < 0 encode ~leaf_index. cat_mask is a packed bitset per node:
+// cat_words u32 words per node, bit b set = bin b routes left.
+// bins: (n, f) uint16 row-major. out: (n,) f64, *accumulated* (caller zeros).
+void ltpu_predict_bins(const uint16_t* bins, int64_t n, int64_t f,
+                       const int32_t* nan_bins, int num_trees,
+                       const int64_t* node_offsets, const int64_t* leaf_offsets,
+                       const int32_t* split_feature, const int32_t* split_bin,
+                       const uint8_t* default_left, const uint8_t* is_cat,
+                       const uint32_t* cat_mask, int cat_words,
+                       const int32_t* left_child, const int32_t* right_child,
+                       const double* leaf_value, double* out) {
+  for (int64_t i = 0; i < n; ++i) {
+    const uint16_t* row = bins + i * f;
+    double acc = 0.0;
+    for (int t = 0; t < num_trees; ++t) {
+      int64_t nbase = node_offsets[t];
+      int64_t lbase = leaf_offsets[t];
+      int64_t nnodes = node_offsets[t + 1] - nbase;
+      if (nnodes == 0) {  // stump: single leaf
+        acc += leaf_value[lbase];
+        continue;
+      }
+      int32_t node = 0;
+      for (;;) {
+        int64_t g = nbase + node;
+        int32_t fi = split_feature[g];
+        int32_t col = row[fi];
+        bool go_left;
+        if (is_cat[g]) {
+          int32_t b = col;
+          go_left = (b < cat_words * 32) &&
+                    ((cat_mask[g * cat_words + (b >> 5)] >> (b & 31)) & 1u);
+        } else if (col == nan_bins[fi]) {
+          go_left = default_left[g] != 0;
+        } else {
+          go_left = col <= split_bin[g];
+        }
+        int32_t nxt = go_left ? left_child[g] : right_child[g];
+        if (nxt < 0) {
+          acc += leaf_value[lbase + (~nxt)];
+          break;
+        }
+        node = nxt;
+      }
+    }
+    out[i] += acc;
+  }
+}
+
+// Per-row leaf index for one tree (reference Tree::PredictLeafIndex).
+void ltpu_predict_leaf_index(const uint16_t* bins, int64_t n, int64_t f,
+                             const int32_t* nan_bins, int64_t nnodes,
+                             const int32_t* split_feature,
+                             const int32_t* split_bin,
+                             const uint8_t* default_left, const uint8_t* is_cat,
+                             const uint32_t* cat_mask, int cat_words,
+                             const int32_t* left_child,
+                             const int32_t* right_child, int32_t* out) {
+  for (int64_t i = 0; i < n; ++i) {
+    const uint16_t* row = bins + i * f;
+    if (nnodes == 0) {
+      out[i] = 0;
+      continue;
+    }
+    int32_t node = 0;
+    for (;;) {
+      int32_t fi = split_feature[node];
+      int32_t col = row[fi];
+      bool go_left;
+      if (is_cat[node]) {
+        go_left = (col < cat_words * 32) &&
+                  ((cat_mask[static_cast<int64_t>(node) * cat_words + (col >> 5)] >>
+                    (col & 31)) & 1u);
+      } else if (col == nan_bins[fi]) {
+        go_left = default_left[node] != 0;
+      } else {
+        go_left = col <= split_bin[node];
+      }
+      int32_t nxt = go_left ? left_child[node] : right_child[node];
+      if (nxt < 0) {
+        out[i] = ~nxt;
+        break;
+      }
+      node = nxt;
+    }
+  }
+}
+
+int ltpu_version() { return 1; }
+
+}  // extern "C"

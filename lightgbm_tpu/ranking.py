@@ -63,10 +63,11 @@ class LambdaRankNDCG(ObjectiveFunction):
         lab[valid] = label_np[doc_idx[valid]]
         gain = np.where(valid, gains[np.minimum(lab.astype(np.int64),
                                                 len(gains) - 1)], 0.0)
-        # Ideal DCG per query (reference DCGCalculator::CalMaxDCG).
+        # Ideal DCG per query at the truncation level (reference
+        # DCGCalculator::CalMaxDCGAtK with lambdarank_truncation_level).
         top = np.sort(gain, axis=1)[:, ::-1]
         disc = 1.0 / np.log2(np.arange(s) + 2.0)
-        max_dcg = (top * disc[None, :]).sum(axis=1)
+        max_dcg = (top[:, : self.trunc] * disc[None, : self.trunc]).sum(axis=1)
         self.inv_max_dcg = jnp.asarray(
             np.where(max_dcg > 0, 1.0 / np.maximum(max_dcg, 1e-20), 0.0),
             jnp.float32)

@@ -86,12 +86,12 @@ class SampleStrategy:
         n = self.num_data
         score = np.abs(grad * hess)
         top_k = max(int(n * cfg.top_rate), 1)
-        other_k = max(int(n * cfg.other_rate), 1)
+        other_k = int(n * cfg.other_rate)
         order = np.argsort(-score, kind="stable")
         mask = np.zeros(n, np.float32)
         mask[order[:top_k]] = 1.0
         rest = order[top_k:]
-        if len(rest) > 0 and other_k > 0:
+        if len(rest) > 0 and other_k > 0 and cfg.other_rate > 0:
             pick = self.rng.choice(len(rest), size=min(other_k, len(rest)),
                                    replace=False)
             mask[rest[pick]] = (1.0 - cfg.top_rate) / cfg.other_rate

@@ -280,3 +280,23 @@ def test_rollback_one_iter():
     bst.rollback_one_iter()
     p5b = bst.predict(X)
     np.testing.assert_allclose(p5, p5b, rtol=1e-5)
+
+
+def test_max_depth_one_gives_stumps():
+    from sklearn.datasets import make_classification
+
+    X, y = make_classification(n_samples=500, n_features=6, random_state=0)
+    bst = lgb.train({"objective": "binary", "max_depth": 1, "num_leaves": 31,
+                     "verbosity": -1}, lgb.Dataset(X, label=y), 5)
+    for tree in bst._gbdt.models[0]:
+        assert tree.num_leaves == 2  # stumps, not empty trees
+
+
+def test_goss_other_rate_zero():
+    from sklearn.datasets import make_classification
+
+    X, y = make_classification(n_samples=500, n_features=6, random_state=0)
+    bst = lgb.train({"objective": "binary", "data_sample_strategy": "goss",
+                     "other_rate": 0.0, "top_rate": 0.3, "num_leaves": 7,
+                     "verbosity": -1}, lgb.Dataset(X, label=y), 5)
+    assert bst.num_trees() >= 1
