@@ -4,17 +4,16 @@ Reference: ``src/boosting/dart.hpp:23`` — per iteration, a random subset of
 existing trees is "dropped" (their contribution removed from the scores before
 computing gradients), the new tree is fit to the residual, and the dropped trees
 plus the new tree are re-normalized by ``k/(k+1)`` and ``1/(k+1)``.
+
+All tree predictions/scalings below run on device arrays (``TreeArrays``); the
+host only draws the dropout indices.
 """
 
 from __future__ import annotations
 
-from typing import Optional
-
-import jax
-import jax.numpy as jnp
 import numpy as np
 
-from .gbdt import GBDT
+from .gbdt import GBDT, _scale_tree_arrays, _tree_dict
 from .tree import predict_tree_bins_device
 
 
@@ -23,31 +22,39 @@ class DART(GBDT):
         super().__init__(cfg, train, valids)
         self.drop_rng = np.random.RandomState(cfg.drop_seed)
 
-    def _tree_pred(self, k: int, tree, bins) -> jnp.ndarray:
-        dev = self._device_tree(tree)
-        return predict_tree_bins_device(dev, bins, self.meta_dev["nan_bins"])
+    def _tree_pred_idx(self, k: int, idx: int, bins):
+        return predict_tree_bins_device(
+            _tree_dict(self.dev_models[k][idx]), bins,
+            self.meta_dev["nan_bins"])
 
-    def _scale_tree_scores(self, k: int, idx: int, factor: float) -> None:
-        """Scale tree ``idx``'s stored leaf values and adjust all score arrays."""
-        tree = self.models[k][idx]
-        delta = factor - 1.0
-        pred = self._tree_pred(k, tree, self.bins_dev) * delta
+    def _add_scores(self, k: int, pred) -> None:
         if self._shape_k:
             self.scores = self.scores.at[:, k].add(pred)
         else:
             self.scores = self.scores + pred
+
+    def _add_valid(self, i: int, k: int, pred) -> None:
+        if self._shape_k:
+            self.valid_scores[i] = self.valid_scores[i].at[:, k].add(pred)
+        else:
+            self.valid_scores[i] = self.valid_scores[i] + pred
+
+    def _scale_stored_tree(self, k: int, idx: int, factor: float) -> None:
+        self.dev_models[k][idx] = _scale_tree_arrays(
+            self.dev_models[k][idx], factor)
+        self._host_cache[k][idx] = None
+
+    def _scale_new_tree(self, k: int, idx: int, factor: float) -> None:
+        """Scale the freshly-trained tree and fix up all score arrays."""
+        delta = factor - 1.0
+        self._add_scores(k, self._tree_pred_idx(k, idx, self.bins_dev) * delta)
         for i, vbins in enumerate(self.valid_bins):
-            vp = self._tree_pred(k, tree, vbins) * delta
-            if self._shape_k:
-                self.valid_scores[i] = self.valid_scores[i].at[:, k].add(vp)
-            else:
-                self.valid_scores[i] = self.valid_scores[i] + vp
-        tree.leaf_value = tree.leaf_value * factor
-        tree.internal_value = tree.internal_value * factor
+            self._add_valid(i, k, self._tree_pred_idx(k, idx, vbins) * delta)
+        self._scale_stored_tree(k, idx, factor)
 
     def train_one_iter(self, grad=None, hess=None) -> bool:
         cfg = self.cfg
-        n_trees = len(self.models[0])
+        n_trees = len(self.dev_models[0])
         drop_idx: list = []
         if n_trees > 0 and self.drop_rng.rand() >= cfg.skip_drop:
             if cfg.uniform_drop:
@@ -64,12 +71,9 @@ class DART(GBDT):
         drop_preds: dict = {}
         for k in range(self.num_class):
             for idx in drop_idx:
-                pred = self._tree_pred(k, self.models[k][idx], self.bins_dev)
+                pred = self._tree_pred_idx(k, idx, self.bins_dev)
                 drop_preds[(k, idx)] = pred
-                if self._shape_k:
-                    self.scores = self.scores.at[:, k].add(-pred)
-                else:
-                    self.scores = self.scores - pred
+                self._add_scores(k, -pred)
         stop = super().train_one_iter(grad, hess)
         # Normalize (reference DART::Normalize): dropped trees come back scaled
         # by k/(k+1); the new tree is scaled by 1/(k+1).
@@ -78,22 +82,15 @@ class DART(GBDT):
             factor_old = kd / (kd + 1.0)
             factor_new = 1.0 / (kd + 1.0)
             for k in range(self.num_class):
-                new_idx = len(self.models[k]) - 1
-                self._scale_tree_scores(k, new_idx, factor_new)
+                new_idx = len(self.dev_models[k]) - 1
+                self._scale_new_tree(k, new_idx, factor_new)
                 for idx in drop_idx:
-                    tree = self.models[k][idx]
                     # Tree was fully removed above; re-add at the reduced scale.
-                    pred = drop_preds[(k, idx)] * factor_old
-                    if self._shape_k:
-                        self.scores = self.scores.at[:, k].add(pred)
-                    else:
-                        self.scores = self.scores + pred
+                    self._add_scores(k, drop_preds[(k, idx)] * factor_old)
                     for i, vbins in enumerate(self.valid_bins):
-                        vp = self._tree_pred(k, tree, vbins) * (factor_old - 1.0)
-                        if self._shape_k:
-                            self.valid_scores[i] = self.valid_scores[i].at[:, k].add(vp)
-                        else:
-                            self.valid_scores[i] = self.valid_scores[i] + vp
-                    tree.leaf_value = tree.leaf_value * factor_old
-                    tree.internal_value = tree.internal_value * factor_old
+                        self._add_valid(
+                            i, k,
+                            self._tree_pred_idx(k, idx, vbins)
+                            * (factor_old - 1.0))
+                    self._scale_stored_tree(k, idx, factor_old)
         return stop

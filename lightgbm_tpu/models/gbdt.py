@@ -52,6 +52,28 @@ def _add_leaf_outputs(scores, row_leaf, leaf_values):
     return scores + leaf_values[row_leaf]
 
 
+def _tree_dict(arrays: TreeArrays) -> dict:
+    """Zero-copy view of device TreeArrays in the dict layout the traversal
+    kernels consume (same keys as ``stack_trees``)."""
+    return {
+        "split_feature": arrays.split_feature,
+        "split_bin": arrays.split_bin,
+        "default_left": arrays.default_left,
+        "is_cat": arrays.is_cat,
+        "cat_mask": arrays.cat_mask,
+        "left_child": arrays.left_child,
+        "right_child": arrays.right_child,
+        "leaf_value": arrays.leaf_value,
+        "num_leaves": arrays.num_leaves,
+    }
+
+
+@jax.jit
+def _scale_tree_arrays(arrays: TreeArrays, factor) -> TreeArrays:
+    return arrays._replace(leaf_value=arrays.leaf_value * factor,
+                           internal_value=arrays.internal_value * factor)
+
+
 class GBDT:
     """Boosting driver (reference ``GBDT``, ``gbdt.h:630``)."""
 
@@ -65,7 +87,13 @@ class GBDT:
         if self.objective is not None:
             self.objective.init(train.label, train.weight, train.group, cfg)
         self.metrics = self._create_metrics()
-        self.models: List[List[Tree]] = [[] for _ in range(self.num_class)]
+        # Device-resident ensemble: dev_models holds TreeArrays in HBM (the
+        # reference's CUDATree); host Tree mirrors are materialized lazily in
+        # one batched transfer (tunnel round-trips are the real cost on TPU).
+        self.dev_models: List[List[TreeArrays]] = [
+            [] for _ in range(self.num_class)]
+        self._host_cache: List[List[Optional[Tree]]] = [
+            [] for _ in range(self.num_class)]
         self.iter_ = 0
         self.best_iteration = -1
 
@@ -85,6 +113,7 @@ class GBDT:
             split=_split_config(cfg),
             histogram_impl=hist_impl,
             rows_block=cfg.tpu_rows_block,
+            gather_rows=self.mesh is None,
         )
         self.grow = make_grower(self.grower_cfg)
         self.bins_dev = train.bins_device()
@@ -104,6 +133,64 @@ class GBDT:
         self.valid_scores = [self._init_scores_array(v) for _, v in self.valids]
         self._shape_k = self.num_class > 1 or self.cfg.objective in (
             "multiclass", "multiclassova")
+        # Per-iteration device state cached once: uploading an (N,) mask every
+        # iteration costs a host->device transfer that dwarfs the tree growth.
+        self._full_mask = jnp.ones(train.num_data, jnp.float32)
+        self._bag_mask_dev = None
+        self._fmask_static = None
+        if cfg.feature_fraction >= 1.0:
+            self._fmask_static = jnp.asarray(self.feature_sampler.tree_mask(0))
+        if self.objective is None:
+            self._grad_fn = None
+        elif self.objective.stochastic_gradients:
+            self._grad_fn = self.objective.get_gradients
+        else:
+            self._grad_fn = jax.jit(self.objective.get_gradients)
+        self._build_iter_fns()
+
+    def _build_iter_fns(self) -> None:
+        """Compile the per-iteration programs.  The fused program runs
+        objective gradients -> tree growth -> shrinkage -> score update as ONE
+        XLA dispatch (reference: the CUDA learner's device-resident iteration,
+        ``cuda_single_gpu_tree_learner.cpp:158`` — host sees only scalars)."""
+        grow = self.grow
+        meta = self.meta_dev
+        obj = self.objective
+        num_class = self.num_class
+        shape_k = self._shape_k
+
+        def grow_apply(scores_k, grad_k, hess_k, mask, fmask, shrink):
+            arrays, row_leaf = grow(
+                self.bins_dev, grad_k, hess_k, mask, fmask,
+                meta["num_bins_per_feature"], meta["nan_bins"],
+                meta["is_categorical"], meta["monotone"])
+            grew = arrays.num_leaves > 1
+            lv = jnp.where(grew, arrays.leaf_value * shrink, 0.0)
+            arrays = arrays._replace(
+                leaf_value=lv, internal_value=arrays.internal_value * shrink)
+            return scores_k + lv[row_leaf], arrays, row_leaf
+
+        self._grow_apply = jax.jit(grow_apply)
+
+        self._fused_iter = None
+        if (obj is not None and not obj.need_renew_tree_output
+                and not obj.stochastic_gradients):
+            def fused(scores, mask, fmask, shrink):
+                grad, hess = obj.get_gradients(scores)
+                outs = []
+                if shape_k:
+                    new_scores = scores
+                    for k in range(num_class):
+                        ns_k, arrays, row_leaf = grow_apply(
+                            new_scores[:, k], grad[:, k], hess[:, k],
+                            mask, fmask, shrink)
+                        new_scores = new_scores.at[:, k].set(ns_k)
+                        outs.append((arrays, row_leaf))
+                    return new_scores, outs
+                ns, arrays, row_leaf = grow_apply(scores, grad, hess,
+                                                  mask, fmask, shrink)
+                return ns, [(arrays, row_leaf)]
+            self._fused_iter = jax.jit(fused)
 
     # ------------------------------------------------------------------ helpers
     def _init_scores_array(self, data: TrainData) -> jnp.ndarray:
@@ -129,97 +216,137 @@ class GBDT:
         return out
 
     # ----------------------------------------------------------------- training
-    def train_one_iter(self, grad: Optional[np.ndarray] = None,
-                       hess: Optional[np.ndarray] = None) -> bool:
-        """One boosting iteration (reference ``GBDT::TrainOneIter``).  Returns
-        True when no tree could be grown (training should stop)."""
-        cfg = self.cfg
-        if grad is None:
-            if self.objective is None:
-                raise ValueError(
-                    "objective='custom' requires gradients: pass a callable "
-                    "objective in params or call update(fobj=...) "
-                    "(reference LGBM_BoosterUpdateOneIterCustom)")
-            g_dev, h_dev = self.objective.get_gradients(self.scores)
-        else:
-            g_dev = jnp.asarray(grad, jnp.float32).reshape(self.scores.shape)
-            h_dev = jnp.asarray(hess, jnp.float32).reshape(self.scores.shape)
-
-        mask_np = None
+    def _iter_masks(self, grad=None, hess=None):
+        """Device row/feature masks for this iteration (cached when static).
+        Returns ``(mask, fmask, grads)`` where ``grads`` is the (g, h) device
+        pair when it had to be computed anyway (GOSS), else None."""
         strategy = self.sample_strategy
-        if strategy.is_goss:
-            gm = np.asarray(jax.device_get(g_dev)).reshape(len(self.train_data.label), -1)
-            hm = np.asarray(jax.device_get(h_dev)).reshape(gm.shape)
-            mask_np = strategy.mask(self.iter_, gm.sum(axis=1), hm.sum(axis=1))
-        else:
-            mask_np = strategy.mask(self.iter_)
         n = self.train_data.num_data
-        mask_dev = (jnp.ones(n, jnp.float32) if mask_np is None
-                    else jnp.asarray(mask_np))
-        fmask = jnp.asarray(self.feature_sampler.tree_mask(self.iter_))
-
-        grew_any = False
-        for k in range(self.num_class):
-            tree, row_leaf = self._grow_one_tree(k, g_dev, h_dev, mask_dev,
-                                                 fmask)
-            if tree.num_leaves <= 1:
-                # No split improved the loss — store a zero constant tree so
-                # predict/rollback see exactly what training applied (reference
-                # stops with "no further splits with positive gain").
-                tree.leaf_value = np.zeros_like(tree.leaf_value)
-                self.models[k].append(tree)
-                continue
-            grew_any = True
-            if (self.objective is not None
-                    and self.objective.need_renew_tree_output):
-                rl = np.asarray(jax.device_get(row_leaf))
-                sc = np.asarray(jax.device_get(
-                    self.scores[:, k] if self._shape_k else self.scores))
-                renewed = self.objective.renew_leaf_values(
-                    sc, rl, tree.num_leaves)
-                if renewed is not None:
-                    tree.leaf_value = renewed
-            tree.shrink(cfg.learning_rate if cfg.boosting != "rf" else 1.0)
-            self.models[k].append(tree)
-            self._update_scores(k, tree, row_leaf)
-        self.iter_ += 1
-        return not grew_any
-
-    def _grow_one_tree(self, k: int, g_dev, h_dev, mask_dev, fmask):
-        """Grow one class-k tree on the device (shared by GBDT/DART/RF)."""
-        gk = g_dev[:, k] if self._shape_k else g_dev
-        hk = h_dev[:, k] if self._shape_k else h_dev
-        arrays, row_leaf = self.grow(
-            self.bins_dev, gk, hk, mask_dev, fmask,
-            self.meta_dev["num_bins_per_feature"],
-            self.meta_dev["nan_bins"],
-            self.meta_dev["is_categorical"],
-            self.meta_dev["monotone"],
-        )
-        tree = Tree.from_arrays(arrays,
-                                self.train_data.binned.upper_bounds_padded)
-        return tree, row_leaf
-
-    def _update_scores(self, k: int, tree: Tree, row_leaf: jnp.ndarray) -> None:
-        lv = jnp.asarray(tree.leaf_value, jnp.float32)
-        if self._shape_k:
-            self.scores = self.scores.at[:, k].set(
-                _add_leaf_outputs(self.scores[:, k], row_leaf, lv))
+        grads = None
+        if strategy.is_goss:
+            if grad is None:
+                g_dev, h_dev = self._grad_fn(self.scores)
+                grads = (g_dev, h_dev)
+                gm = np.asarray(jax.device_get(g_dev)).reshape(n, -1)
+                hm = np.asarray(jax.device_get(h_dev)).reshape(n, -1)
+            else:
+                gm = np.asarray(grad).reshape(n, -1)
+                hm = np.asarray(hess).reshape(n, -1)
+            mask_dev = jnp.asarray(strategy.mask(
+                self.iter_, gm.sum(axis=1), hm.sum(axis=1)))
+        elif strategy.is_bagging:
+            if strategy.needs_resample(self.iter_) or self._bag_mask_dev is None:
+                self._bag_mask_dev = jnp.asarray(strategy.mask(self.iter_))
+            mask_dev = self._bag_mask_dev
         else:
-            self.scores = _add_leaf_outputs(self.scores, row_leaf, lv)
-        dev_tree = self._device_tree(tree)
+            mask_dev = self._full_mask
+        fmask = (self._fmask_static if self._fmask_static is not None
+                 else jnp.asarray(self.feature_sampler.tree_mask(self.iter_)))
+        return mask_dev, fmask, grads
+
+    def _store_tree(self, k: int, arrays: TreeArrays,
+                    row_leaf: jnp.ndarray) -> None:
+        self.dev_models[k].append(arrays)
+        self._host_cache[k].append(None)
         for i, vbins in enumerate(self.valid_bins):
             pred = predict_tree_bins_device(
-                dev_tree, vbins, self.meta_dev["nan_bins"])
+                _tree_dict(arrays), vbins, self.meta_dev["nan_bins"])
             if self._shape_k:
                 self.valid_scores[i] = self.valid_scores[i].at[:, k].add(pred)
             else:
                 self.valid_scores[i] = self.valid_scores[i] + pred
 
-    def _device_tree(self, tree: Tree) -> dict:
-        stacked = stack_trees([tree], self.cfg.num_leaves,
-                              self.train_data.binned.max_num_bins)
-        return jax.tree_util.tree_map(lambda a: a[0], stacked)
+    def train_one_iter(self, grad: Optional[np.ndarray] = None,
+                       hess: Optional[np.ndarray] = None) -> bool:
+        """One boosting iteration (reference ``GBDT::TrainOneIter``).  Returns
+        True when no tree could be grown (training should stop)."""
+        cfg = self.cfg
+        if grad is None and self.objective is None:
+            raise ValueError(
+                "objective='custom' requires gradients: pass a callable "
+                "objective in params or call update(fobj=...) "
+                "(reference LGBM_BoosterUpdateOneIterCustom)")
+        mask_dev, fmask, goss_grads = self._iter_masks(grad, hess)
+        shrink = cfg.learning_rate if cfg.boosting != "rf" else 1.0
+
+        results = []
+        if (grad is None and self._fused_iter is not None
+                and not self.sample_strategy.is_goss):
+            # Hot path: ONE device dispatch for gradients + all class trees +
+            # score updates.
+            self.scores, results = self._fused_iter(self.scores, mask_dev,
+                                                    fmask, shrink)
+        else:
+            if goss_grads is not None:
+                g_dev, h_dev = goss_grads
+            elif grad is None:
+                g_dev, h_dev = self._grad_fn(self.scores)
+            else:
+                g_dev = jnp.asarray(grad, jnp.float32).reshape(self.scores.shape)
+                h_dev = jnp.asarray(hess, jnp.float32).reshape(self.scores.shape)
+            for k in range(self.num_class):
+                gk = g_dev[:, k] if self._shape_k else g_dev
+                hk = h_dev[:, k] if self._shape_k else h_dev
+                sk = self.scores[:, k] if self._shape_k else self.scores
+                if (self.objective is not None
+                        and self.objective.need_renew_tree_output):
+                    arrays, row_leaf = self._raw_grow(gk, hk, mask_dev, fmask)
+                    arrays = self._renew_and_shrink(arrays, row_leaf, sk,
+                                                    shrink)
+                    new_sk = _add_leaf_outputs(sk, row_leaf,
+                                               arrays.leaf_value)
+                else:
+                    new_sk, arrays, row_leaf = self._grow_apply(
+                        sk, gk, hk, mask_dev, fmask, shrink)
+                if self._shape_k:
+                    self.scores = self.scores.at[:, k].set(new_sk)
+                else:
+                    self.scores = new_sk
+                results.append((arrays, row_leaf))
+        for k, (arrays, row_leaf) in enumerate(results):
+            self._store_tree(k, arrays, row_leaf)
+        self.iter_ += 1
+        nls = jax.device_get([a.num_leaves for a, _ in results])
+        return all(int(x) <= 1 for x in nls)
+
+    def _raw_grow(self, gk, hk, mask_dev, fmask):
+        return self.grow(
+            self.bins_dev, gk, hk, mask_dev, fmask,
+            self.meta_dev["num_bins_per_feature"], self.meta_dev["nan_bins"],
+            self.meta_dev["is_categorical"], self.meta_dev["monotone"])
+
+    def _renew_and_shrink(self, arrays: TreeArrays, row_leaf, scores_k,
+                          shrink: float) -> TreeArrays:
+        """Host percentile leaf renewal (reference ``RenewTreeOutput``,
+        L1/Huber/Quantile/MAPE) then shrinkage — branchy host work by design."""
+        nl = int(arrays.num_leaves)
+        if nl <= 1:
+            return arrays._replace(leaf_value=jnp.zeros_like(arrays.leaf_value))
+        rl = np.asarray(jax.device_get(row_leaf))
+        sc = np.asarray(jax.device_get(scores_k))
+        renewed = self.objective.renew_leaf_values(sc, rl, nl)
+        L = arrays.leaf_value.shape[0]
+        if renewed is not None:
+            lv = np.zeros(L, np.float32)
+            lv[:nl] = renewed * shrink
+            return arrays._replace(
+                leaf_value=jnp.asarray(lv),
+                internal_value=arrays.internal_value * shrink)
+        return _scale_tree_arrays(arrays, shrink)
+
+    # ------------------------------------------------- host model materialization
+    @property
+    def models(self) -> List[List[Tree]]:
+        """Host Tree mirrors of the device ensemble (lazy, batched transfer)."""
+        pending = [(k, i)
+                   for k in range(self.num_class)
+                   for i, t in enumerate(self._host_cache[k]) if t is None]
+        if pending:
+            host = jax.device_get([self.dev_models[k][i] for k, i in pending])
+            ub = self.train_data.binned.upper_bounds_padded
+            for (k, i), a in zip(pending, host):
+                self._host_cache[k][i] = Tree.from_arrays(a, ub)
+        return self._host_cache
 
     # --------------------------------------------------------------- evaluation
     def eval_set(self, feval=None) -> List[Tuple[str, str, float, bool]]:
@@ -298,27 +425,27 @@ class GBDT:
         if self.iter_ == 0:
             return
         for k in range(self.num_class):
-            tree = self.models[k].pop()
-            if tree.num_leaves > 1:
-                dev_tree = self._device_tree(tree)
-                pred = predict_tree_bins_device(
-                    dev_tree, self.bins_dev, self.meta_dev["nan_bins"])
+            arrays = self.dev_models[k].pop()
+            self._host_cache[k].pop()
+            dev_tree = _tree_dict(arrays)
+            pred = predict_tree_bins_device(
+                dev_tree, self.bins_dev, self.meta_dev["nan_bins"])
+            if self._shape_k:
+                self.scores = self.scores.at[:, k].add(-pred)
+            else:
+                self.scores = self.scores - pred
+            for i, vbins in enumerate(self.valid_bins):
+                vp = predict_tree_bins_device(
+                    dev_tree, vbins, self.meta_dev["nan_bins"])
                 if self._shape_k:
-                    self.scores = self.scores.at[:, k].add(-pred)
+                    self.valid_scores[i] = self.valid_scores[i].at[:, k].add(-vp)
                 else:
-                    self.scores = self.scores - pred
-                for i, vbins in enumerate(self.valid_bins):
-                    vp = predict_tree_bins_device(
-                        dev_tree, vbins, self.meta_dev["nan_bins"])
-                    if self._shape_k:
-                        self.valid_scores[i] = self.valid_scores[i].at[:, k].add(-vp)
-                    else:
-                        self.valid_scores[i] = self.valid_scores[i] - vp
+                    self.valid_scores[i] = self.valid_scores[i] - vp
         self.iter_ -= 1
 
     @property
     def num_trees(self) -> int:
-        return sum(len(m) for m in self.models)
+        return sum(len(m) for m in self.dev_models)
 
     def feature_importance(self, importance_type: str = "split") -> np.ndarray:
         """reference ``GBDT::FeatureImportance`` (``gbdt.cpp``)."""

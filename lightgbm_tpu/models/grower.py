@@ -7,16 +7,21 @@ device-resident learner (``cuda_single_gpu_tree_learner.cpp:158`` — per-leaf k
 sequence with only scalars returning to host).
 
 TPU re-design: the whole per-tree growth loop is ONE compiled XLA program —
-a ``lax.while_loop`` with static trip bound ``num_leaves - 1`` over a static-shape
-state.  Instead of a permutation array + contiguous leaf ranges (reference
-``DataPartition``), rows carry a ``row_leaf`` assignment vector; leaf membership is
-a predicate folded into the histogram contraction, so no dynamic-size gathers
-exist anywhere.  Host sees nothing until the finished tree arrays come back.
+a ``lax.while_loop`` with static trip bound ``num_leaves - 1`` over static-shape
+state.  Two interchangeable data layouts:
 
-Sharding: ``bins``/``grad``/``hess``/``row_leaf`` may be sharded along rows and/or
-the feature axis of ``bins`` across a mesh; all per-leaf reductions cross the mesh
-via compiler-inserted collectives (the reference's histogram ReduceScatter + split
-AllGather, ``data_parallel_tree_learner.cpp:284,441``).
+- **Permutation layout** (default, single device): a row-index permutation kept
+  grouped by leaf (the reference's ``DataPartition``/``CUDADataPartition``), so
+  every per-split op — partition, histogram gather, scatter-back — touches ONLY
+  the splitting leaf's rows via ``dynamic_slice`` with a static power-of-two
+  bucket chosen by a ``lax.switch`` on the leaf's row count.  Per-tree work is
+  O(N · avg_depth) like the reference, not O(N · num_leaves).
+- **Mask layout** (sharded meshes): rows carry a ``row_leaf`` assignment vector
+  and leaf membership is a predicate folded into the histogram contraction.
+  Slower (full-N pass per split) but preserves row-sharding locality: all
+  reductions cross the mesh via compiler-inserted collectives (the reference's
+  histogram ReduceScatter + split AllGather,
+  ``data_parallel_tree_learner.cpp:284,441``).
 """
 
 from __future__ import annotations
@@ -28,10 +33,11 @@ from typing import NamedTuple, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from ..ops.histogram import build_histogram
+from ..ops.histogram import build_histogram, histogram_from_vals
 from ..ops.split import BestSplit, SplitConfig, best_split, leaf_output
 
 _NEG_INF = -jnp.inf
+_MIN_BUCKET = 2048
 
 
 @dataclasses.dataclass(frozen=True)
@@ -42,6 +48,10 @@ class GrowerConfig:
     split: SplitConfig = dataclasses.field(default_factory=SplitConfig)
     histogram_impl: str = "auto"
     rows_block: int = 16384
+    # Permutation layout on/off (see module docstring).  Disabled under a
+    # device mesh: dynamic_slice over globally-grouped rows would destroy the
+    # row-sharding locality the distributed path relies on.
+    gather_rows: bool = True
 
 
 class TreeArrays(NamedTuple):
@@ -73,11 +83,13 @@ class TreeArrays(NamedTuple):
 
 class _GrowState(NamedTuple):
     num_leaves: jnp.ndarray      # () i32
-    row_leaf: jnp.ndarray        # (N,) i32
+    perm: jnp.ndarray            # (N + max_bucket,) i32 rows grouped by leaf
+    leaf_start: jnp.ndarray      # (L,) i32 slice start per leaf
+    leaf_rows: jnp.ndarray       # (L,) i32 physical row count per leaf
     leaf_hist: jnp.ndarray       # (L, F, B, 3) f32
     leaf_sum_grad: jnp.ndarray   # (L,)
     leaf_sum_hess: jnp.ndarray   # (L,)
-    leaf_count: jnp.ndarray      # (L,)
+    leaf_count: jnp.ndarray      # (L,) in-bag counts (histogram count channel)
     leaf_depth: jnp.ndarray      # (L,) i32
     leaf_parent: jnp.ndarray     # (L,) i32 node index (-1 root)
     leaf_is_left: jnp.ndarray    # (L,) bool
@@ -109,6 +121,17 @@ def _store_best(state: _GrowState, leaf: jnp.ndarray, bs: BestSplit,
     )
 
 
+def _split_buckets(n: int) -> list:
+    """Static slice sizes covering leaf row counts 1..n."""
+    sizes = []
+    b = _MIN_BUCKET
+    while b < n:
+        sizes.append(b)
+        b *= 2
+    sizes.append(n)
+    return sizes
+
+
 def make_grower(cfg: GrowerConfig):
     """Build the jitted ``grow(bins, grad, hess, sample_mask, feature_mask, meta...)``
     function.  All shapes/hyper-params are compile-time; data is traced."""
@@ -124,34 +147,7 @@ def make_grower(cfg: GrowerConfig):
             monotone=monotone, feature_mask=feature_mask, cfg=cfg.split,
         )
 
-    @functools.partial(jax.jit, donate_argnums=())
-    def grow(
-        bins: jnp.ndarray,          # (N, F) uint8/16 — binned features
-        grad: jnp.ndarray,          # (N,) f32
-        hess: jnp.ndarray,          # (N,) f32
-        sample_mask: jnp.ndarray,   # (N,) f32 bagging/GOSS weights (1.0 = in-bag)
-        feature_mask: jnp.ndarray,  # (F,) bool feature_fraction mask
-        num_bins_per_feature: jnp.ndarray,
-        nan_bins: jnp.ndarray,
-        is_categorical: jnp.ndarray,
-        monotone: jnp.ndarray,      # (F,) i32
-    ) -> Tuple[TreeArrays, jnp.ndarray]:
-        n, f = bins.shape
-        meta = (num_bins_per_feature, nan_bins, is_categorical, monotone)
-        g = grad * sample_mask
-        h = hess * sample_mask
-        in_bag = sample_mask > 0.0
-
-        def hist_for(mask):
-            return build_histogram(
-                bins, g, h, mask, num_bins=B,
-                impl=cfg.histogram_impl, rows_block=cfg.rows_block,
-            )
-
-        root_hist = hist_for(in_bag)
-        root_tot = jnp.sum(root_hist[0], axis=0)  # (3,): feature 0 covers all rows
-        root_g, root_h, root_c = root_tot[0], root_tot[1], root_tot[2]
-
+    def _init_state(n, f, root_hist, root_g, root_h, root_c):
         tree = TreeArrays(
             split_feature=jnp.zeros(M, jnp.int32),
             split_bin=jnp.zeros(M, jnp.int32),
@@ -168,10 +164,11 @@ def make_grower(cfg: GrowerConfig):
             leaf_weight=jnp.zeros(L, jnp.float32),
             num_leaves=jnp.asarray(1, jnp.int32),
         )
-
-        state = _GrowState(
+        return _GrowState(
             num_leaves=jnp.asarray(1, jnp.int32),
-            row_leaf=jnp.zeros(n, jnp.int32),
+            perm=jnp.zeros(0, jnp.int32),  # set by caller when used
+            leaf_start=jnp.zeros(L, jnp.int32),
+            leaf_rows=jnp.zeros(L, jnp.int32).at[0].set(n),
             leaf_hist=jnp.zeros((L, f, B, 3), jnp.float32).at[0].set(root_hist),
             leaf_sum_grad=jnp.zeros(L, jnp.float32).at[0].set(root_g),
             leaf_sum_hess=jnp.zeros(L, jnp.float32).at[0].set(root_h),
@@ -190,15 +187,205 @@ def make_grower(cfg: GrowerConfig):
             best_cl=jnp.zeros(L, jnp.float32),
             tree=tree,
         )
-        root_bs = _best_for(root_hist, root_g, root_h, root_c, meta, feature_mask)
-        # Splitting the root puts children at depth 1, legal for any
-        # max_depth >= 1 (and unlimited when <= 0) — max_depth=1 means stumps.
+
+    def _update_tree(st: _GrowState, leaf, new_leaf, node, pg, ph, pc):
+        """Shared tree bookkeeping for one executed split."""
+        tr = st.tree
+        feat = st.best_feature[leaf]
+        parent = st.leaf_parent[leaf]
+        p_safe = jnp.maximum(parent, 0)
+        was_left = st.leaf_is_left[leaf]
+        left_child = tr.left_child.at[p_safe].set(
+            jnp.where((parent >= 0) & was_left, node, tr.left_child[p_safe]))
+        right_child = tr.right_child.at[p_safe].set(
+            jnp.where((parent >= 0) & ~was_left, node, tr.right_child[p_safe]))
+        return tr._replace(
+            split_feature=tr.split_feature.at[node].set(feat),
+            split_bin=tr.split_bin.at[node].set(st.best_bin[leaf]),
+            default_left=tr.default_left.at[node].set(st.best_default_left[leaf]),
+            is_cat=tr.is_cat.at[node].set(st.best_is_cat[leaf]),
+            cat_mask=tr.cat_mask.at[node].set(st.best_cat_mask[leaf]),
+            left_child=left_child.at[node].set(~leaf),
+            right_child=right_child.at[node].set(~new_leaf),
+            split_gain=tr.split_gain.at[node].set(st.best_gain[leaf]),
+            internal_value=tr.internal_value.at[node].set(
+                leaf_output(pg, ph, cfg.split)),
+            internal_count=tr.internal_count.at[node].set(pc),
+        )
+
+    def _finish(state: _GrowState) -> TreeArrays:
+        leaf_ids = jnp.arange(L)
+        active = leaf_ids < state.num_leaves
+        values = leaf_output(state.leaf_sum_grad, state.leaf_sum_hess, cfg.split)
+        return state.tree._replace(
+            leaf_value=jnp.where(active, values, 0.0),
+            leaf_count=jnp.where(active, state.leaf_count, 0.0),
+            leaf_weight=jnp.where(active, state.leaf_sum_hess, 0.0),
+            num_leaves=state.num_leaves,
+        )
+
+    def _children_updates(st, leaf, new_leaf, hist_left, hist_right,
+                          gl, hl, cl, gr, hr, cr, meta, feature_mask):
+        """Store child stats + their best splits."""
+        depth = st.leaf_depth[leaf] + 1
+        node = st.num_leaves - 1
+        st = st._replace(
+            num_leaves=st.num_leaves + 1,
+            leaf_hist=st.leaf_hist.at[leaf].set(hist_left)
+                                  .at[new_leaf].set(hist_right),
+            leaf_sum_grad=st.leaf_sum_grad.at[leaf].set(gl).at[new_leaf].set(gr),
+            leaf_sum_hess=st.leaf_sum_hess.at[leaf].set(hl).at[new_leaf].set(hr),
+            leaf_count=st.leaf_count.at[leaf].set(cl).at[new_leaf].set(cr),
+            leaf_depth=st.leaf_depth.at[leaf].set(depth).at[new_leaf].set(depth),
+            leaf_parent=st.leaf_parent.at[leaf].set(node).at[new_leaf].set(node),
+            leaf_is_left=st.leaf_is_left.at[leaf].set(True)
+                                        .at[new_leaf].set(False),
+        )
+        depth_ok = jnp.asarray(True) if cfg.max_depth <= 0 \
+            else depth < cfg.max_depth
+        bs_l = _best_for(hist_left, gl, hl, cl, meta, feature_mask)
+        bs_r = _best_for(hist_right, gr, hr, cr, meta, feature_mask)
+        st = _store_best(st, leaf, bs_l, depth_ok)
+        st = _store_best(st, new_leaf, bs_r, depth_ok)
+        return st
+
+    # ------------------------------------------------------------------ perm path
+    def _grow_perm(bins, g, h, in_bag, feature_mask, meta):
+        """Permutation-layout growth (single device)."""
+        n, f = bins.shape
+        nan_bins = meta[1]
+        vals = jnp.stack([g, h, in_bag.astype(jnp.float32)], axis=-1)
+        bins_pad = jnp.concatenate([bins, jnp.zeros((1, f), bins.dtype)], 0)
+        vals_pad = jnp.concatenate([vals, jnp.zeros((1, 3), vals.dtype)], 0)
+        buckets = _split_buckets(n)
+        max_bucket = buckets[-1]
+        buckets_arr = jnp.asarray(buckets, jnp.int32)
+        perm0 = jnp.concatenate([jnp.arange(n, dtype=jnp.int32),
+                                 jnp.full(max_bucket, n, jnp.int32)])
+
+        root_hist = histogram_from_vals(
+            bins, vals, num_bins=B, impl=cfg.histogram_impl,
+            rows_block=cfg.rows_block)
+        root_tot = jnp.sum(root_hist[0], axis=0)
+        root_g, root_h, root_c = root_tot[0], root_tot[1], root_tot[2]
+
+        state = _init_state(n, f, root_hist, root_g, root_h, root_c)
+        state = state._replace(perm=perm0)
+        root_bs = _best_for(root_hist, root_g, root_h, root_c, meta,
+                            feature_mask)
         state = _store_best(state, jnp.asarray(0), root_bs, jnp.asarray(True))
+
+        def _make_branch(S):
+            def branch(perm, start, cnt, feat, sbin, dleft, scat, cmask,
+                       small_is_left):
+                seg = jax.lax.dynamic_slice(perm, (start,), (S,))
+                valid = jnp.arange(S, dtype=jnp.int32) < cnt
+                bseg = bins_pad[seg]                       # (S, F)
+                vseg = vals_pad[seg]                       # (S, 3)
+                col = jnp.take_along_axis(
+                    bseg, jnp.full((S, 1), feat, jnp.int32), axis=1
+                )[:, 0].astype(jnp.int32)
+                is_nan = col == nan_bins[feat]
+                go_left = jnp.where(scat, cmask[col], col <= sbin)
+                go_left = jnp.where(is_nan & ~scat, dleft, go_left)
+                go_left = go_left & valid
+                go_right = valid & ~go_left
+                nl_phys = jnp.sum(go_left.astype(jnp.int32))
+                lpos = jnp.cumsum(go_left.astype(jnp.int32)) - go_left
+                rpos = nl_phys + jnp.cumsum(go_right.astype(jnp.int32)) - go_right
+                pos = jnp.where(go_left, lpos,
+                                jnp.where(go_right, rpos,
+                                          jnp.arange(S, dtype=jnp.int32)))
+                new_seg = jnp.zeros(S, jnp.int32).at[pos].set(seg)
+                perm = jax.lax.dynamic_update_slice(perm, new_seg, (start,))
+                # Histogram of the smaller child (by in-bag count), masked from
+                # the slice — the sibling comes from parent-hist subtraction.
+                w = jnp.where(small_is_left, go_left, go_right)
+                hist_small = histogram_from_vals(
+                    bseg, vseg * w[:, None].astype(vseg.dtype), num_bins=B,
+                    impl=cfg.histogram_impl,
+                    rows_block=min(cfg.rows_block, S))
+                return perm, nl_phys, hist_small
+            return branch
+
+        branches = [_make_branch(S) for S in buckets]
+
+        def body(st: _GrowState) -> _GrowState:
+            leaf = jnp.argmax(st.best_gain).astype(jnp.int32)
+            node = st.num_leaves - 1
+            new_leaf = st.num_leaves
+            start = st.leaf_start[leaf]
+            cnt = st.leaf_rows[leaf]
+            pg, ph, pc = (st.leaf_sum_grad[leaf], st.leaf_sum_hess[leaf],
+                          st.leaf_count[leaf])
+            gl, hl, cl = st.best_gl[leaf], st.best_hl[leaf], st.best_cl[leaf]
+            gr, hr, cr = pg - gl, ph - hl, pc - cl
+            small_is_left = cl <= cr
+
+            j = jnp.clip(jnp.searchsorted(buckets_arr, cnt, side="left"),
+                         0, len(buckets) - 1).astype(jnp.int32)
+            perm, nl_phys, hist_small = jax.lax.switch(
+                j, branches, st.perm, start, cnt,
+                st.best_feature[leaf], st.best_bin[leaf],
+                st.best_default_left[leaf], st.best_is_cat[leaf],
+                st.best_cat_mask[leaf], small_is_left)
+
+            hist_parent = st.leaf_hist[leaf]
+            hist_big = hist_parent - hist_small
+            hist_left = jnp.where(small_is_left, hist_small, hist_big)
+            hist_right = jnp.where(small_is_left, hist_big, hist_small)
+
+            tree = _update_tree(st, leaf, new_leaf, node, pg, ph, pc)
+            st = st._replace(
+                perm=perm,
+                tree=tree,
+                leaf_start=st.leaf_start.at[new_leaf].set(start + nl_phys),
+                leaf_rows=st.leaf_rows.at[leaf].set(nl_phys)
+                                      .at[new_leaf].set(cnt - nl_phys),
+            )
+            return _children_updates(st, leaf, new_leaf, hist_left, hist_right,
+                                     gl, hl, cl, gr, hr, cr, meta, feature_mask)
 
         def cond(st: _GrowState):
             return (st.num_leaves < L) & (jnp.max(st.best_gain) > _NEG_INF)
 
-        def body(st: _GrowState) -> _GrowState:
+        state = jax.lax.while_loop(cond, body, state)
+
+        # row -> leaf assignment from the final grouped permutation: position i
+        # belongs to the leaf whose [start, start+rows) range contains i.
+        starts = jnp.where(jnp.arange(L) < state.num_leaves,
+                           state.leaf_start, n + max_bucket)
+        order = jnp.argsort(starts)
+        sorted_starts = starts[order]
+        pos_leaf = order[jnp.clip(
+            jnp.searchsorted(sorted_starts, jnp.arange(n, dtype=jnp.int32),
+                             side="right") - 1, 0, L - 1)].astype(jnp.int32)
+        row_leaf = jnp.zeros(n, jnp.int32).at[state.perm[:n]].set(pos_leaf)
+        return _finish(state), row_leaf
+
+    # ------------------------------------------------------------------ mask path
+    def _grow_mask(bins, g, h, in_bag, feature_mask, meta):
+        """Mask-layout growth (sharding-friendly; full-N pass per split)."""
+        n, f = bins.shape
+
+        def hist_for(mask):
+            return build_histogram(
+                bins, g, h, mask, num_bins=B,
+                impl=cfg.histogram_impl, rows_block=cfg.rows_block,
+            )
+
+        nan_bins = meta[1]
+        root_hist = hist_for(in_bag)
+        root_tot = jnp.sum(root_hist[0], axis=0)
+        root_g, root_h, root_c = root_tot[0], root_tot[1], root_tot[2]
+        state = _init_state(n, f, root_hist, root_g, root_h, root_c)
+        row_leaf0 = jnp.zeros(n, jnp.int32)
+        root_bs = _best_for(root_hist, root_g, root_h, root_c, meta,
+                            feature_mask)
+        state = _store_best(state, jnp.asarray(0), root_bs, jnp.asarray(True))
+
+        def body(carry):
+            st, row_leaf = carry
             leaf = jnp.argmax(st.best_gain).astype(jnp.int32)
             node = st.num_leaves - 1
             new_leaf = st.num_leaves
@@ -209,21 +396,18 @@ def make_grower(cfg: GrowerConfig):
             scat = st.best_is_cat[leaf]
             cmask = st.best_cat_mask[leaf]
 
-            # ---- partition rows (reference DataPartition::Split) ----
             col = jnp.take(bins, feat, axis=1).astype(jnp.int32)
             is_nan = col == nan_bins[feat]
             go_left = jnp.where(scat, cmask[col], col <= sbin)
             go_left = jnp.where(is_nan & ~scat, dleft, go_left)
-            mine = st.row_leaf == leaf
-            row_leaf = jnp.where(mine & ~go_left, new_leaf, st.row_leaf)
+            mine = row_leaf == leaf
+            row_leaf = jnp.where(mine & ~go_left, new_leaf, row_leaf)
 
-            # ---- child stats ----
             pg, ph, pc = (st.leaf_sum_grad[leaf], st.leaf_sum_hess[leaf],
                           st.leaf_count[leaf])
             gl, hl, cl = st.best_gl[leaf], st.best_hl[leaf], st.best_cl[leaf]
             gr, hr, cr = pg - gl, ph - hl, pc - cl
 
-            # ---- smaller-child histogram + sibling subtraction ----
             small_is_left = cl <= cr
             target = jnp.where(small_is_left, leaf, new_leaf)
             # row_leaf tracks ALL rows (out-of-bag included, they need score
@@ -234,66 +418,38 @@ def make_grower(cfg: GrowerConfig):
             hist_big = hist_parent - hist_small
             hist_left = jnp.where(small_is_left, hist_small, hist_big)
             hist_right = jnp.where(small_is_left, hist_big, hist_small)
-            leaf_hist = st.leaf_hist.at[leaf].set(hist_left).at[new_leaf].set(hist_right)
 
-            # ---- tree bookkeeping ----
-            tr = st.tree
-            parent = st.leaf_parent[leaf]
-            p_safe = jnp.maximum(parent, 0)
-            was_left = st.leaf_is_left[leaf]
-            left_child = tr.left_child.at[p_safe].set(
-                jnp.where((parent >= 0) & was_left, node, tr.left_child[p_safe]))
-            right_child = tr.right_child.at[p_safe].set(
-                jnp.where((parent >= 0) & ~was_left, node, tr.right_child[p_safe]))
-            tr = tr._replace(
-                split_feature=tr.split_feature.at[node].set(feat),
-                split_bin=tr.split_bin.at[node].set(sbin),
-                default_left=tr.default_left.at[node].set(dleft),
-                is_cat=tr.is_cat.at[node].set(scat),
-                cat_mask=tr.cat_mask.at[node].set(cmask),
-                left_child=left_child.at[node].set(~leaf),
-                right_child=right_child.at[node].set(~new_leaf),
-                split_gain=tr.split_gain.at[node].set(st.best_gain[leaf]),
-                internal_value=tr.internal_value.at[node].set(
-                    leaf_output(pg, ph, cfg.split)),
-                internal_count=tr.internal_count.at[node].set(pc),
-            )
+            tree = _update_tree(st, leaf, new_leaf, node, pg, ph, pc)
+            st = st._replace(tree=tree)
+            st = _children_updates(st, leaf, new_leaf, hist_left, hist_right,
+                                   gl, hl, cl, gr, hr, cr, meta, feature_mask)
+            return st, row_leaf
 
-            depth = st.leaf_depth[leaf] + 1
-            st = st._replace(
-                num_leaves=st.num_leaves + 1,
-                row_leaf=row_leaf,
-                leaf_hist=leaf_hist,
-                leaf_sum_grad=st.leaf_sum_grad.at[leaf].set(gl).at[new_leaf].set(gr),
-                leaf_sum_hess=st.leaf_sum_hess.at[leaf].set(hl).at[new_leaf].set(hr),
-                leaf_count=st.leaf_count.at[leaf].set(cl).at[new_leaf].set(cr),
-                leaf_depth=st.leaf_depth.at[leaf].set(depth).at[new_leaf].set(depth),
-                leaf_parent=st.leaf_parent.at[leaf].set(node).at[new_leaf].set(node),
-                leaf_is_left=st.leaf_is_left.at[leaf].set(True)
-                                            .at[new_leaf].set(False),
-                tree=tr,
-            )
+        def cond(carry):
+            st, _ = carry
+            return (st.num_leaves < L) & (jnp.max(st.best_gain) > _NEG_INF)
 
-            # ---- children best splits ----
-            depth_ok = jnp.asarray(True) if cfg.max_depth <= 0 \
-                else depth < cfg.max_depth
-            bs_l = _best_for(hist_left, gl, hl, cl, meta, feature_mask)
-            bs_r = _best_for(hist_right, gr, hr, cr, meta, feature_mask)
-            st = _store_best(st, leaf, bs_l, depth_ok)
-            st = _store_best(st, new_leaf, bs_r, depth_ok)
-            return st
+        state, row_leaf = jax.lax.while_loop(cond, body, (state, row_leaf0))
+        return _finish(state), row_leaf
 
-        state = jax.lax.while_loop(cond, body, state)
-
-        leaf_ids = jnp.arange(L)
-        active = leaf_ids < state.num_leaves
-        values = leaf_output(state.leaf_sum_grad, state.leaf_sum_hess, cfg.split)
-        tree = state.tree._replace(
-            leaf_value=jnp.where(active, values, 0.0),
-            leaf_count=jnp.where(active, state.leaf_count, 0.0),
-            leaf_weight=jnp.where(active, state.leaf_sum_hess, 0.0),
-            num_leaves=state.num_leaves,
-        )
-        return tree, state.row_leaf
+    @functools.partial(jax.jit, donate_argnums=())
+    def grow(
+        bins: jnp.ndarray,          # (N, F) uint8/16 — binned features
+        grad: jnp.ndarray,          # (N,) f32
+        hess: jnp.ndarray,          # (N,) f32
+        sample_mask: jnp.ndarray,   # (N,) f32 bagging/GOSS weights (1.0 = in-bag)
+        feature_mask: jnp.ndarray,  # (F,) bool feature_fraction mask
+        num_bins_per_feature: jnp.ndarray,
+        nan_bins: jnp.ndarray,
+        is_categorical: jnp.ndarray,
+        monotone: jnp.ndarray,      # (F,) i32
+    ) -> Tuple[TreeArrays, jnp.ndarray]:
+        meta = (num_bins_per_feature, nan_bins, is_categorical, monotone)
+        g = grad * sample_mask
+        h = hess * sample_mask
+        in_bag = sample_mask > 0.0
+        if cfg.gather_rows and bins.shape[0] > _MIN_BUCKET:
+            return _grow_perm(bins, g, h, in_bag, feature_mask, meta)
+        return _grow_mask(bins, g, h, in_bag, feature_mask, meta)
 
     return grow

@@ -7,13 +7,11 @@ the **average** of tree outputs plus the init score.
 
 from __future__ import annotations
 
-from typing import Optional
-
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .gbdt import GBDT
+from .gbdt import GBDT, _tree_dict
 from .tree import predict_tree_bins_device
 
 
@@ -34,33 +32,27 @@ class RandomForest(GBDT):
 
     def train_one_iter(self, grad=None, hess=None) -> bool:
         if grad is None:
-            g_dev, h_dev = self.objective.get_gradients(self._init_train_scores)
+            g_dev, h_dev = self._grad_fn(self._init_train_scores)
         else:
             g_dev = jnp.asarray(grad, jnp.float32).reshape(self.scores.shape)
             h_dev = jnp.asarray(hess, jnp.float32).reshape(self.scores.shape)
+        mask_dev, fmask, _ = self._iter_masks(grad, hess)
 
-        mask_np = self.sample_strategy.mask(self.iter_)
-        n = self.train_data.num_data
-        mask_dev = (jnp.ones(n, jnp.float32) if mask_np is None
-                    else jnp.asarray(mask_np))
-        fmask = jnp.asarray(self.feature_sampler.tree_mask(self.iter_))
-
-        grew_any = False
+        num_leaves_flags = []
         for k in range(self.num_class):
-            tree, row_leaf = self._grow_one_tree(k, g_dev, h_dev, mask_dev,
-                                                 fmask)
-            if tree.num_leaves <= 1:
-                tree.leaf_value = np.zeros_like(tree.leaf_value)
-            else:
-                grew_any = True
-            self.models[k].append(tree)
-            lv = jnp.asarray(tree.leaf_value, jnp.float32)
-            contrib = lv[row_leaf]
+            gk = g_dev[:, k] if self._shape_k else g_dev
+            hk = h_dev[:, k] if self._shape_k else h_dev
+            zero = jnp.zeros(self.train_data.num_data, jnp.float32)
+            contrib, arrays, row_leaf = self._grow_apply(
+                zero, gk, hk, mask_dev, fmask, 1.0)
+            self.dev_models[k].append(arrays)
+            self._host_cache[k].append(None)
+            num_leaves_flags.append(arrays.num_leaves)
             if self._shape_k:
                 self._sum_scores = self._sum_scores.at[:, k].add(contrib)
             else:
                 self._sum_scores = self._sum_scores + contrib
-            dev_tree = self._device_tree(tree)
+            dev_tree = _tree_dict(arrays)
             for i, vbins in enumerate(self.valid_bins):
                 vp = predict_tree_bins_device(dev_tree, vbins,
                                               self.meta_dev["nan_bins"])
@@ -73,11 +65,12 @@ class RandomForest(GBDT):
         self.scores = self._init_train_scores + self._sum_scores / t
         self.valid_scores = [init + s / t for init, s in
                              zip(self._init_valid, self._sum_valid)]
-        return not grew_any
+        nls = jax.device_get(num_leaves_flags)
+        return all(int(x) <= 1 for x in nls)
 
     def predict_raw(self, X, num_iteration=None, start_iteration=0):
         raw = super().predict_raw(X, num_iteration, start_iteration)
-        n_iter = len(self.models[0]) if num_iteration is None else num_iteration
-        n_iter = max(min(n_iter, len(self.models[0]) - start_iteration), 1)
+        n_iter = len(self.dev_models[0]) if num_iteration is None else num_iteration
+        n_iter = max(min(n_iter, len(self.dev_models[0]) - start_iteration), 1)
         init = self.init_scores[0] if self.num_class == 1 else self.init_scores
         return (raw - init) / n_iter + init

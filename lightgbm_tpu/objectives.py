@@ -35,6 +35,9 @@ class ObjectiveFunction:
     num_model_per_iteration: int = 1
     is_constant_hessian: bool = False
     need_renew_tree_output: bool = False
+    # True when get_gradients has host-side state (e.g. an advancing PRNG key)
+    # and must not be traced once and reused (see RankXENDCG).
+    stochastic_gradients = False
 
     def init(self, label: np.ndarray, weight: Optional[np.ndarray],
              group: Optional[np.ndarray], cfg: Config) -> None:

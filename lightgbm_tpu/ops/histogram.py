@@ -86,6 +86,28 @@ def histogram_segment(
     return hist.reshape(f, num_bins, 3)
 
 
+def histogram_from_vals(
+    bins: jnp.ndarray,
+    vals: jnp.ndarray,
+    *,
+    num_bins: int,
+    impl: str = "auto",
+    rows_block: int = 16384,
+) -> jnp.ndarray:
+    """Histogram from pre-packed (N, 3) channel values."""
+    if impl == "auto":
+        impl = "pallas" if jax.default_backend() == "tpu" else "segment"
+    if impl == "pallas":
+        from .pallas_histogram import histogram_pallas
+        return histogram_pallas(bins, vals, num_bins=num_bins,
+                                rows_block=min(rows_block, 2048))
+    if impl == "onehot":
+        return histogram_onehot(bins, vals, num_bins=num_bins, rows_block=rows_block)
+    if impl == "segment":
+        return histogram_segment(bins, vals, num_bins=num_bins)
+    raise ValueError(f"unknown histogram impl: {impl}")
+
+
 def build_histogram(
     bins: jnp.ndarray,
     grad: jnp.ndarray,
@@ -98,17 +120,8 @@ def build_histogram(
 ) -> jnp.ndarray:
     """Histogram for the rows selected by ``mask`` (all rows when ``mask=None``)."""
     vals = pack_values(grad, hess, mask)
-    if impl == "auto":
-        impl = "pallas" if jax.default_backend() == "tpu" else "segment"
-    if impl == "pallas":
-        from .pallas_histogram import histogram_pallas
-        return histogram_pallas(bins, vals, num_bins=num_bins,
-                                rows_block=min(rows_block, 2048))
-    if impl == "onehot":
-        return histogram_onehot(bins, vals, num_bins=num_bins, rows_block=rows_block)
-    if impl == "segment":
-        return histogram_segment(bins, vals, num_bins=num_bins)
-    raise ValueError(f"unknown histogram impl: {impl}")
+    return histogram_from_vals(bins, vals, num_bins=num_bins, impl=impl,
+                               rows_block=rows_block)
 
 
 def subtract_histogram(parent: jnp.ndarray, child: jnp.ndarray) -> jnp.ndarray:

@@ -155,6 +155,10 @@ class RankXENDCG(ObjectiveFunction):
     entropy against gain-derived targets perturbed by fresh uniform gammas each
     iteration."""
 
+    # The host-side PRNG key advance in get_gradients must run eagerly every
+    # iteration — jit-wrapping would freeze the gammas at trace time.
+    stochastic_gradients = True
+
     def __init__(self):
         super().__init__(name="rank_xendcg")
 
