@@ -1,0 +1,23 @@
+import time, numpy as np, jax, jax.numpy as jnp
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.dataset import TrainData
+from lightgbm_tpu.models.grower import GrowerConfig, make_grower
+from lightgbm_tpu.models.gbdt import _split_config
+from bench import make_higgs_like
+n, leaves = 200000, 255
+X, y = make_higgs_like(n, 28)
+cfg = Config({"objective":"binary","num_leaves":leaves,"max_bin":255,
+              "min_data_in_leaf":0,"min_sum_hessian_in_leaf":100.0})
+td = TrainData.build(X, y, cfg)
+meta = td.feature_meta_device()
+bins = jnp.asarray(td.binned.bins)
+p0 = np.full(n, y.mean())
+grad = jnp.asarray((p0-y).astype(np.float32)); hess = jnp.asarray((p0*(1-p0)).astype(np.float32))
+mask = jnp.ones(n,jnp.float32); fmask = jnp.ones(28,bool)
+args = (bins,grad,hess,mask,fmask,meta["num_bins_per_feature"],meta["nan_bins"],meta["is_categorical"],meta["monotone"])
+gcfg = GrowerConfig(num_leaves=leaves, num_bins=td.binned.max_num_bins, split=_split_config(cfg, td))
+grow = make_grower(gcfg)
+r = grow(*args); jax.device_get(r[0].num_leaves)
+t0=time.time()
+for _ in range(10): r = grow(*args); jax.device_get(r[0].num_leaves)
+print(f"{(time.time()-t0)/10*1000:.0f} ms/tree nl={int(r[0].num_leaves)}")

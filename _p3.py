@@ -1,0 +1,53 @@
+import time, numpy as np, jax, jax.numpy as jnp
+from lightgbm_tpu.ops.histogram import histogram_from_vals
+
+n, F, B, S = 1000000, 28, 255, 8192
+rng = np.random.RandomState(0)
+bins_pad = jnp.asarray(rng.randint(0,255,(n+1,F)), jnp.uint8)
+vals_pad = jnp.asarray(rng.rand(n+1,3).astype(np.float32))
+perm = jnp.asarray(rng.permutation(n+1).astype(np.int32))
+nanb = jnp.full(F, 255, jnp.int32)
+
+def timeit(name, fn, niter=40, reps=3):
+    f = jax.jit(lambda c: jax.lax.scan(lambda c,_: (fn(c), None), c, None, length=niter)[0])
+    r = f(jnp.asarray(0.0)); jax.device_get(r)
+    t0=time.time()
+    for _ in range(reps): r = f(jnp.asarray(0.0)); jax.device_get(r)
+    dt=(time.time()-t0)/reps
+    print(f"{name}: {(dt/niter)*1000:.3f} ms/iter (total {dt*1000:.0f}ms)")
+
+start = jnp.asarray(1234, jnp.int32)
+def seg_of(c):
+    return jax.lax.dynamic_slice(perm, (start + (c*0).astype(jnp.int32),), (S,))
+
+timeit("dyn_slice only", lambda c: c + seg_of(c)[0].astype(jnp.float32)*1e-9)
+def gather_bins(c):
+    seg = seg_of(c)
+    bseg = bins_pad[seg]
+    return c + bseg[0,0].astype(jnp.float32)*1e-9
+timeit("+ bins row-gather SxF", gather_bins)
+def gather_vals(c):
+    seg = seg_of(c)
+    vseg = vals_pad[seg]
+    return c + vseg[0,0]*1e-9
+timeit("+ vals row-gather Sx3", gather_vals)
+def cumsum_scatter(c):
+    seg = seg_of(c)
+    gl = (seg % 2) == 0
+    lpos = jnp.cumsum(gl.astype(jnp.int32)) - gl
+    pos = jnp.where(gl, lpos, jnp.arange(S, dtype=jnp.int32))
+    new_seg = jnp.zeros(S, jnp.int32).at[pos].set(seg)
+    return c + new_seg[0].astype(jnp.float32)*1e-9
+timeit("slice+cumsum+scatter", cumsum_scatter)
+def hist_only(c):
+    seg = seg_of(c)
+    bseg = bins_pad[seg]; vseg = vals_pad[seg]
+    h = histogram_from_vals(bseg, vseg, num_bins=B, impl="pallas", rows_block=2048)
+    return c + h[0,0,0]*1e-9
+timeit("slice+gathers+pallas hist", hist_only)
+def hist_onehot(c):
+    seg = seg_of(c)
+    bseg = bins_pad[seg]; vseg = vals_pad[seg]
+    h = histogram_from_vals(bseg, vseg, num_bins=B, impl="onehot", rows_block=8192)
+    return c + h[0,0,0]*1e-9
+timeit("slice+gathers+onehot hist", hist_onehot)

@@ -1,0 +1,37 @@
+import time, numpy as np, jax, jax.numpy as jnp
+rng = np.random.RandomState(0)
+n = 1000000
+perm = jnp.asarray(rng.permutation(n).astype(np.int32))
+vals = jnp.asarray(rng.randint(0,255,n).astype(np.int32))
+
+def timeit(name, f, arg, reps=3):
+    r = f(arg); jax.device_get(r.ravel()[0])
+    t0=time.time()
+    for _ in range(reps): r = f(arg); jax.device_get(r.ravel()[0])
+    print(f"{name}: {(time.time()-t0)/reps*1000:.1f} ms")
+
+timeit("scatter set 1M", jax.jit(lambda p: jnp.zeros(n,jnp.int32).at[p].set(vals)), perm)
+timeit("scatter set 1M unique", jax.jit(lambda p: jnp.zeros(n,jnp.int32).at[p].set(vals, unique_indices=True, mode='promise_in_bounds')), perm)
+timeit("argsort 1M", jax.jit(lambda p: jnp.argsort(p)), perm)
+timeit("gather 1M", jax.jit(lambda p: vals[p]), perm)
+# searchsorted-based partition at S=8192, in-loop marginal cost
+S = 8192
+seg = jnp.asarray(rng.randint(0,n,S).astype(np.int32))
+def part_gather(c):
+    gl = (seg + c.astype(jnp.int32)) % 2 == 0
+    valid = jnp.arange(S, dtype=jnp.int32) < S - 3
+    gl = gl & valid
+    gr = valid & ~gl
+    cumL = jnp.cumsum(gl.astype(jnp.int32)); nl = cumL[-1]
+    cumR = jnp.cumsum(gr.astype(jnp.int32))
+    j = jnp.arange(S, dtype=jnp.int32)
+    li = jnp.searchsorted(cumL, j + 1, side='left')
+    ri = jnp.searchsorted(cumR, j - nl + 1, side='left')
+    idx = jnp.where(j < nl, li, jnp.where(j < S-3, ri, j))
+    out = seg[jnp.clip(idx, 0, S-1)]
+    return c + out[0].astype(jnp.float32)*1e-9
+f = jax.jit(lambda c: jax.lax.scan(lambda c,_: (part_gather(c), None), c, None, length=40)[0])
+r = f(jnp.asarray(0.0)); jax.device_get(r)
+t0=time.time()
+for _ in range(3): r = f(jnp.asarray(0.0)); jax.device_get(r)
+print(f"searchsorted-partition S=8192 x40: {(time.time()-t0)/3*1000:.0f} ms total")

@@ -1,0 +1,18 @@
+import time, numpy as np, jax, jax.numpy as jnp
+from lightgbm_tpu.ops.histogram import histogram_from_vals
+rng = np.random.RandomState(0)
+F, B = 28, 255
+for S in (2048, 8192, 32768, 131072, 524288):
+    bins = jnp.asarray(rng.randint(0,255,(S,F)), jnp.uint8)
+    vals = jnp.asarray(rng.rand(S,3).astype(np.float32))
+    niter = 30
+    def body(c, _):
+        h = histogram_from_vals(bins, vals*(1+c*1e-12), num_bins=B, impl="pallas", rows_block=2048)
+        return c + h[0,0,0]*1e-20, None
+    f = jax.jit(lambda c: jax.lax.scan(body, c, None, length=niter)[0])
+    r = f(jnp.asarray(0.0)); jax.device_get(r)
+    t0=time.time()
+    for _ in range(3): r = f(jnp.asarray(0.0)); jax.device_get(r)
+    dt=(time.time()-t0)/3
+    per = (dt - 0.072)/niter*1000
+    print(f"S={S}: {per:.2f} ms/hist ({per/S*1e6:.1f} ns/row)")

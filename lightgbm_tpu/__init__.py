@@ -29,12 +29,20 @@ __all__ = [
     "early_stopping", "log_evaluation", "record_evaluation",
     "reset_parameter", "EarlyStopException",
     "LGBMModel", "LGBMClassifier", "LGBMRegressor", "LGBMRanker",
+    "plot_importance", "plot_metric", "plot_split_value_histogram",
+    "plot_tree", "create_tree_digraph",
 ]
+
+_PLOT_FNS = ("plot_importance", "plot_metric", "plot_split_value_histogram",
+             "plot_tree", "create_tree_digraph")
 
 
 def __getattr__(name):
-    # sklearn wrappers are imported lazily to keep base import light.
+    # sklearn wrappers / plotting are imported lazily to keep base import light.
     if name in ("LGBMModel", "LGBMClassifier", "LGBMRegressor", "LGBMRanker"):
         from . import sklearn as _sk
         return getattr(_sk, name)
+    if name in _PLOT_FNS:
+        from . import plotting as _pl
+        return getattr(_pl, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -257,6 +257,48 @@ class Booster:
             fh.write(self.model_to_string(num_iteration, start_iteration))
         return self
 
+    def dump_model(self, num_iteration: Optional[int] = None,
+                   start_iteration: int = 0) -> dict:
+        """JSON-style model dict (reference ``LGBM_BoosterDumpModel`` /
+        Python ``Booster.dump_model``)."""
+        from .serialization import model_to_dict
+        return model_to_dict(self._gbdt, num_iteration=num_iteration,
+                             start_iteration=start_iteration)
+
+    def trees_to_dataframe(self):
+        """Flat per-node table (reference ``Booster.trees_to_dataframe``);
+        returns a list of dicts (pandas-free)."""
+        rows = []
+        dump = self.dump_model()
+        names = dump["feature_names"]
+
+        def walk(tree_idx, node, parent=None, depth=0):
+            if "leaf_index" in node:
+                rows.append({
+                    "tree_index": tree_idx, "node_depth": depth,
+                    "node_index": f"{tree_idx}-L{node['leaf_index']}",
+                    "parent_index": parent, "split_feature": None,
+                    "threshold": None, "value": node["leaf_value"],
+                    "count": node.get("leaf_count"),
+                })
+                return
+            ni = f"{tree_idx}-S{node['split_index']}"
+            rows.append({
+                "tree_index": tree_idx, "node_depth": depth,
+                "node_index": ni, "parent_index": parent,
+                "split_feature": names[node["split_feature"]],
+                "threshold": node["threshold"],
+                "split_gain": node["split_gain"],
+                "value": node["internal_value"],
+                "count": node["internal_count"],
+            })
+            walk(tree_idx, node["left_child"], ni, depth + 1)
+            walk(tree_idx, node["right_child"], ni, depth + 1)
+
+        for info in dump["tree_info"]:
+            walk(info["tree_index"], info["tree_structure"])
+        return rows
+
     def eval(self, data: Dataset, name: str, feval=None):
         raise NotImplementedError("use valid_sets at construction (round 1)")
 

@@ -32,6 +32,7 @@ class TrainData:
     init_score: Optional[np.ndarray] = None
     feature_names: Optional[List[str]] = None
     monotone_constraints: Optional[np.ndarray] = None
+    raw: Optional[np.ndarray] = None     # raw values (kept for linear trees)
     # device arrays (lazily uploaded)
     _bins_dev: Optional[jnp.ndarray] = None
     _meta_dev: Optional[dict] = None
@@ -78,6 +79,8 @@ class TrainData:
             init_score=None if init_score is None else np.asarray(init_score),
             feature_names=feature_names,
             monotone_constraints=mono,
+            # Reference keeps raw data when linear_tree=true (Dataset raw_data_)
+            raw=np.asarray(X, np.float64) if cfg.linear_tree else None,
         )
 
     @property

@@ -31,7 +31,17 @@ from .tree import Tree, predict_tree_bins_device, stack_trees, \
     predict_ensemble_bins_device
 
 
-def _split_config(cfg: Config) -> SplitConfig:
+def _split_config(cfg: Config, train: Optional[TrainData] = None) -> SplitConfig:
+    facts = {}
+    if train is not None:
+        binned = train.binned
+        mono = train.monotone_constraints
+        facts = dict(
+            has_nan=bool(np.any(np.asarray(binned.nan_bins)
+                                < binned.max_num_bins)),
+            has_categorical=bool(np.any(np.asarray(binned.is_categorical))),
+            has_monotone=mono is not None and bool(np.any(mono != 0)),
+        )
     return SplitConfig(
         lambda_l1=cfg.lambda_l1,
         lambda_l2=cfg.lambda_l2,
@@ -44,6 +54,13 @@ def _split_config(cfg: Config) -> SplitConfig:
         max_cat_threshold=cfg.max_cat_threshold,
         max_cat_to_onehot=cfg.max_cat_to_onehot,
         path_smooth=cfg.path_smooth,
+        use_cegb=bool(cfg.cegb_penalty_split > 0.0
+                      or cfg.cegb_penalty_feature_coupled
+                      or cfg.cegb_penalty_feature_lazy
+                      or cfg.cegb_tradeoff < 1.0),
+        cegb_tradeoff=cfg.cegb_tradeoff,
+        cegb_penalty_split=cfg.cegb_penalty_split,
+        **facts,
     )
 
 
@@ -110,7 +127,7 @@ class GBDT:
             num_leaves=cfg.num_leaves,
             max_depth=cfg.max_depth,
             num_bins=train.binned.max_num_bins,
-            split=_split_config(cfg),
+            split=_split_config(cfg, train),
             histogram_impl=hist_impl,
             rows_block=cfg.tpu_rows_block,
             gather_rows=self.mesh is None,
@@ -124,6 +141,23 @@ class GBDT:
             cfg, train.num_data, train.label, train.query_boundaries())
         self.feature_sampler = FeatureSampler(cfg, train.num_features)
 
+        # CEGB (reference cost_effective_gradient_boosting.hpp): coupled
+        # penalties apply on a feature's FIRST use in the model, so the host
+        # tracks used features across iterations and re-masks the vector.
+        self._use_cegb = self.grower_cfg.split.use_cegb
+        if self._use_cegb:
+            nf = train.num_features
+            def _vec(lst):
+                v = np.zeros(nf, np.float32)
+                if lst:
+                    v[: len(lst)] = np.asarray(lst, np.float32)[:nf]
+                return v
+            self._cegb_coupled_raw = _vec(cfg.cegb_penalty_feature_coupled)
+            self._cegb_lazy_dev = jnp.asarray(
+                _vec(cfg.cegb_penalty_feature_lazy))
+            self._cegb_used = np.zeros(nf, bool)
+
+        self._linear_nls: List[int] = []
         self.init_scores = np.zeros(self.num_class, np.float64)
         if cfg.boost_from_average and self.objective is not None:
             for k in range(self.num_class):
@@ -159,11 +193,13 @@ class GBDT:
         num_class = self.num_class
         shape_k = self._shape_k
 
-        def grow_apply(scores_k, grad_k, hess_k, mask, fmask, shrink):
+        def grow_apply(scores_k, grad_k, hess_k, mask, fmask, shrink,
+                       cegb_coupled=None, cegb_lazy=None):
             arrays, row_leaf = grow(
                 self.bins_dev, grad_k, hess_k, mask, fmask,
                 meta["num_bins_per_feature"], meta["nan_bins"],
-                meta["is_categorical"], meta["monotone"])
+                meta["is_categorical"], meta["monotone"],
+                cegb_coupled, cegb_lazy)
             grew = arrays.num_leaves > 1
             lv = jnp.where(grew, arrays.leaf_value * shrink, 0.0)
             arrays = arrays._replace(
@@ -271,11 +307,13 @@ class GBDT:
 
         results = []
         if (grad is None and self._fused_iter is not None
-                and not self.sample_strategy.is_goss):
+                and not self.sample_strategy.is_goss and not self._use_cegb
+                and not cfg.linear_tree):
             # Hot path: ONE device dispatch for gradients + all class trees +
             # score updates.
-            self.scores, results = self._fused_iter(self.scores, mask_dev,
-                                                    fmask, shrink)
+            self.scores, outs = self._fused_iter(self.scores, mask_dev,
+                                                 fmask, shrink)
+            results = [(k, a, rl) for k, (a, rl) in enumerate(outs)]
         else:
             if goss_grads is not None:
                 g_dev, h_dev = goss_grads
@@ -288,6 +326,15 @@ class GBDT:
                 gk = g_dev[:, k] if self._shape_k else g_dev
                 hk = h_dev[:, k] if self._shape_k else h_dev
                 sk = self.scores[:, k] if self._shape_k else self.scores
+                if cfg.linear_tree:
+                    arrays, row_leaf = self._raw_grow(gk, hk, mask_dev, fmask)
+                    new_sk = self._fit_and_store_linear(
+                        k, arrays, row_leaf, gk, hk, mask_dev, sk, shrink)
+                    if self._shape_k:
+                        self.scores = self.scores.at[:, k].set(new_sk)
+                    else:
+                        self.scores = new_sk
+                    continue
                 if (self.objective is not None
                         and self.objective.need_renew_tree_output):
                     arrays, row_leaf = self._raw_grow(gk, hk, mask_dev, fmask)
@@ -295,6 +342,12 @@ class GBDT:
                                                     shrink)
                     new_sk = _add_leaf_outputs(sk, row_leaf,
                                                arrays.leaf_value)
+                elif self._use_cegb:
+                    coupled = jnp.asarray(
+                        self._cegb_coupled_raw * (~self._cegb_used))
+                    new_sk, arrays, row_leaf = self._grow_apply(
+                        sk, gk, hk, mask_dev, fmask, shrink,
+                        coupled, self._cegb_lazy_dev)
                 else:
                     new_sk, arrays, row_leaf = self._grow_apply(
                         sk, gk, hk, mask_dev, fmask, shrink)
@@ -302,11 +355,19 @@ class GBDT:
                     self.scores = self.scores.at[:, k].set(new_sk)
                 else:
                     self.scores = new_sk
-                results.append((arrays, row_leaf))
-        for k, (arrays, row_leaf) in enumerate(results):
+                results.append((k, arrays, row_leaf))
+        for k, arrays, row_leaf in results:
             self._store_tree(k, arrays, row_leaf)
         self.iter_ += 1
-        nls = jax.device_get([a.num_leaves for a, _ in results])
+        if self._use_cegb and self._cegb_coupled_raw.any():
+            # Coupled penalties: mark this iteration's split features used.
+            for _, arrays, _rl in results:
+                sf, nl = jax.device_get((arrays.split_feature,
+                                         arrays.num_leaves))
+                self._cegb_used[np.asarray(sf[: max(int(nl) - 1, 0)])] = True
+        nls = jax.device_get([a.num_leaves for _, a, _rl in results]
+                             + self._linear_nls)
+        self._linear_nls = []
         return all(int(x) <= 1 for x in nls)
 
     def _raw_grow(self, gk, hk, mask_dev, fmask):
@@ -333,6 +394,53 @@ class GBDT:
                 leaf_value=jnp.asarray(lv),
                 internal_value=arrays.internal_value * shrink)
         return _scale_tree_arrays(arrays, shrink)
+
+    def _fit_and_store_linear(self, k: int, arrays: TreeArrays, row_leaf,
+                              gk, hk, mask_dev, sk, shrink: float):
+        """Linear-tree path (reference ``LinearTreeLearner``): host
+        normal-equation solves per leaf, host score updates on raw values."""
+        from .linear import fit_leaf_linear_models, predict_linear
+
+        ub = self.train_data.binned.upper_bounds_padded
+        tree = Tree.from_arrays(arrays, ub)  # unshrunk
+        arrays = _scale_tree_arrays(arrays, shrink)
+        raw = self.train_data.raw
+        nan_bins_np = np.asarray(self.train_data.binned.nan_bins)
+        if tree.num_leaves <= 1 or raw is None:
+            arrays = arrays._replace(
+                leaf_value=jnp.zeros_like(arrays.leaf_value))
+            tree.leaf_value = np.zeros_like(tree.leaf_value)
+            tree.is_linear = True
+            tree.leaf_const = np.zeros(max(tree.num_leaves, 1))
+            tree.leaf_features = [np.zeros(0, np.int64)] * max(tree.num_leaves, 1)
+            tree.leaf_coeff = [np.zeros(0)] * max(tree.num_leaves, 1)
+            self.dev_models[k].append(arrays)
+            self._host_cache[k].append(tree)
+            self._linear_nls.append(tree.num_leaves)
+            return sk
+        rl = np.asarray(jax.device_get(row_leaf))
+        m = np.asarray(jax.device_get(mask_dev), np.float64)
+        g = np.asarray(jax.device_get(gk), np.float64) * m
+        h = np.asarray(jax.device_get(hk), np.float64) * m
+        # Solve with unshrunk stats, then one Tree::Shrinkage covers leaf
+        # values, constants and coefficients (reference tree.h:201-213).
+        fit_leaf_linear_models(
+            tree, raw, rl, g, h, self.cfg.linear_lambda,
+            np.asarray(self.train_data.binned.is_categorical))
+        tree.shrink(shrink)
+        pred = predict_linear(tree, rl, raw)
+        new_sk = sk + jnp.asarray(pred, jnp.float32)
+        self.dev_models[k].append(arrays)
+        self._host_cache[k].append(tree)
+        self._linear_nls.append(tree.num_leaves)
+        for i, (_name, vdata) in enumerate(self.valids):
+            li = tree.predict_leaf_bins(vdata.binned.bins, nan_bins_np)
+            vp = jnp.asarray(predict_linear(tree, li, vdata.raw), jnp.float32)
+            if self._shape_k:
+                self.valid_scores[i] = self.valid_scores[i].at[:, k].add(vp)
+            else:
+                self.valid_scores[i] = self.valid_scores[i] + vp
+        return new_sk
 
     # ------------------------------------------------- host model materialization
     @property
@@ -384,6 +492,8 @@ class GBDT:
         from .. import native
 
         X = np.asarray(X)
+        if self.cfg.linear_tree:
+            return self._predict_raw_linear(X, num_iteration, start_iteration)
         host_bins = self.train_data.binned.apply(X)
         nan_bins_np = self.train_data.binned.nan_bins
         n = X.shape[0]
@@ -410,6 +520,31 @@ class GBDT:
             out[:, kk] += self.init_scores[kk]
         return out[:, 0] if k == 1 else out
 
+    def _predict_raw_linear(self, X, num_iteration, start_iteration):
+        """Host prediction for linear-leaf models (leaf routing in bin space,
+        linear output on raw values)."""
+        from .linear import predict_linear
+
+        host_bins = self.train_data.binned.apply(X)
+        nan_bins_np = np.asarray(self.train_data.binned.nan_bins)
+        X64 = np.asarray(X, np.float64)
+        n, k = X.shape[0], self.num_class
+        out = np.zeros((n, k), np.float64)
+        for kk in range(k):
+            trees = self.models[kk]
+            end = len(trees) if num_iteration is None else min(
+                len(trees), start_iteration + num_iteration)
+            for tree in trees[start_iteration:end]:
+                if tree.num_leaves <= 1:
+                    continue
+                li = tree.predict_leaf_bins(host_bins, nan_bins_np)
+                if tree.is_linear:
+                    out[:, kk] += predict_linear(tree, li, X64)
+                else:
+                    out[:, kk] += np.asarray(tree.leaf_value, np.float64)[li]
+            out[:, kk] += self.init_scores[kk]
+        return out[:, 0] if k == 1 else out
+
     def predict(self, X: np.ndarray, raw_score: bool = False,
                 num_iteration: Optional[int] = None,
                 start_iteration: int = 0) -> np.ndarray:
@@ -424,9 +559,32 @@ class GBDT:
         and subtract their score contributions."""
         if self.iter_ == 0:
             return
+        from .linear import predict_linear
+        nan_bins_np = np.asarray(self.train_data.binned.nan_bins)
         for k in range(self.num_class):
             arrays = self.dev_models[k].pop()
-            self._host_cache[k].pop()
+            tree = self._host_cache[k].pop()
+            if (tree is not None and tree.is_linear
+                    and self.train_data.raw is not None):
+                li = tree.predict_leaf_bins(self.train_data.binned.bins,
+                                            nan_bins_np)
+                pred = jnp.asarray(
+                    predict_linear(tree, li, self.train_data.raw), jnp.float32)
+                if self._shape_k:
+                    self.scores = self.scores.at[:, k].add(-pred)
+                else:
+                    self.scores = self.scores - pred
+                for i, (_nm, vdata) in enumerate(self.valids):
+                    vli = tree.predict_leaf_bins(vdata.binned.bins,
+                                                 nan_bins_np)
+                    vp = jnp.asarray(predict_linear(tree, vli, vdata.raw),
+                                     jnp.float32)
+                    if self._shape_k:
+                        self.valid_scores[i] = \
+                            self.valid_scores[i].at[:, k].add(-vp)
+                    else:
+                        self.valid_scores[i] = self.valid_scores[i] - vp
+                continue
             dev_tree = _tree_dict(arrays)
             pred = predict_tree_bins_device(
                 dev_tree, self.bins_dev, self.meta_dev["nan_bins"])

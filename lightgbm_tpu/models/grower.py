@@ -102,6 +102,8 @@ class _GrowState(NamedTuple):
     best_gl: jnp.ndarray         # (L,) split child stats
     best_hl: jnp.ndarray
     best_cl: jnp.ndarray
+    feat_used: jnp.ndarray       # (F,) bool — features split on so far (CEGB)
+    leaf_path: jnp.ndarray       # (L, F) bool — features on each leaf's path
     tree: TreeArrays
 
 
@@ -139,13 +141,47 @@ def make_grower(cfg: GrowerConfig):
     L, B = cfg.num_leaves, cfg.num_bins
     M = max(L - 1, 1)
 
-    def _best_for(hist, pg, ph, pc, meta, feature_mask):
+    def _best_for(hist, pg, ph, pc, meta, feature_mask, penalty=None):
         nbpf, nan_bins, is_cat, monotone = meta
         return best_split(
             hist, pg, ph, pc,
             num_bins_per_feature=nbpf, nan_bins=nan_bins, is_categorical=is_cat,
             monotone=monotone, feature_mask=feature_mask, cfg=cfg.split,
+            gain_penalty=penalty,
         )
+
+    def _best_for_pair(hist2, pg2, ph2, pc2, meta, feature_mask, penalty2=None):
+        """Both children's split searches in one vmapped program — halves the
+        kernel count of the per-split scalar scans."""
+        nbpf, nan_bins, is_cat, monotone = meta
+
+        def one(hist, pg, ph, pc, penalty):
+            return best_split(
+                hist, pg, ph, pc,
+                num_bins_per_feature=nbpf, nan_bins=nan_bins,
+                is_categorical=is_cat, monotone=monotone,
+                feature_mask=feature_mask, cfg=cfg.split,
+                gain_penalty=penalty,
+            )
+
+        if penalty2 is None:
+            return jax.vmap(lambda h, g, hh, c: one(h, g, hh, c, None))(
+                hist2, pg2, ph2, pc2)
+        return jax.vmap(one)(hist2, pg2, ph2, pc2, penalty2)
+
+    def _cegb_penalty(count, feat_used, path_used, coupled, lazy):
+        """Per-feature gain penalty (reference CEGB ``DeltaGain``):
+        tradeoff * (penalty_split*count + coupled[f]*first-use-in-model
+        + lazy[f]*rows-not-yet-scanned).  Lazy uses per-leaf path tracking
+        (exact within a tree; the reference's cross-tree per-row bitset is
+        approximated by the path of the current tree)."""
+        if not cfg.split.use_cegb:
+            return None
+        t = cfg.split.cegb_tradeoff
+        pen = jnp.full_like(coupled, t * cfg.split.cegb_penalty_split * count)
+        pen = pen + t * coupled * (~feat_used)
+        pen = pen + t * lazy * count * (~path_used)
+        return pen
 
     def _init_state(n, f, root_hist, root_g, root_h, root_c):
         tree = TreeArrays(
@@ -185,6 +221,8 @@ def make_grower(cfg: GrowerConfig):
             best_gl=jnp.zeros(L, jnp.float32),
             best_hl=jnp.zeros(L, jnp.float32),
             best_cl=jnp.zeros(L, jnp.float32),
+            feat_used=jnp.zeros(f, bool),
+            leaf_path=jnp.zeros((L, f), bool),
             tree=tree,
         )
 
@@ -225,32 +263,63 @@ def make_grower(cfg: GrowerConfig):
         )
 
     def _children_updates(st, leaf, new_leaf, hist_left, hist_right,
-                          gl, hl, cl, gr, hr, cr, meta, feature_mask):
-        """Store child stats + their best splits."""
+                          gl, hl, cl, gr, hr, cr, meta, feature_mask,
+                          cegb=None):
+        """Store child stats + their best splits (both children batched into
+        single 2-row scatters to minimize kernel count in the hot loop)."""
         depth = st.leaf_depth[leaf] + 1
         node = st.num_leaves - 1
+        pair = jnp.stack([leaf, new_leaf])
+        penalty2 = None
+        if cfg.split.use_cegb and cegb is not None:
+            coupled, lazy = cegb
+            feat = st.best_feature[leaf]
+            fhot = jnp.arange(st.feat_used.shape[0]) == feat
+            feat_used = st.feat_used | fhot
+            child_path = st.leaf_path[leaf] | fhot
+            st = st._replace(
+                feat_used=feat_used,
+                leaf_path=st.leaf_path.at[pair].set(
+                    jnp.stack([child_path, child_path])),
+            )
+            penalty2 = jnp.stack([
+                _cegb_penalty(cl, feat_used, child_path, coupled, lazy),
+                _cegb_penalty(cr, feat_used, child_path, coupled, lazy),
+            ])
+        hist2 = jnp.stack([hist_left, hist_right])
+        g2 = jnp.stack([gl, gr])
+        h2 = jnp.stack([hl, hr])
+        c2 = jnp.stack([cl, cr])
         st = st._replace(
             num_leaves=st.num_leaves + 1,
-            leaf_hist=st.leaf_hist.at[leaf].set(hist_left)
-                                  .at[new_leaf].set(hist_right),
-            leaf_sum_grad=st.leaf_sum_grad.at[leaf].set(gl).at[new_leaf].set(gr),
-            leaf_sum_hess=st.leaf_sum_hess.at[leaf].set(hl).at[new_leaf].set(hr),
-            leaf_count=st.leaf_count.at[leaf].set(cl).at[new_leaf].set(cr),
-            leaf_depth=st.leaf_depth.at[leaf].set(depth).at[new_leaf].set(depth),
-            leaf_parent=st.leaf_parent.at[leaf].set(node).at[new_leaf].set(node),
-            leaf_is_left=st.leaf_is_left.at[leaf].set(True)
-                                        .at[new_leaf].set(False),
+            leaf_hist=st.leaf_hist.at[pair].set(hist2),
+            leaf_sum_grad=st.leaf_sum_grad.at[pair].set(g2),
+            leaf_sum_hess=st.leaf_sum_hess.at[pair].set(h2),
+            leaf_count=st.leaf_count.at[pair].set(c2),
+            leaf_depth=st.leaf_depth.at[pair].set(jnp.stack([depth, depth])),
+            leaf_parent=st.leaf_parent.at[pair].set(jnp.stack([node, node])),
+            leaf_is_left=st.leaf_is_left.at[pair].set(
+                jnp.asarray([True, False])),
         )
         depth_ok = jnp.asarray(True) if cfg.max_depth <= 0 \
             else depth < cfg.max_depth
-        bs_l = _best_for(hist_left, gl, hl, cl, meta, feature_mask)
-        bs_r = _best_for(hist_right, gr, hr, cr, meta, feature_mask)
-        st = _store_best(st, leaf, bs_l, depth_ok)
-        st = _store_best(st, new_leaf, bs_r, depth_ok)
-        return st
+        bs2 = _best_for_pair(hist2, g2, h2, c2, meta, feature_mask, penalty2)
+        gain2 = jnp.where(depth_ok, bs2.gain, _NEG_INF)
+        return st._replace(
+            best_gain=st.best_gain.at[pair].set(gain2),
+            best_feature=st.best_feature.at[pair].set(bs2.feature),
+            best_bin=st.best_bin.at[pair].set(bs2.bin),
+            best_default_left=st.best_default_left.at[pair].set(
+                bs2.default_left),
+            best_is_cat=st.best_is_cat.at[pair].set(bs2.is_cat),
+            best_cat_mask=st.best_cat_mask.at[pair].set(bs2.cat_mask),
+            best_gl=st.best_gl.at[pair].set(bs2.sum_grad_left),
+            best_hl=st.best_hl.at[pair].set(bs2.sum_hess_left),
+            best_cl=st.best_cl.at[pair].set(bs2.count_left),
+        )
 
     # ------------------------------------------------------------------ perm path
-    def _grow_perm(bins, g, h, in_bag, feature_mask, meta):
+    def _grow_perm(bins, g, h, in_bag, feature_mask, meta, cegb=None):
         """Permutation-layout growth (single device)."""
         n, f = bins.shape
         nan_bins = meta[1]
@@ -271,20 +340,20 @@ def make_grower(cfg: GrowerConfig):
 
         state = _init_state(n, f, root_hist, root_g, root_h, root_c)
         state = state._replace(perm=perm0)
+        root_pen = None
+        if cfg.split.use_cegb and cegb is not None:
+            root_pen = _cegb_penalty(root_c, state.feat_used,
+                                     state.leaf_path[0], *cegb)
         root_bs = _best_for(root_hist, root_g, root_h, root_c, meta,
-                            feature_mask)
+                            feature_mask, root_pen)
         state = _store_best(state, jnp.asarray(0), root_bs, jnp.asarray(True))
 
-        def _make_branch(S):
-            def branch(perm, start, cnt, feat, sbin, dleft, scat, cmask,
-                       small_is_left):
+        def _make_part_branch(S):
+            """Partition the leaf's slice (cheap S-ops; no histogram)."""
+            def branch(perm, start, cnt, feat, sbin, dleft, scat, cmask):
                 seg = jax.lax.dynamic_slice(perm, (start,), (S,))
                 valid = jnp.arange(S, dtype=jnp.int32) < cnt
-                bseg = bins_pad[seg]                       # (S, F)
-                vseg = vals_pad[seg]                       # (S, 3)
-                col = jnp.take_along_axis(
-                    bseg, jnp.full((S, 1), feat, jnp.int32), axis=1
-                )[:, 0].astype(jnp.int32)
+                col = bins_pad[seg, feat].astype(jnp.int32)
                 is_nan = col == nan_bins[feat]
                 go_left = jnp.where(scat, cmask[col], col <= sbin)
                 go_left = jnp.where(is_nan & ~scat, dleft, go_left)
@@ -298,17 +367,31 @@ def make_grower(cfg: GrowerConfig):
                                           jnp.arange(S, dtype=jnp.int32)))
                 new_seg = jnp.zeros(S, jnp.int32).at[pos].set(seg)
                 perm = jax.lax.dynamic_update_slice(perm, new_seg, (start,))
-                # Histogram of the smaller child (by in-bag count), masked from
-                # the slice — the sibling comes from parent-hist subtraction.
-                w = jnp.where(small_is_left, go_left, go_right)
-                hist_small = histogram_from_vals(
-                    bseg, vseg * w[:, None].astype(vseg.dtype), num_bins=B,
-                    impl=cfg.histogram_impl,
-                    rows_block=min(cfg.rows_block, S))
-                return perm, nl_phys, hist_small
+                return perm, nl_phys
             return branch
 
-        branches = [_make_branch(S) for S in buckets]
+        def _make_hist_branch(S):
+            """Histogram of a contiguous child range (the smaller sibling —
+            the larger one comes from parent-hist subtraction, the
+            reference's FeatureHistogram::Subtract)."""
+            def branch(perm, start, cnt):
+                seg = jax.lax.dynamic_slice(perm, (start,), (S,))
+                valid = jnp.arange(S, dtype=jnp.int32) < cnt
+                seg = jnp.where(valid, seg, n)
+                bseg = bins_pad[seg]                       # (S, F)
+                vseg = vals_pad[seg]                       # (S, 3)
+                return histogram_from_vals(
+                    bseg, vseg, num_bins=B,
+                    impl=cfg.histogram_impl,
+                    rows_block=min(cfg.rows_block, S))
+            return branch
+
+        part_branches = [_make_part_branch(S) for S in buckets]
+        hist_branches = [_make_hist_branch(S) for S in buckets]
+
+        def _bucket_of(cnt):
+            return jnp.clip(jnp.searchsorted(buckets_arr, cnt, side="left"),
+                            0, len(buckets) - 1).astype(jnp.int32)
 
         def body(st: _GrowState) -> _GrowState:
             leaf = jnp.argmax(st.best_gain).astype(jnp.int32)
@@ -320,20 +403,26 @@ def make_grower(cfg: GrowerConfig):
                           st.leaf_count[leaf])
             gl, hl, cl = st.best_gl[leaf], st.best_hl[leaf], st.best_cl[leaf]
             gr, hr, cr = pg - gl, ph - hl, pc - cl
-            small_is_left = cl <= cr
 
-            j = jnp.clip(jnp.searchsorted(buckets_arr, cnt, side="left"),
-                         0, len(buckets) - 1).astype(jnp.int32)
-            perm, nl_phys, hist_small = jax.lax.switch(
-                j, branches, st.perm, start, cnt,
+            perm, nl_phys = jax.lax.switch(
+                _bucket_of(cnt), part_branches, st.perm, start, cnt,
                 st.best_feature[leaf], st.best_bin[leaf],
                 st.best_default_left[leaf], st.best_is_cat[leaf],
-                st.best_cat_mask[leaf], small_is_left)
+                st.best_cat_mask[leaf])
+            # Histogram ONLY the physically smaller child's contiguous range
+            # (its own, usually much smaller, bucket) — the expensive op scales
+            # with the smaller sibling, exactly like the reference's serial
+            # learner; the sibling comes from parent-hist subtraction.
+            small_left = nl_phys <= cnt - nl_phys
+            hs_start = jnp.where(small_left, start, start + nl_phys)
+            hs_cnt = jnp.minimum(nl_phys, cnt - nl_phys)
+            hist_small = jax.lax.switch(
+                _bucket_of(hs_cnt), hist_branches, perm, hs_start, hs_cnt)
 
             hist_parent = st.leaf_hist[leaf]
             hist_big = hist_parent - hist_small
-            hist_left = jnp.where(small_is_left, hist_small, hist_big)
-            hist_right = jnp.where(small_is_left, hist_big, hist_small)
+            hist_left = jnp.where(small_left, hist_small, hist_big)
+            hist_right = jnp.where(small_left, hist_big, hist_small)
 
             tree = _update_tree(st, leaf, new_leaf, node, pg, ph, pc)
             st = st._replace(
@@ -344,7 +433,8 @@ def make_grower(cfg: GrowerConfig):
                                       .at[new_leaf].set(cnt - nl_phys),
             )
             return _children_updates(st, leaf, new_leaf, hist_left, hist_right,
-                                     gl, hl, cl, gr, hr, cr, meta, feature_mask)
+                                     gl, hl, cl, gr, hr, cr, meta, feature_mask,
+                                     cegb)
 
         def cond(st: _GrowState):
             return (st.num_leaves < L) & (jnp.max(st.best_gain) > _NEG_INF)
@@ -364,7 +454,7 @@ def make_grower(cfg: GrowerConfig):
         return _finish(state), row_leaf
 
     # ------------------------------------------------------------------ mask path
-    def _grow_mask(bins, g, h, in_bag, feature_mask, meta):
+    def _grow_mask(bins, g, h, in_bag, feature_mask, meta, cegb=None):
         """Mask-layout growth (sharding-friendly; full-N pass per split)."""
         n, f = bins.shape
 
@@ -380,8 +470,12 @@ def make_grower(cfg: GrowerConfig):
         root_g, root_h, root_c = root_tot[0], root_tot[1], root_tot[2]
         state = _init_state(n, f, root_hist, root_g, root_h, root_c)
         row_leaf0 = jnp.zeros(n, jnp.int32)
+        root_pen = None
+        if cfg.split.use_cegb and cegb is not None:
+            root_pen = _cegb_penalty(root_c, state.feat_used,
+                                     state.leaf_path[0], *cegb)
         root_bs = _best_for(root_hist, root_g, root_h, root_c, meta,
-                            feature_mask)
+                            feature_mask, root_pen)
         state = _store_best(state, jnp.asarray(0), root_bs, jnp.asarray(True))
 
         def body(carry):
@@ -422,7 +516,8 @@ def make_grower(cfg: GrowerConfig):
             tree = _update_tree(st, leaf, new_leaf, node, pg, ph, pc)
             st = st._replace(tree=tree)
             st = _children_updates(st, leaf, new_leaf, hist_left, hist_right,
-                                   gl, hl, cl, gr, hr, cr, meta, feature_mask)
+                                   gl, hl, cl, gr, hr, cr, meta, feature_mask,
+                                   cegb)
             return st, row_leaf
 
         def cond(carry):
@@ -443,13 +538,23 @@ def make_grower(cfg: GrowerConfig):
         nan_bins: jnp.ndarray,
         is_categorical: jnp.ndarray,
         monotone: jnp.ndarray,      # (F,) i32
+        cegb_coupled: Optional[jnp.ndarray] = None,  # (F,) f32 (CEGB)
+        cegb_lazy: Optional[jnp.ndarray] = None,     # (F,) f32 (CEGB)
     ) -> Tuple[TreeArrays, jnp.ndarray]:
         meta = (num_bins_per_feature, nan_bins, is_categorical, monotone)
+        cegb = None
+        if cfg.split.use_cegb:
+            f = bins.shape[1]
+            coupled = (cegb_coupled if cegb_coupled is not None
+                       else jnp.zeros(f, jnp.float32))
+            lazy = (cegb_lazy if cegb_lazy is not None
+                    else jnp.zeros(f, jnp.float32))
+            cegb = (coupled, lazy)
         g = grad * sample_mask
         h = hess * sample_mask
         in_bag = sample_mask > 0.0
         if cfg.gather_rows and bins.shape[0] > _MIN_BUCKET:
-            return _grow_perm(bins, g, h, in_bag, feature_mask, meta)
-        return _grow_mask(bins, g, h, in_bag, feature_mask, meta)
+            return _grow_perm(bins, g, h, in_bag, feature_mask, meta, cegb)
+        return _grow_mask(bins, g, h, in_bag, feature_mask, meta, cegb)
 
     return grow

@@ -1,0 +1,122 @@
+"""Linear trees: per-leaf linear models.
+
+Reference: ``LinearTreeLearner`` (``src/treelearner/linear_tree_learner.h:34``,
+``.cpp CalculateLinear``) — after the tree structure is grown, each leaf gets a
+linear model over the *numerical* features used on its path, solved from the
+gradient statistics:  ``coeffs = -(X^T H X + lambda*I)^-1 (X^T g)`` with X the
+leaf's rows of [path features | 1] (Eq. 3 of arXiv:1802.05640).
+
+The tree growth stays on device; the per-leaf normal-equation solves are small
+(d <= depth) and branchy, so they run on host exactly like the reference's
+Eigen solves (which are host-side even in its CUDA build).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+_ZERO_THRESHOLD = 1e-35
+
+
+def leaf_path_features(tree, num_features: int,
+                       is_categorical: Optional[np.ndarray]) -> List[np.ndarray]:
+    """Per-leaf sorted unique numerical features on the root->leaf path
+    (reference ``Tree::branch_features``)."""
+    m = tree.num_splits()
+    feats: List[List[int]] = [[] for _ in range(tree.num_leaves)]
+    if m == 0:
+        return [np.zeros(0, np.int64) for _ in range(max(tree.num_leaves, 1))]
+
+    def walk(node: int, path: List[int]):
+        f = int(tree.split_feature[node])
+        new_path = path + [f]
+        for child in (int(tree.left_child[node]), int(tree.right_child[node])):
+            if child < 0:
+                feats[~child] = new_path
+            else:
+                walk(child, new_path)
+
+    walk(0, [])
+    out = []
+    for lf in feats:
+        u = np.unique(np.asarray(lf, np.int64))
+        if is_categorical is not None and len(u):
+            u = u[~is_categorical[u]]
+        out.append(u)
+    return out
+
+
+def fit_leaf_linear_models(tree, X: np.ndarray, row_leaf: np.ndarray,
+                           grad: np.ndarray, hess: np.ndarray,
+                           linear_lambda: float,
+                           is_categorical: Optional[np.ndarray] = None) -> None:
+    """Fit and attach linear models to ``tree`` (mutates ``tree``).
+
+    Mirrors ``LinearTreeLearner::CalculateLinear``: rows whose leaf features
+    contain NaN are excluded from the solve (they fall back to the plain leaf
+    value at prediction); a leaf with fewer usable rows than coefficients
+    keeps its constant output.
+    """
+    nl = tree.num_leaves
+    feats = leaf_path_features(tree, X.shape[1], is_categorical)
+    order = np.argsort(row_leaf, kind="stable")
+    bounds = np.searchsorted(row_leaf[order], np.arange(nl + 1))
+    leaf_const = np.asarray(tree.leaf_value[:nl], np.float64).copy()
+    leaf_features: List[np.ndarray] = []
+    leaf_coeffs: List[np.ndarray] = []
+    for l in range(nl):
+        fl = feats[l] if l < len(feats) else np.zeros(0, np.int64)
+        rows = order[bounds[l]: bounds[l + 1]]
+        d = len(fl)
+        if d == 0 or len(rows) == 0:
+            leaf_features.append(np.zeros(0, np.int64))
+            leaf_coeffs.append(np.zeros(0, np.float64))
+            continue
+        Xl = X[rows][:, fl].astype(np.float64)
+        ok = ~np.isnan(Xl).any(axis=1)
+        if ok.sum() < d + 1:
+            leaf_features.append(np.zeros(0, np.int64))
+            leaf_coeffs.append(np.zeros(0, np.float64))
+            continue
+        Xl = Xl[ok]
+        g = grad[rows][ok].astype(np.float64)
+        h = hess[rows][ok].astype(np.float64)
+        Xa = np.concatenate([Xl, np.ones((len(Xl), 1))], axis=1)
+        XTH = Xa.T * h[None, :]
+        A = XTH @ Xa
+        A[np.arange(d), np.arange(d)] += linear_lambda
+        b = Xa.T @ g
+        try:
+            coeffs = -np.linalg.solve(A, b)
+        except np.linalg.LinAlgError:
+            coeffs = -np.linalg.lstsq(A, b, rcond=None)[0]
+        keep = np.abs(coeffs[:d]) > _ZERO_THRESHOLD
+        leaf_features.append(fl[keep])
+        leaf_coeffs.append(coeffs[:d][keep])
+        leaf_const[l] = coeffs[d]
+    tree.is_linear = True
+    tree.leaf_const = leaf_const
+    tree.leaf_features = leaf_features
+    tree.leaf_coeff = leaf_coeffs
+
+
+def predict_linear(tree, leaf_idx: np.ndarray, X: np.ndarray) -> np.ndarray:
+    """Linear-leaf prediction: ``const + sum coef*x``; rows with NaN in the
+    leaf's features fall back to the plain leaf value (reference
+    ``Tree::PredictLinear``)."""
+    out = np.asarray(tree.leaf_value, np.float64)[leaf_idx].copy()
+    for l in range(tree.num_leaves):
+        sel = np.nonzero(leaf_idx == l)[0]
+        if len(sel) == 0:
+            continue
+        fl = tree.leaf_features[l]
+        vals = np.full(len(sel), tree.leaf_const[l])
+        if len(fl):
+            Xl = X[sel][:, fl].astype(np.float64)
+            nan = np.isnan(Xl).any(axis=1)
+            vals = vals + Xl @ tree.leaf_coeff[l]
+            vals[nan] = tree.leaf_value[l]
+        out[sel] = vals
+    return out

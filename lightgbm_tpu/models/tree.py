@@ -44,6 +44,11 @@ class Tree:
     leaf_weight: np.ndarray      # (L,) f32
     num_leaves: int
     shrinkage: float = 1.0
+    # Linear-tree extras (reference Tree is_linear_/leaf_const_/leaf_coeff_)
+    is_linear: bool = False
+    leaf_const: Optional[np.ndarray] = None
+    leaf_features: Optional[list] = None
+    leaf_coeff: Optional[list] = None
 
     @classmethod
     def from_arrays(
@@ -80,10 +85,14 @@ class Tree:
         )
 
     def shrink(self, rate: float) -> None:
-        """Reference ``Tree::Shrinkage`` — scales leaf and internal outputs."""
+        """Reference ``Tree::Shrinkage`` — scales leaf and internal outputs
+        (incl. linear constants/coefficients, ``tree.h:201-213``)."""
         self.leaf_value = self.leaf_value * rate
         self.internal_value = self.internal_value * rate
         self.shrinkage *= rate
+        if self.is_linear:
+            self.leaf_const = self.leaf_const * rate
+            self.leaf_coeff = [c * rate for c in self.leaf_coeff]
 
     # ------------------------------------------------------------------ predict
     def predict_bins(self, bins: np.ndarray, nan_bins: np.ndarray) -> np.ndarray:
@@ -110,6 +119,34 @@ class Tree:
             nxt = np.where(gl, self.left_child[nd], self.right_child[nd])
             leaf = nxt < 0
             out[idx[leaf]] = self.leaf_value[~nxt[leaf]]
+            node[idx[~leaf]] = nxt[~leaf]
+            active[idx[leaf]] = False
+        return out
+
+    def predict_leaf_bins(self, bins: np.ndarray,
+                          nan_bins: np.ndarray) -> np.ndarray:
+        """Leaf index per row, host traversal in bin space."""
+        n = bins.shape[0]
+        out = np.zeros(n, np.int32)
+        if self.num_leaves <= 1:
+            return out
+        node = np.zeros(n, np.int32)
+        active = np.ones(n, bool)
+        while active.any():
+            idx = np.nonzero(active)[0]
+            nd = node[idx]
+            f = self.split_feature[nd]
+            col = bins[idx, f].astype(np.int64)
+            isnan = col == nan_bins[f]
+            gl = np.where(
+                self.is_cat[nd],
+                self.cat_mask[nd, np.minimum(col, self.cat_mask.shape[1] - 1)],
+                col <= self.split_bin[nd],
+            )
+            gl = np.where(isnan & ~self.is_cat[nd], self.default_left[nd], gl)
+            nxt = np.where(gl, self.left_child[nd], self.right_child[nd])
+            leaf = nxt < 0
+            out[idx[leaf]] = ~nxt[leaf]
             node[idx[~leaf]] = nxt[~leaf]
             active[idx[leaf]] = False
         return out

@@ -40,6 +40,16 @@ class SplitConfig:
     max_cat_threshold: int = 32
     max_cat_to_onehot: int = 4
     path_smooth: float = 0.0
+    # Static dataset facts (set from the bin mappers) that let the compiled
+    # scan skip whole candidate families.  True = "may be present" (safe).
+    has_nan: bool = True
+    has_categorical: bool = True
+    has_monotone: bool = True
+    # Cost-effective gradient boosting (reference
+    # ``cost_effective_gradient_boosting.hpp:79`` DeltaGain).
+    use_cegb: bool = False
+    cegb_tradeoff: float = 1.0
+    cegb_penalty_split: float = 0.0
 
 
 class BestSplit(NamedTuple):
@@ -92,6 +102,8 @@ def best_split(
     monotone: jnp.ndarray | None,       # (F,) i32 in {-1,0,1} or None
     feature_mask: jnp.ndarray,          # (F,) bool (feature_fraction / interaction)
     cfg: SplitConfig,
+    gain_penalty: jnp.ndarray | None = None,  # (F,) subtracted from every gain
+                                              # (CEGB DeltaGain)
 ) -> BestSplit:
     """Evaluate every (feature, threshold, missing-direction) candidate and argmax."""
     f, b, _ = hist.shape
@@ -131,13 +143,18 @@ def best_split(
 
     # Numerical: threshold t means "value-bin <= t goes left".
     gain_mr, stats_mr = eval_dir(cumG, cumH, cumC)                    # NaN -> right
-    gain_ml, stats_ml = eval_dir(cumG + Gn[:, None], cumH + Hn[:, None],
-                                 cumC + Cn[:, None])                  # NaN -> left
-    # Without a NaN bin both directions coincide; keep the missing-right variant.
-    has_nan = (nan_bins < b)[:, None]
-    gain_ml = jnp.where(has_nan, gain_ml, -jnp.inf)
-    num_gain = jnp.maximum(gain_mr, gain_ml)
-    num_default_left = gain_ml > gain_mr
+    if cfg.has_nan:
+        gain_ml, stats_ml = eval_dir(cumG + Gn[:, None], cumH + Hn[:, None],
+                                     cumC + Cn[:, None])              # NaN -> left
+        # Without a NaN bin both directions coincide; keep missing-right.
+        has_nan = (nan_bins < b)[:, None]
+        gain_ml = jnp.where(has_nan, gain_ml, -jnp.inf)
+        num_gain = jnp.maximum(gain_mr, gain_ml)
+        num_default_left = gain_ml > gain_mr
+    else:
+        stats_ml = stats_mr
+        num_gain = gain_mr
+        num_default_left = jnp.zeros_like(gain_mr, bool)
     num_gain = jnp.where(value_mask, num_gain, -jnp.inf)
 
     # Categorical one-hot: "bin == k goes left" (reference one-hot branch of
@@ -157,13 +174,17 @@ def best_split(
         gain = jnp.where(valid & (gain > cfg.min_gain_to_split + _EPS), gain, -jnp.inf)
         return gain, (GL, HL, CL, GR, HR, CR)
 
-    cat_gain, cat_stats = eval_cat(G, H, C)
-    cat_gain = jnp.where(in_feature, cat_gain, -jnp.inf)
+    if cfg.has_categorical:
+        cat_gain, cat_stats = eval_cat(G, H, C)
+        cat_gain = jnp.where(in_feature, cat_gain, -jnp.inf)
+        is_cat_col = is_categorical[:, None]
+        gain_fb = jnp.where(is_cat_col, cat_gain, num_gain)
+    else:
+        cat_stats = stats_mr
+        is_cat_col = jnp.zeros_like(is_categorical, bool)[:, None]
+        gain_fb = num_gain
 
-    is_cat_col = is_categorical[:, None]
-    gain_fb = jnp.where(is_cat_col, cat_gain, num_gain)
-
-    if monotone is not None:
+    if monotone is not None and cfg.has_monotone:
         # Basic monotone mode: reject splits whose child outputs violate the
         # direction (reference monotone_constraints.hpp BasicLeafConstraints).
         GLm = jnp.where(is_cat_col, cat_stats[0], jnp.where(num_default_left,
@@ -178,13 +199,20 @@ def best_split(
         viol = ((mono > 0) & (out_l > out_r)) | ((mono < 0) & (out_l < out_r))
         gain_fb = jnp.where(viol, -jnp.inf, gain_fb)
 
+    if gain_penalty is not None and cfg.use_cegb:
+        gain_fb = gain_fb - gain_penalty[:, None]
+        # Penalized gains that drop to <= 0 are no longer worth splitting
+        # (reference stops on "gain <= 0").
+        gain_fb = jnp.where(gain_fb > _EPS, gain_fb, -jnp.inf)
+
     gain_fb = jnp.where(feature_mask[:, None], gain_fb, -jnp.inf)
 
     flat = jnp.argmax(gain_fb)
     bf = (flat // b).astype(jnp.int32)
     bb = (flat % b).astype(jnp.int32)
     bgain = gain_fb[bf, bb]
-    bis_cat = is_categorical[bf]
+    bis_cat = (is_categorical[bf] if cfg.has_categorical
+               else jnp.asarray(False))
     bdefault_left = jnp.where(bis_cat, False, num_default_left[bf, bb])
 
     def pick(stats_cat, stats_numl, stats_numr, i):

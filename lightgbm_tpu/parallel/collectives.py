@@ -1,0 +1,169 @@
+"""Explicit collective-communication primitives over a device mesh.
+
+Reference counterpart: the L1 ``Network`` layer (``include/LightGBM/network.h:89``,
+``src/network/network.cpp``) — ``Allreduce`` (``network.cpp:68``),
+``ReduceScatter`` (recursive halving, ``network.cpp:232``), ``Allgather``
+(Bruck, ``network.cpp:121``), typed scalar syncs (``network.h:168-275``) — and
+their call sites in the parallel tree learners
+(``data_parallel_tree_learner.cpp:284`` histogram ReduceScatter,
+``parallel_tree_learner.h`` ``SyncUpGlobalBestSplit``,
+``voting_parallel_tree_learner.cpp`` ``GlobalVoting``).
+
+TPU re-design: collectives are XLA ops over ICI/DCN issued inside
+``shard_map`` — ``psum_scatter`` replaces recursive-halving ReduceScatter,
+``all_gather`` replaces Bruck, ``psum/pmin/pmax`` replace the typed scalar
+syncs.  The topology construction (BruckMap/RecursiveHalvingMap) has no
+equivalent: XLA's collective scheduler owns the routing.
+
+These primitives are the seams the distributed tree learners use; they are
+also directly testable against local reductions on a virtual CPU mesh
+(the reference's localhost mock-cluster pattern).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import DATA_AXIS
+
+
+def histogram_reduce_scatter(local_hist: jnp.ndarray, mesh: Mesh,
+                             axis: str = DATA_AXIS) -> jnp.ndarray:
+    """Sum per-shard histograms and leave each shard owning a feature block.
+
+    Reference: ``DataParallelTreeLearner::FindBestSplits`` —
+    ``Network::ReduceScatter(input_buffer, reduce_scatter_size, ...,
+    HistogramSumReducer)`` (``data_parallel_tree_learner.cpp:284``): every rank
+    contributes full local histograms and receives the globally-summed
+    histograms of its owned features.
+
+    ``local_hist``: (F, B, C) with one copy per device along ``axis`` (i.e. a
+    shard_map-local value or an array sharded (axis, ...) holding per-shard
+    partials).  Returns (F/K, B, C) per shard, concatenated to (F, B, C) in
+    the global view sharded along features.
+    """
+    nshards = mesh.shape[axis]
+    f = local_hist.shape[0]
+    if f % nshards != 0:
+        pad = nshards - f % nshards
+        local_hist = jnp.pad(local_hist, ((0, pad), (0, 0), (0, 0)))
+
+    def body(h):
+        # h: this shard's full-F local histogram -> (F/K, B, C) owned block.
+        return jax.lax.psum_scatter(h, axis, scatter_dimension=0, tiled=True)
+
+    return shard_map(
+        body, mesh=mesh,
+        in_specs=P(axis),      # stacked per-shard partials
+        out_specs=P(axis),
+    )(local_hist)
+
+
+def allgather_histogram(owned: jnp.ndarray, mesh: Mesh,
+                        axis: str = DATA_AXIS) -> jnp.ndarray:
+    """Inverse of the scatter: every shard receives all owned blocks
+    (reference Bruck ``Network::Allgather``, ``network.cpp:121``)."""
+    def body(h):
+        return jax.lax.all_gather(h, axis, axis=0, tiled=True)
+
+    return shard_map(body, mesh=mesh, in_specs=P(axis), out_specs=P(),
+                     check_rep=False)(owned)
+
+
+def sync_global_best_split(gains: jnp.ndarray, payload: jnp.ndarray,
+                           mesh: Mesh, axis: str = DATA_AXIS
+                           ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Argmax-by-gain across shards, returning the winning payload everywhere.
+
+    Reference: ``SyncUpGlobalBestSplit`` (``parallel_tree_learner.h``) —
+    Allgather the serialized per-rank best ``SplitInfo`` and pick max gain.
+    ``gains``: per-shard scalar (sharded along ``axis``); ``payload``: per-shard
+    1-D serialized split record.
+    """
+    def body(g, p):
+        all_g = jax.lax.all_gather(g, axis, tiled=True)           # (K,)
+        all_p = jax.lax.all_gather(p, axis, axis=0, tiled=True)   # (K, R)
+        win = jnp.argmax(all_g)
+        return all_g[win], all_p[win]
+
+    return shard_map(
+        body, mesh=mesh,
+        in_specs=(P(axis), P(axis, None)),
+        out_specs=(P(), P()),
+        check_rep=False,
+    )(gains, payload)
+
+
+def _scalar_sync(reduce_fn, value: jnp.ndarray, mesh: Mesh,
+                 axis: str) -> jnp.ndarray:
+    def body(v):
+        return reduce_fn(v, axis)
+
+    return shard_map(body, mesh=mesh, in_specs=P(axis), out_specs=P(),
+                     check_rep=False)(value)
+
+
+def global_sum(value: jnp.ndarray, mesh: Mesh,
+               axis: str = DATA_AXIS) -> jnp.ndarray:
+    """reference ``Network::GlobalSyncUpBySum`` (``network.h:239``)."""
+    return _scalar_sync(jax.lax.psum, value, mesh, axis)
+
+
+def global_min(value: jnp.ndarray, mesh: Mesh,
+               axis: str = DATA_AXIS) -> jnp.ndarray:
+    """reference ``Network::GlobalSyncUpByMin`` (``network.h:168``)."""
+    return _scalar_sync(jax.lax.pmin, value, mesh, axis)
+
+
+def global_max(value: jnp.ndarray, mesh: Mesh,
+               axis: str = DATA_AXIS) -> jnp.ndarray:
+    """reference ``Network::GlobalSyncUpByMax`` (``network.h:203``)."""
+    return _scalar_sync(jax.lax.pmax, value, mesh, axis)
+
+
+def global_mean(value: jnp.ndarray, weight: jnp.ndarray, mesh: Mesh,
+                axis: str = DATA_AXIS) -> jnp.ndarray:
+    """Weighted mean across shards (reference ``GlobalSyncUpByMean``,
+    ``network.h:263`` — used by boost-from-average, ``gbdt.cpp:313``)."""
+    def body(v, w):
+        return jax.lax.psum(v * w, axis) / jnp.maximum(
+            jax.lax.psum(w, axis), 1e-35)
+
+    return shard_map(body, mesh=mesh, in_specs=(P(axis), P(axis)),
+                     out_specs=P(), check_rep=False)(value, weight)
+
+
+# ----------------------------------------------------------------- voting mode
+def global_feature_vote(local_gains: jnp.ndarray, top_k: int, mesh: Mesh,
+                        axis: str = DATA_AXIS) -> jnp.ndarray:
+    """PV-Tree voting (reference ``VotingParallelTreeLearner::GlobalVoting``,
+    ``voting_parallel_tree_learner.cpp:~150``): each shard proposes its local
+    top-k features by split gain; votes are summed globally and the top-2k
+    features win.  Only the winners' histograms then cross the network.
+
+    ``local_gains``: (K, F) per-shard best gain per feature (sharded along
+    ``axis``).  Returns a replicated (F,) bool mask of the selected features.
+    """
+    f = local_gains.shape[-1]
+    k = min(top_k, f)
+
+    def body(gains):
+        g = gains[0]                                    # this shard's (F,)
+        _, top_idx = jax.lax.top_k(g, k)
+        votes = jnp.zeros(f, jnp.int32).at[top_idx].add(1)
+        votes = jax.lax.psum(votes, axis)               # global vote count
+        # winners: top-2k features by votes (gain as tie-break)
+        score = votes.astype(jnp.float32) * 1e6 + jax.lax.psum(g, axis)
+        _, win = jax.lax.top_k(score, min(2 * k, f))
+        return jnp.zeros(f, bool).at[win].set(True)[None]
+
+    mask = shard_map(body, mesh=mesh, in_specs=P(axis),
+                     out_specs=P(axis))(local_gains)
+    # All shards compute identical masks; take the first replica.
+    return mask[0]

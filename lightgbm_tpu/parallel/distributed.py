@@ -1,0 +1,121 @@
+"""Multi-host distributed bootstrap.
+
+Reference counterpart: the machine-list/rank bootstrap of the socket transport
+(``src/network/linkers_socket.cpp:24-60`` — parse ``machines`` /
+``machine_list_file``, derive own rank by matching local addresses, connect a
+full mesh) and MPI's rank/size discovery (``linkers_mpi.cpp:11-27``), plus the
+CLI wiring ``Application::InitTrain -> Network::Init``
+(``src/application/application.cpp:171``).
+
+TPU re-design: process bootstrap is ``jax.distributed.initialize`` (rank 0 is
+the coordinator; JAX/ICI own all transport), after which every process sees the
+global device set and builds the same ``Mesh``.  The reference's ``machines``
+config keys are accepted for CLI compatibility: the first entry becomes the
+coordinator address and the rank is derived from the list position, exactly
+like the reference derives it from matching local addresses.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+
+from ..config import Config
+from ..utils.log import Log
+from .mesh import make_mesh
+
+log_info = Log.info
+log_warning = Log.warning
+
+
+def parse_machine_list(cfg: Config) -> List[str]:
+    """``machines`` param or ``machine_list_file`` lines, ``ip:port`` each
+    (reference ``Linkers::Linkers``, ``linkers_socket.cpp:24``)."""
+    if getattr(cfg, "machines", ""):
+        entries = [m.strip() for m in str(cfg.machines).split(",") if m.strip()]
+    elif getattr(cfg, "machine_list_filename", ""):
+        with open(cfg.machine_list_filename) as fh:
+            entries = [ln.strip() for ln in fh
+                       if ln.strip() and not ln.startswith("#")]
+    else:
+        return []
+    return entries
+
+
+def derive_rank(machines: Sequence[str],
+                local_port: Optional[int] = None) -> int:
+    """Find this host's position in the machine list by matching local
+    addresses (reference ``linkers_socket.cpp:40-60``)."""
+    local_names = {socket.gethostname(), "localhost", "127.0.0.1"}
+    try:
+        local_names.add(socket.gethostbyname(socket.gethostname()))
+    except OSError:
+        pass
+    for rank, entry in enumerate(machines):
+        host, _, port = entry.partition(":")
+        if host in local_names and (
+                local_port is None or (port and int(port) == local_port)):
+            return rank
+    raise ValueError(
+        f"could not find local machine in machines list {machines!r} "
+        "(reference: 'Please check machine_list_filename or machines')")
+
+
+def init_distributed(cfg: Optional[Config] = None, *,
+                     coordinator_address: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None) -> Tuple[int, int]:
+    """Initialize the multi-process JAX runtime and return (rank, world_size).
+
+    Accepts either explicit coordinator parameters or a reference-style
+    ``machines``/``machine_list_file`` config (first entry = coordinator, list
+    position = rank).  No-op in single-process mode (``num_machines <= 1``
+    with no machine list), matching ``Network::Init``'s behavior.
+    """
+    if coordinator_address is None and cfg is not None:
+        machines = parse_machine_list(cfg)
+        nm = int(getattr(cfg, "num_machines", 1) or 1)
+        if not machines and nm <= 1:
+            return 0, 1
+        if not machines:
+            raise ValueError("num_machines > 1 requires machines or "
+                             "machine_list_filename")
+        coordinator_address = machines[0]
+        num_processes = len(machines)
+        if process_id is None:
+            env_rank = os.environ.get("LIGHTGBM_TPU_RANK")
+            process_id = (int(env_rank) if env_rank is not None
+                          else derive_rank(machines))
+    if coordinator_address is None:
+        return 0, 1
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    log_info(f"Distributed init: rank {jax.process_index()}/"
+             f"{jax.process_count()}, {len(jax.devices())} global devices")
+    return jax.process_index(), jax.process_count()
+
+
+def global_mesh(num_feature_shards: int = 1):
+    """Mesh over ALL processes' devices (call after :func:`init_distributed`).
+    Data-parallel rows ride ICI within hosts and DCN across hosts."""
+    return make_mesh(0, num_feature_shards, jax.devices())
+
+
+def is_multi_process() -> bool:
+    return jax.process_count() > 1
+
+
+def shutdown() -> None:
+    """reference ``Network::Dispose`` / ``MpiFinalizeIfIsParallel``
+    (``main.cpp:20``)."""
+    if is_multi_process():
+        try:
+            jax.distributed.shutdown()
+        except Exception as exc:  # pragma: no cover
+            log_warning(f"distributed shutdown: {exc}")

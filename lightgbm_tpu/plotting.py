@@ -1,0 +1,400 @@
+"""Plotting utilities.
+
+Reference: ``python-package/lightgbm/plotting.py`` (840 LoC) —
+``plot_importance``, ``plot_split_value_histogram``, ``plot_metric``,
+``plot_tree``, ``create_tree_digraph``.  Same call signatures for the common
+arguments; matplotlib is imported lazily, graphviz is optional (gated, like the
+reference).
+"""
+
+from __future__ import annotations
+
+from copy import deepcopy
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+import numpy as np
+
+from .basic import Booster
+from .sklearn import LGBMModel
+
+
+def _check_not_tuple_of_2_elements(obj, obj_name="obj"):
+    if not isinstance(obj, tuple) or len(obj) != 2:
+        raise TypeError(f"{obj_name} must be a tuple of 2 elements.")
+
+
+def _to_booster(booster) -> Booster:
+    if isinstance(booster, LGBMModel):
+        return booster.booster_
+    if isinstance(booster, Booster):
+        return booster
+    raise TypeError("booster must be a Booster or LGBMModel instance")
+
+
+def plot_importance(
+    booster,
+    ax=None,
+    height: float = 0.2,
+    xlim: Optional[Tuple[float, float]] = None,
+    ylim: Optional[Tuple[float, float]] = None,
+    title: Optional[str] = "Feature importance",
+    xlabel: Optional[str] = "Feature importance",
+    ylabel: Optional[str] = "Features",
+    importance_type: str = "auto",
+    max_num_features: Optional[int] = None,
+    ignore_zero: bool = True,
+    figsize: Optional[Tuple[float, float]] = None,
+    dpi: Optional[int] = None,
+    grid: bool = True,
+    precision: Optional[int] = 3,
+    **kwargs: Any,
+):
+    """Horizontal bar chart of feature importances (reference
+    ``plotting.py plot_importance``)."""
+    import matplotlib.pyplot as plt
+
+    bst = _to_booster(booster)
+    if importance_type == "auto":
+        importance_type = "split"
+    importance = bst.feature_importance(importance_type=importance_type)
+    feature_name = bst.feature_name()
+
+    if not len(importance):
+        raise ValueError("Booster's feature_importance is empty.")
+    tuples = sorted(zip(feature_name, importance), key=lambda x: x[1])
+    if ignore_zero:
+        tuples = [x for x in tuples if x[1] > 0]
+    if max_num_features is not None and max_num_features > 0:
+        tuples = tuples[-max_num_features:]
+    labels, values = zip(*tuples)
+
+    if ax is None:
+        if figsize is not None:
+            _check_not_tuple_of_2_elements(figsize, "figsize")
+        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
+    ylocs = np.arange(len(values))
+    ax.barh(ylocs, values, align="center", height=height, **kwargs)
+    for x, y in zip(values, ylocs):
+        fmt = f"%.{precision}f" if (precision is not None
+                                    and importance_type == "gain") else "%d"
+        ax.text(x + 1, y, fmt % x, va="center")
+    ax.set_yticks(ylocs)
+    ax.set_yticklabels(labels)
+    if xlim is not None:
+        _check_not_tuple_of_2_elements(xlim, "xlim")
+    else:
+        xlim = (0, max(values) * 1.1)
+    ax.set_xlim(xlim)
+    if ylim is not None:
+        _check_not_tuple_of_2_elements(ylim, "ylim")
+    else:
+        ylim = (-1, len(values))
+    ax.set_ylim(ylim)
+    if title is not None:
+        ax.set_title(title)
+    if xlabel is not None:
+        ax.set_xlabel(xlabel)
+    if ylabel is not None:
+        ax.set_ylabel(ylabel)
+    ax.grid(grid)
+    return ax
+
+
+def plot_split_value_histogram(
+    booster,
+    feature: Union[int, str],
+    bins: Union[int, str, None] = None,
+    ax=None,
+    width_coef: float = 0.8,
+    xlim: Optional[Tuple[float, float]] = None,
+    ylim: Optional[Tuple[float, float]] = None,
+    title: Optional[str] = "Split value histogram for feature with @index/name@ @feature@",
+    xlabel: Optional[str] = "Feature split value",
+    ylabel: Optional[str] = "Count",
+    figsize: Optional[Tuple[float, float]] = None,
+    dpi: Optional[int] = None,
+    grid: bool = True,
+    **kwargs: Any,
+):
+    """Histogram of a feature's split thresholds across the model (reference
+    ``plotting.py plot_split_value_histogram``)."""
+    import matplotlib.pyplot as plt
+
+    bst = _to_booster(booster)
+    dump = bst.dump_model()
+    names = dump["feature_names"]
+    if isinstance(feature, str):
+        fidx = names.index(feature)
+    else:
+        fidx = int(feature)
+
+    values: List[float] = []
+
+    def walk(node):
+        if "leaf_index" in node:
+            return
+        if node["split_feature"] == fidx and node["decision_type"] == "<=":
+            values.append(float(node["threshold"]))
+        walk(node["left_child"])
+        walk(node["right_child"])
+
+    for info in dump["tree_info"]:
+        walk(info["tree_structure"])
+    if not values:
+        raise ValueError(
+            f"Cannot plot split value histogram, "
+            f"because feature {feature} was not used in splitting")
+    hist, bin_edges = np.histogram(values, bins=bins or "auto")
+    centers = (bin_edges[:-1] + bin_edges[1:]) / 2
+
+    if ax is None:
+        if figsize is not None:
+            _check_not_tuple_of_2_elements(figsize, "figsize")
+        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
+    width = width_coef * (bin_edges[1] - bin_edges[0])
+    ax.bar(centers, hist, width=width, align="center", **kwargs)
+    if xlim is not None:
+        _check_not_tuple_of_2_elements(xlim, "xlim")
+        ax.set_xlim(xlim)
+    if ylim is not None:
+        _check_not_tuple_of_2_elements(ylim, "ylim")
+    else:
+        ylim = (0, max(hist) * 1.1)
+    ax.set_ylim(ylim)
+    if title is not None:
+        title = title.replace("@feature@", str(feature)).replace(
+            "@index/name@", "name" if isinstance(feature, str) else "index")
+        ax.set_title(title)
+    if xlabel is not None:
+        ax.set_xlabel(xlabel)
+    if ylabel is not None:
+        ax.set_ylabel(ylabel)
+    ax.grid(grid)
+    return ax
+
+
+def plot_metric(
+    booster: Union[Dict, "LGBMModel"],
+    metric: Optional[str] = None,
+    dataset_names: Optional[List[str]] = None,
+    ax=None,
+    xlim: Optional[Tuple[float, float]] = None,
+    ylim: Optional[Tuple[float, float]] = None,
+    title: Optional[str] = "Metric during training",
+    xlabel: Optional[str] = "Iterations",
+    ylabel: Optional[str] = "@metric@",
+    figsize: Optional[Tuple[float, float]] = None,
+    dpi: Optional[int] = None,
+    grid: bool = True,
+):
+    """Plot metric curves recorded by ``record_evaluation`` (reference
+    ``plotting.py plot_metric``)."""
+    import matplotlib.pyplot as plt
+
+    if isinstance(booster, LGBMModel):
+        eval_results = deepcopy(booster.evals_result_)
+    elif isinstance(booster, dict):
+        eval_results = deepcopy(booster)
+    elif isinstance(booster, Booster):
+        raise TypeError("booster must be a dict from record_evaluation() "
+                        "or an LGBMModel (reference behavior)")
+    else:
+        raise TypeError("booster must be dict or LGBMModel.")
+    if not eval_results:
+        raise ValueError("eval results cannot be empty.")
+
+    if ax is None:
+        if figsize is not None:
+            _check_not_tuple_of_2_elements(figsize, "figsize")
+        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
+
+    if dataset_names is None:
+        dataset_names_iter = iter(eval_results.keys())
+    else:
+        dataset_names_iter = iter(dataset_names)
+    name = next(dataset_names_iter)
+    metrics_for_one = eval_results[name]
+    num_metric = len(metrics_for_one)
+    if metric is None:
+        if num_metric > 1:
+            raise ValueError("more than one metric available, pick one with "
+                             "the metric parameter")
+        metric, results = list(metrics_for_one.items())[0]
+    else:
+        if metric not in metrics_for_one:
+            raise KeyError("No given metric in eval results.")
+        results = metrics_for_one[metric]
+    num_iteration = len(results)
+    max_result = max(results)
+    min_result = min(results)
+    x_ = range(num_iteration)
+    ax.plot(x_, results, label=name)
+    for name in dataset_names_iter:
+        metrics_for_one = eval_results[name]
+        results = metrics_for_one[metric]
+        max_result = max(*results, max_result)
+        min_result = min(*results, min_result)
+        ax.plot(x_, results, label=name)
+    ax.legend(loc="best")
+    if xlim is not None:
+        _check_not_tuple_of_2_elements(xlim, "xlim")
+    else:
+        xlim = (0, num_iteration)
+    ax.set_xlim(xlim)
+    if ylim is not None:
+        _check_not_tuple_of_2_elements(ylim, "ylim")
+    else:
+        range_result = max_result - min_result
+        ylim = (min_result - range_result * 0.2,
+                max_result + range_result * 0.2)
+    ax.set_ylim(ylim)
+    if title is not None:
+        ax.set_title(title)
+    if xlabel is not None:
+        ax.set_xlabel(xlabel)
+    if ylabel is not None:
+        ax.set_ylabel(ylabel.replace("@metric@", metric))
+    ax.grid(grid)
+    return ax
+
+
+def _float2str(value, precision: Optional[int] = 3) -> str:
+    return (f"{value:.{precision}f}" if precision is not None
+            and not isinstance(value, str) else str(value))
+
+
+def create_tree_digraph(
+    booster,
+    tree_index: int = 0,
+    show_info: Optional[List[str]] = None,
+    precision: Optional[int] = 3,
+    orientation: str = "horizontal",
+    **kwargs: Any,
+):
+    """Graphviz digraph of one tree (reference ``plotting.py
+    create_tree_digraph``); requires the optional graphviz package."""
+    try:
+        from graphviz import Digraph
+    except ImportError as exc:
+        raise ImportError(
+            "You must install graphviz and restart your session "
+            "to plot tree.") from exc
+
+    bst = _to_booster(booster)
+    dump = bst.dump_model()
+    if tree_index >= len(dump["tree_info"]):
+        raise IndexError("tree_index is out of range.")
+    tree_info = dump["tree_info"][tree_index]
+    names = dump["feature_names"]
+    show_info = show_info or []
+
+    graph = Digraph(**kwargs)
+    rankdir = "LR" if orientation == "horizontal" else "TB"
+    graph.attr("graph", nodesep="0.05", ranksep="0.3", rankdir=rankdir)
+
+    def add(node, parent=None, decision=None):
+        if "split_index" in node:
+            name = f"split{node['split_index']}"
+            label = (f"{names[node['split_feature']]} "
+                     f"{node['decision_type']} "
+                     f"{_float2str(node['threshold'], precision)}")
+            for info in ("split_gain", "internal_value", "internal_count"):
+                if info in show_info:
+                    label += f"\n{info}: {_float2str(node[info], precision)}"
+            graph.node(name, label=label, shape="rectangle")
+            add(node["left_child"], name, "yes")
+            add(node["right_child"], name, "no")
+        else:
+            name = f"leaf{node['leaf_index']}"
+            label = f"leaf {node['leaf_index']}: " \
+                    f"{_float2str(node['leaf_value'], precision)}"
+            if "leaf_count" in show_info and "leaf_count" in node:
+                label += f"\ncount: {node['leaf_count']}"
+            graph.node(name, label=label)
+        if parent is not None:
+            graph.edge(parent, name, decision)
+
+    add(tree_info["tree_structure"])
+    return graph
+
+
+def plot_tree(
+    booster,
+    ax=None,
+    tree_index: int = 0,
+    figsize: Optional[Tuple[float, float]] = None,
+    dpi: Optional[int] = None,
+    show_info: Optional[List[str]] = None,
+    precision: Optional[int] = 3,
+    orientation: str = "horizontal",
+    **kwargs: Any,
+):
+    """Render one tree with matplotlib.  Uses graphviz when available
+    (reference behavior); otherwise falls back to a pure-matplotlib
+    layout so the function works in this hermetic environment."""
+    import matplotlib.pyplot as plt
+
+    if ax is None:
+        if figsize is not None:
+            _check_not_tuple_of_2_elements(figsize, "figsize")
+        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
+    try:
+        from graphviz import Digraph  # noqa: F401
+        graph = create_tree_digraph(booster, tree_index, show_info, precision,
+                                    orientation)
+        import io
+        try:
+            from PIL import Image
+            s = io.BytesIO(graph.pipe(format="png"))
+            ax.imshow(Image.open(s))
+            ax.axis("off")
+            return ax
+        except Exception:
+            pass
+    except ImportError:
+        pass
+
+    # matplotlib-only fallback: recursive box layout
+    bst = _to_booster(booster)
+    dump = bst.dump_model()
+    if tree_index >= len(dump["tree_info"]):
+        raise IndexError("tree_index is out of range.")
+    names = dump["feature_names"]
+    root = dump["tree_info"][tree_index]["tree_structure"]
+
+    def depth_of(node):
+        if "leaf_index" in node:
+            return 1
+        return 1 + max(depth_of(node["left_child"]),
+                       depth_of(node["right_child"]))
+
+    total_depth = depth_of(root)
+    next_y = [0.0]
+
+    def layout(node, depth):
+        x = depth / max(total_depth - 1, 1)
+        if "leaf_index" in node:
+            y = next_y[0]
+            next_y[0] += 1.0
+            label = f"leaf {node['leaf_index']}\n" \
+                    f"{_float2str(node['leaf_value'], precision)}"
+            ax.annotate(label, (x, y), ha="center", va="center",
+                        bbox=dict(boxstyle="round", fc="lightyellow"))
+            return y
+        yl = layout(node["left_child"], depth + 1)
+        yr = layout(node["right_child"], depth + 1)
+        y = (yl + yr) / 2
+        label = (f"{names[node['split_feature']]} {node['decision_type']} "
+                 f"{_float2str(node['threshold'], precision)}")
+        ax.annotate(label, (x, y), ha="center", va="center",
+                    bbox=dict(boxstyle="round", fc="lightblue"))
+        xl = (depth + 1) / max(total_depth - 1, 1)
+        ax.plot([x, xl], [y, yl], "k-", lw=0.8, zorder=0)
+        ax.plot([x, xl], [y, yr], "k-", lw=0.8, zorder=0)
+        return y
+
+    layout(root, 0)
+    ax.set_xlim(-0.1, 1.1)
+    ax.set_ylim(-1, next_y[0])
+    ax.axis("off")
+    return ax

@@ -79,6 +79,17 @@ def _tree_to_string(tree, index: int, mappers) -> str:
     if len(cat_nodes):
         lines.append("cat_boundaries=" + _fmt_arr(cat_boundaries, "%d"))
         lines.append("cat_threshold=" + _fmt_arr(cat_threshold, "%d"))
+    if getattr(tree, "is_linear", False):
+        # Linear-leaf fields (reference Tree::ToString is_linear branch).
+        nl = tree.num_leaves
+        lines.append("is_linear=1")
+        lines.append("leaf_const=" + _fmt_arr(tree.leaf_const[:nl]))
+        lines.append("num_features=" + _fmt_arr(
+            [len(f) for f in tree.leaf_features[:nl]], "%d"))
+        flat_feats = [int(v) for f in tree.leaf_features[:nl] for v in f]
+        flat_coefs = [float(v) for c in tree.leaf_coeff[:nl] for v in c]
+        lines.append("leaf_features=" + _fmt_arr(flat_feats, "%d"))
+        lines.append("leaf_coeff=" + _fmt_arr(flat_coefs))
     lines.append(f"shrinkage={tree.shrinkage:g}")
     lines.append("")
     return "\n".join(lines)
@@ -135,6 +146,101 @@ def _feature_info(m) -> str:
     return f"[{m.upper_bounds[0]:g}:{m.upper_bounds[-2]:g}]"
 
 
+# -------------------------------------------------------------------- JSON dump
+def _tree_structure_dict(tree, mappers) -> dict:
+    """Nested node dict for one tree (reference ``Tree::ToJSON``,
+    ``src/io/tree.cpp``)."""
+    m = tree.num_splits()
+
+    def node(idx: int):
+        if m == 0 or idx < 0:
+            leaf = ~idx if idx < 0 else 0
+            d = {
+                "leaf_index": int(leaf),
+                "leaf_value": float(tree.leaf_value[leaf])
+                if leaf < len(tree.leaf_value) else 0.0,
+            }
+            if leaf < len(tree.leaf_count):
+                d["leaf_count"] = int(tree.leaf_count[leaf])
+                d["leaf_weight"] = float(tree.leaf_weight[leaf])
+            return d
+        f = int(tree.split_feature[idx])
+        is_cat = bool(tree.is_cat[idx])
+        d = {
+            "split_index": int(idx),
+            "split_feature": f,
+            "split_gain": float(tree.split_gain[idx]),
+            "threshold": (float(tree.threshold[idx]) if not is_cat else
+                          "||".join(str(int(b)) for b in
+                                    np.nonzero(tree.cat_mask[idx])[0])),
+            "decision_type": "==" if is_cat else "<=",
+            "default_left": bool(tree.default_left[idx]),
+            "missing_type": ["None", "Zero", "NaN"][
+                (mappers[f].missing_type & 3) if mappers else 2],
+            "internal_value": float(tree.internal_value[idx]),
+            "internal_count": int(tree.internal_count[idx]),
+            "left_child": node(int(tree.left_child[idx])),
+            "right_child": node(int(tree.right_child[idx])),
+        }
+        return d
+
+    return node(0) if m else node(-1)
+
+
+def model_to_dict(gbdt, num_iteration: Optional[int] = None,
+                  start_iteration: int = 0) -> dict:
+    """JSON-dump structure (reference ``GBDT::DumpModel``,
+    ``gbdt_model_text.cpp:38``; Python ``Booster.dump_model``)."""
+    cfg = gbdt.cfg
+    td = gbdt.train_data
+    mappers = td.binned.mappers
+    names = td.feature_names or [f"Column_{i}"
+                                 for i in range(td.num_features)]
+    end = None if num_iteration is None else start_iteration + num_iteration
+    n_iters = min(len(m) for m in gbdt.models) if gbdt.models else 0
+    iters = range(start_iteration,
+                  n_iters if end is None else min(end, n_iters))
+    tree_info = []
+    idx = 0
+    for t in iters:
+        for k in range(gbdt.num_class):
+            tree = gbdt.models[k][t]
+            tree_info.append({
+                "tree_index": idx,
+                "num_leaves": int(tree.num_leaves),
+                "num_cat": int(np.count_nonzero(
+                    tree.is_cat[: tree.num_splits()])),
+                "shrinkage": float(tree.shrinkage),
+                "tree_structure": _tree_structure_dict(tree, mappers),
+            })
+            idx += 1
+    return {
+        "name": "tree",
+        "version": "v4",
+        "num_class": gbdt.num_class,
+        "num_tree_per_iteration": gbdt.num_class,
+        "label_index": 0,
+        "max_feature_idx": td.num_features - 1,
+        "objective": cfg.objective,
+        "average_output": cfg.boosting == "rf",
+        "feature_names": names,
+        "monotone_constraints": list(map(int, td.monotone_constraints))
+        if td.monotone_constraints is not None else [],
+        "feature_infos": {
+            n: {"min_value": (float(m.upper_bounds[0])
+                              if m.upper_bounds is not None
+                              and len(m.upper_bounds) > 1 else 0.0),
+                "max_value": (float(m.upper_bounds[-2])
+                              if m.upper_bounds is not None
+                              and len(m.upper_bounds) > 1 else 0.0),
+                "values": ([int(c) for c in m.categories]
+                           if m.categories is not None else [])}
+            for n, m in zip(names, mappers)
+        },
+        "tree_info": tree_info,
+    }
+
+
 # ------------------------------------------------------------------------- load
 @dataclasses.dataclass
 class LoadedTree:
@@ -151,6 +257,10 @@ class LoadedTree:
     cat_boundaries: Optional[np.ndarray] = None
     cat_threshold: Optional[np.ndarray] = None
     shrinkage: float = 1.0
+    is_linear: bool = False
+    leaf_const: Optional[np.ndarray] = None
+    leaf_features: Optional[list] = None
+    leaf_coeff: Optional[list] = None
 
     def predict(self, X: np.ndarray) -> np.ndarray:
         """Vectorized raw-value traversal (reference ``Tree::Predict``)."""
@@ -160,6 +270,7 @@ class LoadedTree:
             out[:] = self.leaf_value[0] if len(self.leaf_value) else 0.0
             return out
         node = np.zeros(n, np.int32)
+        leaf_idx = np.zeros(n, np.int64)
         active = np.ones(n, bool)
         is_cat = (self.decision_type & _CAT_MASK) > 0
         dleft = (self.decision_type & _DEFAULT_LEFT_MASK) > 0
@@ -187,8 +298,22 @@ class LoadedTree:
             nxt = np.where(gl, self.left_child[nd], self.right_child[nd])
             leaf = nxt < 0
             out[idx[leaf]] = self.leaf_value[~nxt[leaf]]
+            leaf_idx[idx[leaf]] = ~nxt[leaf]
             node[idx[~leaf]] = nxt[~leaf]
             active[idx[leaf]] = False
+        if self.is_linear:
+            for l in range(self.num_leaves):
+                sel = np.nonzero(leaf_idx == l)[0]
+                if not len(sel):
+                    continue
+                fl = self.leaf_features[l]
+                vals = np.full(len(sel), self.leaf_const[l])
+                if len(fl):
+                    Xl = X[sel][:, fl]
+                    nan = np.isnan(Xl).any(axis=1)
+                    vals = vals + Xl @ self.leaf_coeff[l]
+                    vals[nan] = self.leaf_value[l]
+                out[sel] = vals
         return out
 
     def _cat_left(self, nodes: np.ndarray, values: np.ndarray) -> np.ndarray:
@@ -309,6 +434,18 @@ def load_model_string(s: str) -> LoadedModel:
         getf = lambda k, d=None: (np.array([float(x) for x in block[k].split()])
                                   if k in block else d)
         m = max(nl - 1, 0)
+        is_linear = block.get("is_linear", "0").strip() == "1"
+        leaf_const = leaf_features = leaf_coeff = None
+        if is_linear:
+            leaf_const = getf("leaf_const", np.zeros(max(nl, 1)))
+            counts = geti("num_features", np.zeros(max(nl, 1), np.int32))
+            flat_f = geti("leaf_features", np.zeros(0, np.int32))
+            flat_c = getf("leaf_coeff", np.zeros(0))
+            leaf_features, leaf_coeff, pos = [], [], 0
+            for c in counts:
+                leaf_features.append(np.asarray(flat_f[pos: pos + c]))
+                leaf_coeff.append(np.asarray(flat_c[pos: pos + c]))
+                pos += int(c)
         trees.append(LoadedTree(
             num_leaves=nl,
             split_feature=geti("split_feature", np.zeros(m, np.int32)),
@@ -321,6 +458,10 @@ def load_model_string(s: str) -> LoadedModel:
             cat_boundaries=geti("cat_boundaries"),
             cat_threshold=geti("cat_threshold"),
             shrinkage=float(block.get("shrinkage", 1.0)),
+            is_linear=is_linear,
+            leaf_const=leaf_const,
+            leaf_features=leaf_features,
+            leaf_coeff=leaf_coeff,
         ))
     params: Dict[str, str] = {}
     for line in lines[i:]:

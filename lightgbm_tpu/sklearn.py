@@ -156,12 +156,24 @@ class LGBMModel:
                                           reference=ds, params=params))
                 valid_names.append(
                     eval_names[i] if eval_names else f"valid_{i}")
+        from .callback import record_evaluation
+        self._evals_result: Dict[str, Dict[str, List[float]]] = {}
+        callbacks = list(callbacks) if callbacks else []
+        callbacks.append(record_evaluation(self._evals_result))
         self._Booster = train(params, ds,
                               num_boost_round=self.n_estimators,
                               valid_sets=valid_sets, valid_names=valid_names,
                               callbacks=callbacks)
         self.fitted_ = True
         return self
+
+    @property
+    def evals_result_(self) -> Dict[str, Dict[str, List[float]]]:
+        """Per-dataset metric curves recorded during fit (reference
+        ``LGBMModel.evals_result_``)."""
+        if self._Booster is None:
+            raise ValueError("Model not fitted")
+        return self._evals_result
 
     def predict(self, X, raw_score=False, start_iteration=0,
                 num_iteration=None, **kwargs):

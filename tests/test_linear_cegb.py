@@ -1,0 +1,107 @@
+"""Linear trees (reference ``LinearTreeLearner``) and CEGB (reference
+``cost_effective_gradient_boosting.hpp``) behavior tests."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _linear_data(rng, n=3000, f=5):
+    X = rng.randn(n, f)
+    y = 3.0 * X[:, 0] + 2.0 * X[:, 1] + 0.1 * rng.randn(n)
+    return X, y
+
+
+def test_linear_tree_beats_constant_leaves_on_linear_data(rng):
+    X, y = _linear_data(rng)
+    rmses = {}
+    for lin in (False, True):
+        ds = lgb.Dataset(X[:2400], label=y[:2400])
+        bst = lgb.train({"objective": "regression", "num_leaves": 15,
+                         "verbosity": -1, "linear_tree": lin,
+                         "linear_lambda": 0.01}, ds, 30)
+        p = bst.predict(X[2400:])
+        rmses[lin] = np.sqrt(((p - y[2400:]) ** 2).mean())
+    assert rmses[True] < rmses[False] * 0.8
+
+
+def test_linear_tree_save_load_roundtrip(rng, tmp_path):
+    X, y = _linear_data(rng, n=2000)
+    X[::31, 2] = np.nan
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "regression", "num_leaves": 15,
+                     "verbosity": -1, "linear_tree": True}, ds, 15)
+    p1 = bst.predict(X)
+    path = str(tmp_path / "lin.txt")
+    bst.save_model(path)
+    b2 = lgb.Booster(model_file=path)
+    p2 = b2.predict(X)
+    np.testing.assert_allclose(p1, p2, rtol=1e-6, atol=1e-6)
+
+
+def test_linear_tree_nan_rows_fall_back(rng):
+    X, y = _linear_data(rng, n=2000)
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "regression", "num_leaves": 7,
+                     "verbosity": -1, "linear_tree": True}, ds, 5)
+    Xq = X[:10].copy()
+    Xq[:, :] = np.nan
+    p = bst.predict(Xq)
+    assert np.isfinite(p).all()
+
+
+def test_linear_tree_with_valid_set(rng):
+    X, y = _linear_data(rng)
+    ds = lgb.Dataset(X[:2400], label=y[:2400])
+    vs = lgb.Dataset(X[2400:], label=y[2400:], reference=ds)
+    evals = {}
+    bst = lgb.train({"objective": "regression", "num_leaves": 15,
+                     "verbosity": -1, "linear_tree": True, "metric": "l2"},
+                    ds, 20, valid_sets=[vs],
+                    callbacks=[lgb.record_evaluation(evals)])
+    curve = evals["valid_0"]["l2"]
+    assert curve[-1] < curve[0]
+    # recorded valid metric must match fresh prediction
+    p = bst.predict(X[2400:])
+    assert abs(((p - y[2400:]) ** 2).mean() - curve[-1]) < 1e-3
+
+
+def test_cegb_coupled_penalty_reduces_features(rng):
+    X = rng.randn(4000, 10)
+    y = (X[:, 0] + 0.5 * X[:, 1] + 0.25 * X[:, 2] > 0).astype(float)
+    used = {}
+    for name, params in (
+        ("base", {}),
+        ("cegb", {"cegb_tradeoff": 1.0,
+                  "cegb_penalty_feature_coupled": [5.0] * 10}),
+    ):
+        ds = lgb.Dataset(X, label=y)
+        bst = lgb.train({"objective": "binary", "num_leaves": 31,
+                         "verbosity": -1, **params}, ds, 10)
+        used[name] = int((bst.feature_importance() > 0).sum())
+    assert used["cegb"] <= used["base"]
+
+
+def test_cegb_split_penalty_shrinks_trees(rng):
+    X = rng.randn(3000, 6)
+    y = (X[:, 0] > 0).astype(float) + 0.05 * rng.randn(3000)
+    leaves = {}
+    for name, pen in (("base", 0.0), ("cegb", 10.0)):
+        ds = lgb.Dataset(X, label=y)
+        bst = lgb.train({"objective": "regression", "num_leaves": 63,
+                         "verbosity": -1, "cegb_penalty_split": pen,
+                         "cegb_tradeoff": 0.001}, ds, 3)
+        leaves[name] = bst.dump_model()["tree_info"][0]["num_leaves"]
+    assert leaves["cegb"] <= leaves["base"]
+
+
+def test_cegb_model_still_accurate(rng):
+    X = rng.randn(4000, 10)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(float)
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "binary", "num_leaves": 31, "verbosity": -1,
+                     "cegb_tradeoff": 1.0,
+                     "cegb_penalty_feature_coupled": [2.0] * 10}, ds, 20)
+    acc = ((bst.predict(X) > 0.5) == y).mean()
+    assert acc > 0.9

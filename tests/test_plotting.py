@@ -1,0 +1,121 @@
+"""Plotting + model-dump tests (reference tests/python_package_test/
+test_plotting.py — matplotlib Agg backend, no display)."""
+
+import matplotlib
+
+matplotlib.use("Agg")
+
+import matplotlib.pyplot as plt  # noqa: E402
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+import lightgbm_tpu as lgb  # noqa: E402
+
+
+@pytest.fixture
+def model(rng):
+    X = rng.randn(500, 6)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(float)
+    ds = lgb.Dataset(X, label=y,
+                     feature_name=[f"f{i}" for i in range(6)])
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "verbosity": -1, "min_data_in_leaf": 5}, ds, 12)
+    return bst
+
+
+def test_dump_model_structure(model):
+    dump = model.dump_model()
+    assert dump["name"] == "tree"
+    assert dump["num_class"] == 1
+    assert len(dump["tree_info"]) == 12
+    root = dump["tree_info"][0]["tree_structure"]
+    assert "split_feature" in root
+    assert "left_child" in root and "right_child" in root
+    # leaves carry values
+    node = root
+    while "left_child" in node:
+        node = node["left_child"]
+    assert "leaf_value" in node
+    assert dump["feature_names"] == [f"f{i}" for i in range(6)]
+
+
+def test_dump_model_num_iteration(model):
+    dump = model.dump_model(num_iteration=3)
+    assert len(dump["tree_info"]) == 3
+
+
+def test_trees_to_dataframe(model):
+    rows = model.trees_to_dataframe()
+    assert len(rows) > 12
+    split_rows = [r for r in rows if r["split_feature"] is not None]
+    leaf_rows = [r for r in rows if r["split_feature"] is None]
+    assert split_rows and leaf_rows
+    assert all(r["node_index"].startswith("0-") for r in rows
+               if r["tree_index"] == 0)
+
+
+def test_plot_importance(model):
+    ax = lgb.plot_importance(model)
+    assert len(ax.patches) > 0
+    assert ax.get_title() == "Feature importance"
+    plt.close("all")
+
+
+def test_plot_importance_gain(model):
+    ax = lgb.plot_importance(model, importance_type="gain",
+                             max_num_features=3)
+    assert len(ax.patches) <= 3
+    plt.close("all")
+
+
+def test_plot_split_value_histogram(model):
+    imp = model.feature_importance()
+    feat = int(np.argmax(imp))
+    ax = lgb.plot_split_value_histogram(model, feat)
+    assert len(ax.patches) > 0
+    plt.close("all")
+
+
+def test_plot_metric(rng):
+    X = rng.randn(400, 5)
+    y = (X[:, 0] > 0).astype(float)
+    ds = lgb.Dataset(X[:300], label=y[:300])
+    vs = lgb.Dataset(X[300:], label=y[300:], reference=ds)
+    evals = {}
+    lgb.train({"objective": "binary", "metric": "binary_logloss",
+               "num_leaves": 7, "verbosity": -1}, ds, 10,
+              valid_sets=[vs], callbacks=[lgb.record_evaluation(evals)])
+    ax = lgb.plot_metric(evals)
+    assert ax.get_title() == "Metric during training"
+    plt.close("all")
+
+
+def test_plot_metric_sklearn(rng):
+    X = rng.randn(400, 5)
+    y = (X[:, 0] > 0).astype(float)
+    clf = lgb.LGBMClassifier(n_estimators=8, num_leaves=7, verbosity=-1)
+    clf.fit(X[:300], y[:300], eval_set=[(X[300:], y[300:])])
+    assert clf.evals_result_
+    ax = lgb.plot_metric(clf)
+    plt.close("all")
+
+
+def test_plot_tree(model):
+    ax = lgb.plot_tree(model, tree_index=2)
+    assert ax is not None
+    plt.close("all")
+
+
+def test_plot_tree_bad_index(model):
+    with pytest.raises(IndexError):
+        lgb.plot_tree(model, tree_index=999)
+
+
+def test_create_tree_digraph_requires_graphviz(model):
+    try:
+        import graphviz  # noqa: F401
+        g = lgb.create_tree_digraph(model, 0)
+        assert g is not None
+    except ImportError:
+        with pytest.raises(ImportError):
+            lgb.create_tree_digraph(model, 0)
