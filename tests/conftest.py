@@ -3,18 +3,13 @@ tests run without TPU hardware (mirrors the reference's localhost mock-cluster
 pattern, tests/distributed/_test_distributed.py)."""
 
 import os
+import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import jax  # noqa: E402
+import _hermetic  # noqa: E402
 
-# The environment's PJRT plugin boot (sitecustomize) may force
-# jax_platforms to the accelerator; tests are hermetic on CPU.
-jax.config.update("jax_platforms", "cpu")
+_hermetic.force_cpu(8)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
