@@ -131,7 +131,13 @@ class GBDT:
             histogram_impl=hist_impl,
             rows_block=cfg.tpu_rows_block,
             gather_rows=self.mesh is None,
+            quantized=cfg.use_quantized_grad,
+            num_grad_quant_bins=cfg.num_grad_quant_bins,
+            stochastic_rounding=cfg.stochastic_rounding,
+            quant_renew_leaf=cfg.quant_train_renew_leaf,
         )
+        self._quant_key = (jax.random.PRNGKey(cfg.seed)
+                           if cfg.use_quantized_grad else None)
         self.grow = make_grower(self.grower_cfg)
         self.bins_dev = train.bins_device()
         self.meta_dev = train.feature_meta_device()
@@ -194,12 +200,12 @@ class GBDT:
         shape_k = self._shape_k
 
         def grow_apply(scores_k, grad_k, hess_k, mask, fmask, shrink,
-                       cegb_coupled=None, cegb_lazy=None):
+                       cegb_coupled=None, cegb_lazy=None, quant_key=None):
             arrays, row_leaf = grow(
                 self.bins_dev, grad_k, hess_k, mask, fmask,
                 meta["num_bins_per_feature"], meta["nan_bins"],
                 meta["is_categorical"], meta["monotone"],
-                cegb_coupled, cegb_lazy)
+                cegb_coupled, cegb_lazy, quant_key)
             grew = arrays.num_leaves > 1
             lv = jnp.where(grew, arrays.leaf_value * shrink, 0.0)
             arrays = arrays._replace(
@@ -211,20 +217,23 @@ class GBDT:
         self._fused_iter = None
         if (obj is not None and not obj.need_renew_tree_output
                 and not obj.stochastic_gradients):
-            def fused(scores, mask, fmask, shrink):
+            def fused(scores, mask, fmask, shrink, quant_key=None):
                 grad, hess = obj.get_gradients(scores)
                 outs = []
                 if shape_k:
                     new_scores = scores
                     for k in range(num_class):
+                        qk = (None if quant_key is None
+                              else jax.random.fold_in(quant_key, k))
                         ns_k, arrays, row_leaf = grow_apply(
                             new_scores[:, k], grad[:, k], hess[:, k],
-                            mask, fmask, shrink)
+                            mask, fmask, shrink, quant_key=qk)
                         new_scores = new_scores.at[:, k].set(ns_k)
                         outs.append((arrays, row_leaf))
                     return new_scores, outs
                 ns, arrays, row_leaf = grow_apply(scores, grad, hess,
-                                                  mask, fmask, shrink)
+                                                  mask, fmask, shrink,
+                                                  quant_key=quant_key)
                 return ns, [(arrays, row_leaf)]
             self._fused_iter = jax.jit(fused)
 
@@ -304,6 +313,8 @@ class GBDT:
                 "(reference LGBM_BoosterUpdateOneIterCustom)")
         mask_dev, fmask, goss_grads = self._iter_masks(grad, hess)
         shrink = cfg.learning_rate if cfg.boosting != "rf" else 1.0
+        qkey = (jax.random.fold_in(self._quant_key, self.iter_)
+                if self._quant_key is not None else None)
 
         results = []
         if (grad is None and self._fused_iter is not None
@@ -312,7 +323,7 @@ class GBDT:
             # Hot path: ONE device dispatch for gradients + all class trees +
             # score updates.
             self.scores, outs = self._fused_iter(self.scores, mask_dev,
-                                                 fmask, shrink)
+                                                 fmask, shrink, qkey)
             results = [(k, a, rl) for k, (a, rl) in enumerate(outs)]
         else:
             if goss_grads is not None:
@@ -326,8 +337,10 @@ class GBDT:
                 gk = g_dev[:, k] if self._shape_k else g_dev
                 hk = h_dev[:, k] if self._shape_k else h_dev
                 sk = self.scores[:, k] if self._shape_k else self.scores
+                qk = None if qkey is None else jax.random.fold_in(qkey, k)
                 if cfg.linear_tree:
-                    arrays, row_leaf = self._raw_grow(gk, hk, mask_dev, fmask)
+                    arrays, row_leaf = self._raw_grow(gk, hk, mask_dev, fmask,
+                                                      qk)
                     new_sk = self._fit_and_store_linear(
                         k, arrays, row_leaf, gk, hk, mask_dev, sk, shrink)
                     if self._shape_k:
@@ -337,7 +350,8 @@ class GBDT:
                     continue
                 if (self.objective is not None
                         and self.objective.need_renew_tree_output):
-                    arrays, row_leaf = self._raw_grow(gk, hk, mask_dev, fmask)
+                    arrays, row_leaf = self._raw_grow(gk, hk, mask_dev, fmask,
+                                                      qk)
                     arrays = self._renew_and_shrink(arrays, row_leaf, sk,
                                                     shrink)
                     new_sk = _add_leaf_outputs(sk, row_leaf,
@@ -347,10 +361,11 @@ class GBDT:
                         self._cegb_coupled_raw * (~self._cegb_used))
                     new_sk, arrays, row_leaf = self._grow_apply(
                         sk, gk, hk, mask_dev, fmask, shrink,
-                        coupled, self._cegb_lazy_dev)
+                        coupled, self._cegb_lazy_dev, qk)
                 else:
                     new_sk, arrays, row_leaf = self._grow_apply(
-                        sk, gk, hk, mask_dev, fmask, shrink)
+                        sk, gk, hk, mask_dev, fmask, shrink,
+                        quant_key=qk)
                 if self._shape_k:
                     self.scores = self.scores.at[:, k].set(new_sk)
                 else:
@@ -370,11 +385,12 @@ class GBDT:
         self._linear_nls = []
         return all(int(x) <= 1 for x in nls)
 
-    def _raw_grow(self, gk, hk, mask_dev, fmask):
+    def _raw_grow(self, gk, hk, mask_dev, fmask, quant_key=None):
         return self.grow(
             self.bins_dev, gk, hk, mask_dev, fmask,
             self.meta_dev["num_bins_per_feature"], self.meta_dev["nan_bins"],
-            self.meta_dev["is_categorical"], self.meta_dev["monotone"])
+            self.meta_dev["is_categorical"], self.meta_dev["monotone"],
+            None, None, quant_key)
 
     def _renew_and_shrink(self, arrays: TreeArrays, row_leaf, scores_k,
                           shrink: float) -> TreeArrays:

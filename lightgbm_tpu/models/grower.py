@@ -33,7 +33,7 @@ from typing import NamedTuple, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from ..ops.histogram import build_histogram, histogram_from_vals
+from ..ops.histogram import histogram_from_vals
 from ..ops.split import BestSplit, SplitConfig, best_split, leaf_output
 
 _NEG_INF = -jnp.inf
@@ -52,6 +52,13 @@ class GrowerConfig:
     # device mesh: dynamic_slice over globally-grouped rows would destroy the
     # row-sharding locality the distributed path relies on.
     gather_rows: bool = True
+    # Quantized training (reference GradientDiscretizer,
+    # gradient_discretizer.hpp:128): int8 grad/hess levels, int32 histogram
+    # accumulation, per-iteration scales; see ops/quantize.py.
+    quantized: bool = False
+    num_grad_quant_bins: int = 4
+    stochastic_rounding: bool = True
+    quant_renew_leaf: bool = False
 
 
 class TreeArrays(NamedTuple):
@@ -318,12 +325,18 @@ def make_grower(cfg: GrowerConfig):
             best_cl=st.best_cl.at[pair].set(bs2.count_left),
         )
 
+    def _scale_hist(hist, scale3):
+        """Rescale an int32 quantized histogram to f32 (g, h, count) so the
+        split scan downstream is layout-identical to the fp32 path."""
+        if scale3 is None:
+            return hist
+        return hist.astype(jnp.float32) * scale3
+
     # ------------------------------------------------------------------ perm path
-    def _grow_perm(bins, g, h, in_bag, feature_mask, meta, cegb=None):
+    def _grow_perm(bins, vals, scale3, feature_mask, meta, cegb=None):
         """Permutation-layout growth (single device)."""
         n, f = bins.shape
         nan_bins = meta[1]
-        vals = jnp.stack([g, h, in_bag.astype(jnp.float32)], axis=-1)
         bins_pad = jnp.concatenate([bins, jnp.zeros((1, f), bins.dtype)], 0)
         vals_pad = jnp.concatenate([vals, jnp.zeros((1, 3), vals.dtype)], 0)
         buckets = _split_buckets(n)
@@ -332,9 +345,9 @@ def make_grower(cfg: GrowerConfig):
         perm0 = jnp.concatenate([jnp.arange(n, dtype=jnp.int32),
                                  jnp.full(max_bucket, n, jnp.int32)])
 
-        root_hist = histogram_from_vals(
+        root_hist = _scale_hist(histogram_from_vals(
             bins, vals, num_bins=B, impl=cfg.histogram_impl,
-            rows_block=cfg.rows_block)
+            rows_block=cfg.rows_block), scale3)
         root_tot = jnp.sum(root_hist[0], axis=0)
         root_g, root_h, root_c = root_tot[0], root_tot[1], root_tot[2]
 
@@ -380,10 +393,10 @@ def make_grower(cfg: GrowerConfig):
                 seg = jnp.where(valid, seg, n)
                 bseg = bins_pad[seg]                       # (S, F)
                 vseg = vals_pad[seg]                       # (S, 3)
-                return histogram_from_vals(
+                return _scale_hist(histogram_from_vals(
                     bseg, vseg, num_bins=B,
                     impl=cfg.histogram_impl,
-                    rows_block=min(cfg.rows_block, S))
+                    rows_block=min(cfg.rows_block, S)), scale3)
             return branch
 
         part_branches = [_make_part_branch(S) for S in buckets]
@@ -454,18 +467,22 @@ def make_grower(cfg: GrowerConfig):
         return _finish(state), row_leaf
 
     # ------------------------------------------------------------------ mask path
-    def _grow_mask(bins, g, h, in_bag, feature_mask, meta, cegb=None):
+    def _grow_mask(bins, vals, scale3, feature_mask, meta, cegb=None):
         """Mask-layout growth (sharding-friendly; full-N pass per split)."""
         n, f = bins.shape
 
         def hist_for(mask):
-            return build_histogram(
-                bins, g, h, mask, num_bins=B,
-                impl=cfg.histogram_impl, rows_block=cfg.rows_block,
-            )
+            # vals already carries bagging weights + in-bag zeroing; the
+            # per-leaf predicate is the only extra mask needed.
+            masked = jnp.where(mask[:, None], vals, jnp.zeros_like(vals))
+            return _scale_hist(histogram_from_vals(
+                bins, masked, num_bins=B,
+                impl=cfg.histogram_impl, rows_block=cfg.rows_block), scale3)
 
         nan_bins = meta[1]
-        root_hist = hist_for(in_bag)
+        root_hist = _scale_hist(histogram_from_vals(
+            bins, vals, num_bins=B, impl=cfg.histogram_impl,
+            rows_block=cfg.rows_block), scale3)
         root_tot = jnp.sum(root_hist[0], axis=0)
         root_g, root_h, root_c = root_tot[0], root_tot[1], root_tot[2]
         state = _init_state(n, f, root_hist, root_g, root_h, root_c)
@@ -505,9 +522,10 @@ def make_grower(cfg: GrowerConfig):
             small_is_left = cl <= cr
             target = jnp.where(small_is_left, leaf, new_leaf)
             # row_leaf tracks ALL rows (out-of-bag included, they need score
-            # updates later); the histogram must see only in-bag rows or the
-            # count channel diverges from the root histogram.
-            hist_small = hist_for((row_leaf == target) & in_bag)
+            # updates later); out-of-bag rows contribute zeros via the
+            # pre-masked vals, so the count channel stays consistent with the
+            # root histogram.
+            hist_small = hist_for(row_leaf == target)
             hist_parent = st.leaf_hist[leaf]
             hist_big = hist_parent - hist_small
             hist_left = jnp.where(small_is_left, hist_small, hist_big)
@@ -540,6 +558,7 @@ def make_grower(cfg: GrowerConfig):
         monotone: jnp.ndarray,      # (F,) i32
         cegb_coupled: Optional[jnp.ndarray] = None,  # (F,) f32 (CEGB)
         cegb_lazy: Optional[jnp.ndarray] = None,     # (F,) f32 (CEGB)
+        quant_key: Optional[jnp.ndarray] = None,     # PRNG key (quantized)
     ) -> Tuple[TreeArrays, jnp.ndarray]:
         meta = (num_bins_per_feature, nan_bins, is_categorical, monotone)
         cegb = None
@@ -553,8 +572,38 @@ def make_grower(cfg: GrowerConfig):
         g = grad * sample_mask
         h = hess * sample_mask
         in_bag = sample_mask > 0.0
+        if cfg.quantized:
+            # Reference GradientDiscretizer (gradient_discretizer.hpp:128):
+            # int8 levels + per-iteration scales; histograms accumulate s32
+            # and are rescaled to f32 right before the split scan.
+            from ..ops.quantize import discretize_gradients, gradient_scales
+            if quant_key is None:
+                quant_key = jax.random.PRNGKey(0)
+            g_scale, h_scale = gradient_scales(g, h, cfg.num_grad_quant_bins)
+            gq, hq = discretize_gradients(g, h, g_scale, h_scale, quant_key,
+                                          cfg.stochastic_rounding)
+            vals = jnp.stack([gq, hq, in_bag.astype(jnp.int8)], axis=-1)
+            scale3 = jnp.stack(
+                [g_scale, h_scale, jnp.asarray(1.0, jnp.float32)])
+        else:
+            vals = jnp.stack([g, h, in_bag.astype(jnp.float32)], axis=-1)
+            scale3 = None
         if cfg.gather_rows and bins.shape[0] > _MIN_BUCKET:
-            return _grow_perm(bins, g, h, in_bag, feature_mask, meta, cegb)
-        return _grow_mask(bins, g, h, in_bag, feature_mask, meta, cegb)
+            tree, row_leaf = _grow_perm(bins, vals, scale3, feature_mask,
+                                        meta, cegb)
+        else:
+            tree, row_leaf = _grow_mask(bins, vals, scale3, feature_mask,
+                                        meta, cegb)
+        if cfg.quantized and cfg.quant_renew_leaf:
+            # quant_train_renew_leaf: recompute leaf outputs from the TRUE
+            # (unquantized) gradients (reference RenewIntGradTreeOutput).
+            g_leaf = jax.ops.segment_sum(g, row_leaf, num_segments=L)
+            h_leaf = jax.ops.segment_sum(h, row_leaf, num_segments=L)
+            renewed = leaf_output(g_leaf, h_leaf, cfg.split)
+            active = jnp.arange(L) < tree.num_leaves
+            tree = tree._replace(
+                leaf_value=jnp.where(active, renewed, 0.0),
+                leaf_weight=jnp.where(active, h_leaf, 0.0))
+        return tree, row_leaf
 
     return grow

@@ -37,14 +37,17 @@ class RandomForest(GBDT):
             g_dev = jnp.asarray(grad, jnp.float32).reshape(self.scores.shape)
             h_dev = jnp.asarray(hess, jnp.float32).reshape(self.scores.shape)
         mask_dev, fmask, _ = self._iter_masks(grad, hess)
+        qkey = (jax.random.fold_in(self._quant_key, self.iter_)
+                if self._quant_key is not None else None)
 
         num_leaves_flags = []
         for k in range(self.num_class):
             gk = g_dev[:, k] if self._shape_k else g_dev
             hk = h_dev[:, k] if self._shape_k else h_dev
+            qk = None if qkey is None else jax.random.fold_in(qkey, k)
             zero = jnp.zeros(self.train_data.num_data, jnp.float32)
             contrib, arrays, row_leaf = self._grow_apply(
-                zero, gk, hk, mask_dev, fmask, 1.0)
+                zero, gk, hk, mask_dev, fmask, 1.0, quant_key=qk)
             self.dev_models[k].append(arrays)
             self._host_cache[k].append(None)
             num_leaves_flags.append(arrays.num_leaves)

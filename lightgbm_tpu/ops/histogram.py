@@ -43,12 +43,13 @@ def pack_values(
 @functools.partial(jax.jit, static_argnames=("num_bins", "rows_block"))
 def histogram_onehot(
     bins: jnp.ndarray,       # (N, F) integer bins
-    vals: jnp.ndarray,       # (N, 3) f32 masked (grad, hess, 1)
+    vals: jnp.ndarray,       # (N, 3) f32 (grad, hess, 1) or int8 quantized
     *,
     num_bins: int,
     rows_block: int = 16384,
-) -> jnp.ndarray:            # (F, num_bins, 3) f32
+) -> jnp.ndarray:            # (F, num_bins, 3) f32 — or i32 for int8 vals
     n, f = bins.shape
+    integer = jnp.issubdtype(vals.dtype, jnp.integer)
     pad = (-n) % rows_block
     if pad:
         bins = jnp.pad(bins, ((0, pad), (0, 0)))
@@ -57,19 +58,22 @@ def histogram_onehot(
     bins_blk = bins.reshape(nblocks, rows_block, f)
     vals_blk = vals.reshape(nblocks, rows_block, 3)
     iota = jnp.arange(num_bins, dtype=jnp.int32)
+    acc_dtype = jnp.int32 if integer else vals.dtype
 
     def body(acc, blk):
         b, v = blk
         onehot = (b.astype(jnp.int32)[:, :, None] == iota[None, None, :])
-        acc = acc + jnp.einsum(
-            "nfb,nc->fbc",
-            onehot.astype(v.dtype),
-            v,
-            precision=jax.lax.Precision.HIGHEST,
-        )
-        return acc, None
+        if integer:
+            # Quantized path: s8 x s8 -> s32 (the MXU's integer contraction;
+            # reference Int32HistogramSumReducer accumulation, bin.h:48-81).
+            part = jnp.einsum("nfb,nc->fbc", onehot.astype(jnp.int8), v,
+                              preferred_element_type=jnp.int32)
+        else:
+            part = jnp.einsum("nfb,nc->fbc", onehot.astype(v.dtype), v,
+                              precision=jax.lax.Precision.HIGHEST)
+        return acc + part, None
 
-    init = jnp.zeros((f, num_bins, 3), dtype=vals.dtype)
+    init = jnp.zeros((f, num_bins, 3), dtype=acc_dtype)
     hist, _ = jax.lax.scan(body, init, (bins_blk, vals_blk))
     return hist
 
@@ -80,9 +84,11 @@ def histogram_segment(
 ) -> jnp.ndarray:
     """Scatter-add variant (useful on CPU; TPU scatters serialize)."""
     n, f = bins.shape
+    integer = jnp.issubdtype(vals.dtype, jnp.integer)
+    acc_dtype = jnp.int32 if integer else vals.dtype
     flat_ids = bins.astype(jnp.int32) + jnp.arange(f, dtype=jnp.int32)[None, :] * num_bins
-    hist = jnp.zeros((f * num_bins, 3), dtype=vals.dtype)
-    hist = hist.at[flat_ids].add(vals[:, None, :])
+    hist = jnp.zeros((f * num_bins, 3), dtype=acc_dtype)
+    hist = hist.at[flat_ids].add(vals.astype(acc_dtype)[:, None, :])
     return hist.reshape(f, num_bins, 3)
 
 
@@ -98,6 +104,11 @@ def histogram_from_vals(
     if impl == "auto":
         impl = "pallas" if jax.default_backend() == "tpu" else "segment"
     if impl == "pallas":
+        if jnp.issubdtype(vals.dtype, jnp.integer):
+            # Quantized histograms ride the s8 einsum path until the int8
+            # Pallas kernel lands.
+            return histogram_onehot(bins, vals, num_bins=num_bins,
+                                    rows_block=rows_block)
         from .pallas_histogram import histogram_pallas
         return histogram_pallas(bins, vals, num_bins=num_bins,
                                 rows_block=min(rows_block, 2048))

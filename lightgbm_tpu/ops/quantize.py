@@ -1,0 +1,67 @@
+"""Gradient discretization for quantized training (``use_quantized_grad``).
+
+Reference counterpart: ``GradientDiscretizer`` (``src/treelearner/
+gradient_discretizer.hpp:128``, ``.cpp:218``; CUDA analog
+``cuda_gradient_discretizer.cu``) — gradients/hessians are discretized to a
+few integer levels with stochastic rounding, histograms accumulate in
+integers, and gains are computed after rescaling.  This is the reference's
+own answer to histogram bandwidth; on TPU it additionally unlocks the MXU's
+int8 contraction path (s8 x s8 -> s32) and shrinks gradient HBM traffic 4x.
+
+TPU re-design: discretization is a single fused elementwise program on
+device (no host round-trip, PRNG = counter-based ``jax.random`` keyed per
+iteration, so results are reproducible and independent of execution order —
+unlike the reference's per-thread PRNG streams).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+_EPS = 1e-30
+
+
+def gradient_scales(
+    grad: jnp.ndarray, hess: jnp.ndarray, num_bins: int
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-iteration scale factors mapping grad/hess onto integer levels.
+
+    Mirrors the reference's scale computation (``gradient_discretizer.cpp``):
+    gradients use the signed half-range ``num_bins/2 - 1`` levels per sign,
+    hessians (non-negative) the full ``num_bins - 1`` range.
+    """
+    g_levels = max(num_bins // 2 - 1, 1)
+    h_levels = max(num_bins - 1, 1)
+    g_scale = jnp.maximum(jnp.max(jnp.abs(grad)) / g_levels, _EPS)
+    h_scale = jnp.maximum(jnp.max(jnp.abs(hess)) / h_levels, _EPS)
+    return g_scale.astype(jnp.float32), h_scale.astype(jnp.float32)
+
+
+def discretize_gradients(
+    grad: jnp.ndarray,
+    hess: jnp.ndarray,
+    g_scale: jnp.ndarray,
+    h_scale: jnp.ndarray,
+    key: jnp.ndarray,
+    stochastic: bool = True,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """int8 (grad, hess) levels; stochastic rounding keeps E[q * scale] = x.
+
+    Exactly-zero inputs (e.g. masked-out rows) stay exactly zero under
+    stochastic rounding: floor(0 + U[0,1)) == 0.
+    """
+    gs = grad / g_scale
+    hs = hess / h_scale
+    if stochastic:
+        kg, kh = jax.random.split(key)
+        gq = jnp.floor(gs + jax.random.uniform(kg, gs.shape, gs.dtype))
+        hq = jnp.floor(hs + jax.random.uniform(kh, hs.shape, hs.dtype))
+    else:
+        gq = jnp.round(gs)
+        hq = jnp.round(hs)
+    gq = jnp.clip(gq, -127, 127).astype(jnp.int8)
+    hq = jnp.clip(hq, -127, 127).astype(jnp.int8)
+    return gq, hq

@@ -1,0 +1,122 @@
+"""Quantized training (use_quantized_grad) — reference GradientDiscretizer
+(src/treelearner/gradient_discretizer.hpp:128, cuda_gradient_discretizer.cu)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.ops.histogram import histogram_onehot, histogram_segment
+from lightgbm_tpu.ops.quantize import discretize_gradients, gradient_scales
+
+
+def _auc(y, s):
+    order = np.argsort(s)
+    ranks = np.empty(len(s))
+    ranks[order] = np.arange(1, len(s) + 1)
+    pos = y == 1
+    npos, nneg = pos.sum(), (~pos).sum()
+    return (ranks[pos].sum() - npos * (npos + 1) / 2) / (npos * nneg)
+
+
+def _binary_problem(n, f=8, seed=3):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    logits = X[:, 0] + 0.7 * X[:, 1] - 0.4 * X[:, 2] * X[:, 0]
+    y = (rng.rand(n) < 1 / (1 + np.exp(-logits))).astype(np.float64)
+    return X, y
+
+
+class TestIntHistogram:
+    def test_int8_matches_oracle(self, rng):
+        n, f, b = 500, 6, 16
+        bins = rng.randint(0, b, size=(n, f)).astype(np.uint8)
+        vals = rng.randint(-5, 6, size=(n, 3)).astype(np.int8)
+        oracle = np.zeros((f, b, 3), np.int64)
+        for i in range(n):
+            for j in range(f):
+                oracle[j, bins[i, j]] += vals[i]
+        h1 = np.asarray(histogram_onehot(jnp.asarray(bins), jnp.asarray(vals),
+                                         num_bins=b, rows_block=128))
+        h2 = np.asarray(histogram_segment(jnp.asarray(bins), jnp.asarray(vals),
+                                          num_bins=b))
+        assert h1.dtype == np.int32 and h2.dtype == np.int32
+        np.testing.assert_array_equal(h1, oracle)
+        np.testing.assert_array_equal(h2, oracle)
+
+
+class TestDiscretize:
+    def test_zero_stays_zero_and_unbiased(self):
+        g = jnp.asarray(np.concatenate([np.zeros(1000),
+                                        np.full(1000, 0.3)]), jnp.float32)
+        h = jnp.asarray(np.concatenate([np.zeros(1000),
+                                        np.full(1000, 0.21)]), jnp.float32)
+        gs, hs = gradient_scales(g, h, 4)
+        gq, hq = discretize_gradients(g, h, gs, hs, jax.random.PRNGKey(7))
+        gq, hq = np.asarray(gq), np.asarray(hq)
+        # masked-out rows must stay exactly zero (in-bag accounting)
+        assert (gq[:1000] == 0).all() and (hq[:1000] == 0).all()
+        # stochastic rounding is unbiased: mean(q)*scale ~= value
+        np.testing.assert_allclose(gq[1000:].mean() * float(gs), 0.3, rtol=0.1)
+        np.testing.assert_allclose(hq[1000:].mean() * float(hs), 0.21, rtol=0.1)
+
+    def test_deterministic_rounding(self):
+        g = jnp.asarray([0.6, -0.6, 0.2], jnp.float32)
+        h = jnp.asarray([0.5, 0.25, 1.0], jnp.float32)
+        gs, hs = gradient_scales(g, h, 4)
+        gq, hq = discretize_gradients(g, h, gs, hs, jax.random.PRNGKey(0),
+                                      stochastic=False)
+        np.testing.assert_array_equal(np.asarray(gq), [1, -1, 0])
+        assert np.asarray(hq)[2] == 3  # max hess -> top level
+
+
+class TestQuantizedTraining:
+    @pytest.mark.parametrize("n", [1500, 4000])  # mask path / perm path
+    def test_auc_parity_with_fp32(self, n):
+        X, y = _binary_problem(n)
+        base = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+                "min_data_in_leaf": 5, "seed": 7, "metric": "none"}
+        out = {}
+        for name, extra in [("fp32", {}),
+                            ("quant", {"use_quantized_grad": True,
+                                       "num_grad_quant_bins": 16})]:
+            bst = lgb.train({**base, **extra}, lgb.Dataset(X, label=y), 40)
+            out[name] = _auc(y, bst.predict(X, raw_score=True))
+        assert out["fp32"] > 0.8
+        assert abs(out["fp32"] - out["quant"]) < 2e-3, out
+
+    def test_default_bins_learns(self):
+        X, y = _binary_problem(3000)
+        bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                         "verbosity": -1, "use_quantized_grad": True,
+                         "seed": 1, "metric": "none"},
+                        lgb.Dataset(X, label=y), 60)
+        assert _auc(y, bst.predict(X, raw_score=True)) > 0.85
+
+    def test_deterministic_given_seed(self):
+        X, y = _binary_problem(1200)
+        params = {"objective": "binary", "num_leaves": 7, "verbosity": -1,
+                  "use_quantized_grad": True, "seed": 11, "metric": "none"}
+        p = [lgb.train(params, lgb.Dataset(X, label=y), 10).predict(X)
+             for _ in range(2)]
+        np.testing.assert_array_equal(p[0], p[1])
+
+    def test_renew_leaf(self):
+        X, y = _binary_problem(2500)
+        params = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+                  "use_quantized_grad": True, "quant_train_renew_leaf": True,
+                  "num_grad_quant_bins": 8, "seed": 5, "metric": "none"}
+        bst = lgb.train(params, lgb.Dataset(X, label=y), 40)
+        assert _auc(y, bst.predict(X, raw_score=True)) > 0.85
+
+    def test_quantized_with_bagging_and_goss(self):
+        X, y = _binary_problem(2500)
+        for extra in [{"bagging_fraction": 0.7, "bagging_freq": 1},
+                      {"data_sample_strategy": "goss"}]:
+            params = {"objective": "binary", "num_leaves": 15,
+                      "verbosity": -1, "use_quantized_grad": True,
+                      "num_grad_quant_bins": 16, "seed": 5, "metric": "none",
+                      **extra}
+            bst = lgb.train(params, lgb.Dataset(X, label=y), 30)
+            assert _auc(y, bst.predict(X, raw_score=True)) > 0.8
