@@ -39,6 +39,7 @@ class SplitConfig:
     cat_smooth: float = 10.0
     max_cat_threshold: int = 32
     max_cat_to_onehot: int = 4
+    min_data_per_group: int = 100
     path_smooth: float = 0.0
     # Static dataset facts (set from the bin mappers) that let the compiled
     # scan skip whole candidate families.  True = "may be present" (safe).
