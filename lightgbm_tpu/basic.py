@@ -143,6 +143,7 @@ class Booster:
         model_file: Optional[str] = None,
         model_str: Optional[str] = None,
         valid_sets: Sequence[Tuple[str, Dataset]] = (),
+        base_model=None,
     ):
         self.params = dict(params or {})
         self.best_iteration = -1
@@ -166,7 +167,7 @@ class Booster:
             cls = RandomForest
         else:
             cls = GBDT
-        self._gbdt = cls(self.cfg, td, valid_td)
+        self._gbdt = cls(self.cfg, td, valid_td, base_model=base_model)
         self.train_set = train_set
 
     # ------------------------------------------------------------------- train
@@ -215,6 +216,10 @@ class Booster:
         if num_iteration is None and self.best_iteration > 0:
             num_iteration = self.best_iteration
         if pred_leaf or pred_contrib:
+            if getattr(self._gbdt, "base_model", None) is not None:
+                raise ValueError(
+                    "pred_leaf/pred_contrib on a continuation booster is not "
+                    "supported yet; save_model() and reload, then predict")
             from .explain import predict_leaf_index, predict_contrib
             fn = predict_leaf_index if pred_leaf else predict_contrib
             return fn(self._gbdt, _as_2d(data), start_iteration, num_iteration)
@@ -225,7 +230,8 @@ class Booster:
     # -------------------------------------------------------------------- misc
     @property
     def current_iteration(self) -> int:
-        return self._gbdt.iter_
+        base = getattr(self._gbdt, "base_model", None)
+        return self._gbdt.iter_ + (base.iter_ if base is not None else 0)
 
     def num_trees(self) -> int:
         return self._gbdt.num_trees
@@ -247,7 +253,10 @@ class Booster:
 
     def model_to_string(self, num_iteration: Optional[int] = None,
                         start_iteration: int = 0) -> str:
-        from .serialization import model_to_string
+        from .serialization import LoadedModel, model_to_string
+        if isinstance(self._gbdt, LoadedModel):
+            return self._gbdt.to_string(num_iteration=num_iteration,
+                                        start_iteration=start_iteration)
         return model_to_string(self._gbdt, num_iteration=num_iteration,
                                start_iteration=start_iteration)
 
@@ -300,7 +309,55 @@ class Booster:
         return rows
 
     def eval(self, data: Dataset, name: str, feval=None):
-        raise NotImplementedError("use valid_sets at construction (round 1)")
+        """Evaluate the current model on an arbitrary dataset (reference
+        ``Booster.eval`` -> ``LGBM_BoosterGetEval`` on an added valid set).
+        Unlike training valid_sets the scores are recomputed per call."""
+        label = data.label
+        weight = data.weight
+        group = data.group
+        raw = self._gbdt.predict_raw(data.data)
+        raw = np.asarray(raw, np.float64)
+        metrics = getattr(self._gbdt, "metrics", None)
+        if metrics is None:  # loaded (prediction-only) booster
+            from .metrics import create_metric, default_metric_for_objective
+            names = self._gbdt.cfg.metric or [
+                default_metric_for_objective(self._gbdt.cfg.objective)]
+            metrics = []
+            for nm in names:
+                if nm not in ("", "none", "null", "na", "custom"):
+                    metrics.extend(create_metric(nm, self._gbdt.cfg))
+        out = []
+        for m in metrics:
+            out.append((name, m.name,
+                        m(label, raw, weight, group),
+                        m.higher_better))
+        if feval is not None:
+            res = feval(raw, data)
+            if res is not None:
+                if not isinstance(res, list):
+                    res = [res]
+                for metric, value, hb in res:
+                    out.append((name, metric, value, hb))
+        return out
+
+    def refit(self, data, label, decay_rate: float = 0.9, weight=None,
+              group=None, **kwargs) -> "Booster":
+        """Refit leaf values on new data keeping all tree structures
+        (reference ``GBDT::RefitTree``, ``gbdt.cpp:258``; new leaf output =
+        decay_rate * old + (1 - decay_rate) * refit).  ``weight``/``group``
+        feed the objective's gradients like the reference's Metadata."""
+        from .refit import refit_booster, refit_loaded
+        from .serialization import LoadedModel
+        if isinstance(self._gbdt, LoadedModel):
+            new_model = refit_loaded(self._gbdt, _as_2d(data),
+                                     np.asarray(label), decay_rate,
+                                     weight=weight, group=group)
+            out = copy.copy(self)
+            out._gbdt = new_model
+            return out
+        return refit_booster(self, _as_2d(data), np.asarray(label),
+                             decay_rate, self.params,
+                             weight=weight, group=group)
 
     def eval_train(self, feval=None):
         return [e for e in self._evals(feval) if e[0] == "training"]

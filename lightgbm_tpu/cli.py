@@ -59,15 +59,17 @@ def run(argv: List[str]) -> int:
                                       reference=ds, params=params))
             valid_names.append(f"valid_{i}")
         from .callback import log_evaluation
+        init_model = cfg.input_model or None
         bst = train_fn(dict(params), ds, num_boost_round=cfg.num_iterations,
                        valid_sets=valid_sets, valid_names=valid_names,
+                       init_model=init_model,
                        callbacks=[log_evaluation(cfg.metric_freq)])
-        out = params.get("output_model", "LightGBM_model.txt")
+        out = cfg.output_model or "LightGBM_model.txt"
         bst.save_model(out)
         Log.info(f"Finished training; model saved to {out}")
         return 0
     if task == "predict":
-        model_path = params.get("input_model", "LightGBM_model.txt")
+        model_path = cfg.input_model or "LightGBM_model.txt"
         data_path = params.get("data")
         if not data_path:
             Log.fatal("task=predict requires data=<file>")
@@ -79,10 +81,27 @@ def run(argv: List[str]) -> int:
         Log.info(f"Finished prediction; results saved to {out}")
         return 0
     if task == "convert_model":
-        Log.fatal("convert_model (C++ codegen) is not supported on the TPU "
-                  "build yet")
+        from .convert_model import convert_model_file
+        model_path = cfg.input_model or "LightGBM_model.txt"
+        out = params.get("convert_model", "gbdt_prediction.cpp")
+        convert_model_file(model_path, out,
+                           params.get("convert_model_language", "cpp"))
+        Log.info(f"Finished converting model; code saved to {out}")
+        return 0
     if task == "refit":
-        Log.fatal("refit task lands with the refit API")
+        # Reference application.cpp task=refit: load model, refit leaf values
+        # on the provided data, save (keeps every tree's structure).
+        model_path = cfg.input_model or "LightGBM_model.txt"
+        data_path = params.get("data")
+        if not data_path:
+            Log.fatal("task=refit requires data=<file>")
+        X, y, w, g = load_data_file(data_path, cfg.label_column, cfg.header)
+        new_bst = Booster(model_file=model_path).refit(
+            X, y, decay_rate=cfg.refit_decay_rate, weight=w, group=g)
+        out = cfg.output_model or "LightGBM_model.txt"
+        new_bst.save_model(out)
+        Log.info(f"Finished refit; model saved to {out}")
+        return 0
     Log.fatal(f"unknown task {task}")
     return 1
 

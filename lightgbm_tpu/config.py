@@ -89,6 +89,10 @@ _PARAMS: List[Tuple[str, Any, Any, Tuple[str, ...], Optional[Tuple[Any, Any]]]] 
     ("feature_contri", "list_float", None, ("feature_contrib", "fc", "fp", "feature_penalty"), None),
     ("forcedsplits_filename", str, "", ("fs", "forced_splits_filename", "forced_splits_file", "forced_splits"), None),
     ("refit_decay_rate", float, 0.9, (), (0.0, 1.0)),
+    # IO / continuation (reference config.h "IO Parameters" block).
+    ("input_model", str, "", ("model_input", "model_in"), None),
+    ("output_model", str, "LightGBM_model.txt", ("model_output", "model_out"), None),
+    ("snapshot_freq", int, -1, ("save_period",), None),
     ("cegb_tradeoff", float, 1.0, (), (0.0, None)),
     ("cegb_penalty_split", float, 0.0, (), (0.0, None)),
     ("cegb_penalty_feature_lazy", "list_float", None, (), None),

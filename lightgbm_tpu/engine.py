@@ -55,11 +55,43 @@ def train(
         nm = names[i] if i < len(names) else f"valid_{i}"
         valid_pairs.append((nm, vs))
 
-    booster = Booster(params=params, train_set=train_set,
-                      valid_sets=valid_pairs)
+    # Training continuation (reference boosting.cpp:34-59 + engine.py init_model
+    # handling): load the base model, replay its raw predictions into every
+    # dataset's init_score, and keep its trees for saving/prediction.
+    base = None
     if init_model is not None:
-        raise NotImplementedError(
-            "init_model continuation lands with model serialization round")
+        from .serialization import LoadedModel, load_model_string
+        if isinstance(init_model, Booster):
+            base = load_model_string(init_model.model_to_string())
+        elif isinstance(init_model, LoadedModel):
+            base = init_model
+        else:
+            with open(init_model) as fh:
+                base = load_model_string(fh.read())
+
+        def _fold_init(ds: Dataset) -> Dataset:
+            # Work on a shallow copy: the caller's Dataset must keep its own
+            # init_score (re-running train() on it would otherwise compound).
+            out = copy.copy(ds)
+            pred = np.asarray(base.predict_raw(ds.data), np.float64)
+            if ds.init_score is not None:
+                pred = pred + np.asarray(ds.init_score,
+                                         np.float64).reshape(pred.shape)
+            out.init_score = pred
+            out._train_data = None  # re-construct with the new init_score
+            return out
+        orig_train = train_set
+        train_set = _fold_init(train_set)
+        new_pairs = []
+        for nm, vs in valid_pairs:
+            vc = _fold_init(vs)
+            if vc.reference is orig_train:
+                vc.reference = train_set
+            new_pairs.append((nm, vc))
+        valid_pairs = new_pairs
+
+    booster = Booster(params=params, train_set=train_set,
+                      valid_sets=valid_pairs, base_model=base)
 
     cbs = list(callbacks or [])
     if early_stopping_rounds is not None and valid_pairs:
@@ -71,10 +103,18 @@ def train(
     cbs_before.sort(key=lambda cb: getattr(cb, "order", 0))
     cbs_after.sort(key=lambda cb: getattr(cb, "order", 0))
 
+    # Periodic model snapshots (reference gbdt.cpp:250-254 snapshot_freq:
+    # saves "<output_model>.snapshot_iter_<n>" during training).  Resolved
+    # through Config so aliases (save_period, model_out, ...) apply.
+    snapshot_freq = booster.cfg.snapshot_freq
+    snapshot_base = booster.cfg.output_model or "LightGBM_model.txt"
+
     for it in range(num_boost_round):
         for cb in cbs_before:
             cb(CallbackEnv(booster, params, it, 0, num_boost_round, None))
         finished = booster.update(fobj=fobj)
+        if snapshot_freq > 0 and (it + 1) % snapshot_freq == 0:
+            booster.save_model(f"{snapshot_base}.snapshot_iter_{it + 1}")
         # Skip metric evaluation entirely when nothing consumes it — avoids a
         # host transfer + metric sort per iteration.
         if cbs_after or feval is not None:
@@ -84,7 +124,11 @@ def train(
                     cb(CallbackEnv(booster, params, it, 0, num_boost_round,
                                    evals))
             except EarlyStopException as e:
-                booster.best_iteration = e.best_iteration + 1
+                # best_iteration counts over the COMBINED model (base trees
+                # first) so Booster.predict's num_iteration slicing keeps the
+                # full base ensemble.
+                n_base = base.iter_ if base is not None else 0
+                booster.best_iteration = e.best_iteration + 1 + n_base
                 booster.best_score = e.best_score
                 break
         if finished:

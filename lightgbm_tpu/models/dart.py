@@ -18,8 +18,8 @@ from .tree import predict_tree_bins_device
 
 
 class DART(GBDT):
-    def __init__(self, cfg, train, valids=()):
-        super().__init__(cfg, train, valids)
+    def __init__(self, cfg, train, valids=(), base_model=None):
+        super().__init__(cfg, train, valids, base_model=base_model)
         self.drop_rng = np.random.RandomState(cfg.drop_seed)
 
     def _tree_pred_idx(self, k: int, idx: int, bins):

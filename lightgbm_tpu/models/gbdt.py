@@ -95,11 +95,17 @@ class GBDT:
     """Boosting driver (reference ``GBDT``, ``gbdt.h:630``)."""
 
     def __init__(self, cfg: Config, train: TrainData,
-                 valids: Sequence[Tuple[str, TrainData]] = ()):
+                 valids: Sequence[Tuple[str, TrainData]] = (),
+                 base_model=None):
         self.cfg = cfg
         self.train_data = train
         self.valids = list(valids)
         self.num_class = cfg.num_model_per_iteration
+        # Training continuation (reference boosting.cpp:34-59 input_model):
+        # ``base_model`` is a LoadedModel whose raw-score predictions were
+        # folded into every dataset's init_score by the caller; its trees are
+        # re-emitted on save and summed into predictions.
+        self.base_model = base_model
         self.objective: Optional[ObjectiveFunction] = create_objective(cfg)
         if self.objective is not None:
             self.objective.init(train.label, train.weight, train.group, cfg)
@@ -165,7 +171,10 @@ class GBDT:
 
         self._linear_nls: List[int] = []
         self.init_scores = np.zeros(self.num_class, np.float64)
-        if cfg.boost_from_average and self.objective is not None:
+        # Reference gbdt.cpp:319 BoostFromAverage applies only when the data
+        # carries no init score (continuation replays the base model there).
+        if (cfg.boost_from_average and self.objective is not None
+                and train.init_score is None):
             for k in range(self.num_class):
                 self.init_scores[k] = self.objective.boost_from_score(k)
         self.scores = self._init_scores_array(train)
@@ -502,7 +511,28 @@ class GBDT:
     # --------------------------------------------------------------- prediction
     def predict_raw(self, X: np.ndarray, num_iteration: Optional[int] = None,
                     start_iteration: int = 0) -> np.ndarray:
-        """Raw scores for new data: host binning, then either the native C++
+        """Raw scores for new data.  Iterations are indexed over the COMBINED
+        model: a continuation base model's trees come first (reference
+        ``GBDT::GetPredictAt`` over the full ensemble), then this booster's."""
+        if self.base_model is not None:
+            nb = self.base_model.iter_
+            end = (None if num_iteration is None
+                   else start_iteration + num_iteration)
+            b_start = min(start_iteration, nb)
+            b_num = (nb if end is None else max(min(end, nb), b_start)) - b_start
+            base = self.base_model.predict_raw(
+                np.asarray(X, np.float64), num_iteration=b_num,
+                start_iteration=b_start)
+            own_start = max(start_iteration - nb, 0)
+            own_num = (None if end is None
+                       else max(end - nb - own_start, 0))
+            return base + self._predict_raw_own(X, own_num, own_start)
+        return self._predict_raw_own(X, num_iteration, start_iteration)
+
+    def _predict_raw_own(self, X: np.ndarray,
+                         num_iteration: Optional[int] = None,
+                         start_iteration: int = 0) -> np.ndarray:
+        """This booster's own trees: host binning, then either the native C++
         batch traversal (small batches; no device round-trip) or the device
         ensemble scan (large batches)."""
         from .. import native
@@ -619,11 +649,17 @@ class GBDT:
 
     @property
     def num_trees(self) -> int:
-        return sum(len(m) for m in self.dev_models)
+        own = sum(len(m) for m in self.dev_models)
+        if self.base_model is not None:
+            own += self.base_model.num_trees
+        return own
 
     def feature_importance(self, importance_type: str = "split") -> np.ndarray:
         """reference ``GBDT::FeatureImportance`` (``gbdt.cpp``)."""
         imp = np.zeros(self.train_data.num_features, np.float64)
+        if self.base_model is not None:
+            base_imp = self.base_model.feature_importance(importance_type)
+            imp[: len(base_imp)] += base_imp
         for cls_models in self.models:
             for tree in cls_models:
                 k = tree.num_splits()

@@ -16,14 +16,14 @@ from .tree import predict_tree_bins_device
 
 
 class RandomForest(GBDT):
-    def __init__(self, cfg, train, valids=()):
+    def __init__(self, cfg, train, valids=(), base_model=None):
         if not (cfg.bagging_freq > 0 and (cfg.bagging_fraction < 1.0
                                           or cfg.feature_fraction < 1.0)):
             raise ValueError(
                 "rf boosting requires bagging (bagging_freq>0 and "
                 "bagging_fraction<1) or feature_fraction<1  "
                 "(reference rf.hpp constructor check)")
-        super().__init__(cfg, train, valids)
+        super().__init__(cfg, train, valids, base_model=base_model)
         # Scores are frozen at the init score; trees are averaged at predict.
         self._init_train_scores = self.scores
         self._sum_scores = jnp.zeros_like(self.scores)

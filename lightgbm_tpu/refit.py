@@ -1,0 +1,154 @@
+"""Model refit: keep every tree's structure, refit leaf values on new data.
+
+Reference: ``GBDT::RefitTree`` (``src/boosting/gbdt.cpp:258``) +
+``SerialTreeLearner::FitByExistingTree`` (``serial_tree_learner.cpp:247``):
+per iteration, gradients are computed at the progressively-updated scores,
+each leaf's output becomes ``decay * old + (1 - decay) * shrinkage *
+CalculateSplittedLeafOutput(sum_grad, sum_hess)``.
+
+Host-side by design: the per-tree leaf routing is a handful of vectorized
+numpy traversals over the new data — refit is a one-shot model surgery, not
+a training hot loop.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import TYPE_CHECKING
+
+import numpy as np
+
+from .config import Config
+
+if TYPE_CHECKING:
+    from .basic import Booster
+
+
+def _leaf_output_np(g: np.ndarray, h: np.ndarray, cfg: Config) -> np.ndarray:
+    l1 = cfg.lambda_l1
+    t = np.sign(g) * np.maximum(np.abs(g) - l1, 0.0) if l1 > 0 else g
+    out = -t / (h + cfg.lambda_l2 + 1e-15)
+    if cfg.max_delta_step > 0:
+        out = np.clip(out, -cfg.max_delta_step, cfg.max_delta_step)
+    return out
+
+
+def refit_loaded(model, X: np.ndarray, label: np.ndarray,
+                 decay_rate: float, weight=None, group=None):
+    """Refit a LoadedModel (raw-threshold trees) in place-free fashion and
+    return the new LoadedModel.  Reference flow: ``Application`` task=refit —
+    predict leaf indices with the loaded model, then ``GBDT::RefitTree``."""
+    import jax
+    import jax.numpy as jnp
+
+    cfg = Config({k: v for k, v in model.params.items()})
+    if model.cfg.num_class > 1:
+        cfg.update({"objective": model.cfg.objective,
+                    "num_class": model.cfg.num_class})
+    from .objectives import create_objective
+    objective = create_objective(cfg)
+    if objective is None:
+        raise ValueError("refit requires a built-in objective")
+    objective.init(np.asarray(label),
+                   None if weight is None else np.asarray(weight, np.float32),
+                   None if group is None else np.asarray(group, np.int64),
+                   cfg)
+
+    if any(t.is_linear for t in model.trees):
+        raise ValueError("refit of linear-tree models is not supported "
+                         "(leaf linear coefficients are not refit)")
+    X = np.asarray(X, np.float64)
+    n = X.shape[0]
+    k_cls = model.num_class
+    new_model = copy.copy(model)
+    new_model.trees = [copy.copy(t) for t in model.trees]
+    n_iters = len(model.trees) // k_cls
+    scores = np.tile(np.asarray(model.init_scores, np.float64)[None, :k_cls],
+                     (n, 1)).astype(np.float32)
+    for it in range(n_iters):
+        sc = scores[:, 0] if k_cls == 1 else scores
+        g_dev, h_dev = objective.get_gradients(jnp.asarray(sc))
+        g = np.asarray(jax.device_get(g_dev)).reshape(n, -1)
+        h = np.asarray(jax.device_get(h_dev)).reshape(n, -1)
+        for k in range(k_cls):
+            tree = new_model.trees[it * k_cls + k]
+            nl = tree.num_leaves
+            leaf = tree.predict_leaf(X)
+            sum_g = np.bincount(leaf, weights=g[:, k], minlength=nl)
+            sum_h = np.bincount(leaf, weights=h[:, k], minlength=nl) + 1e-15
+            refit_val = _leaf_output_np(sum_g, sum_h, cfg) * tree.shrinkage
+            new_leaf = (decay_rate * np.asarray(tree.leaf_value[:nl],
+                                                np.float64)
+                        + (1.0 - decay_rate) * refit_val)
+            tree.leaf_value = np.asarray(tree.leaf_value, np.float64).copy()
+            tree.leaf_value[:nl] = new_leaf
+            scores[:, k] += new_leaf[leaf].astype(np.float32)
+    return new_model
+
+
+def refit_booster(booster: "Booster", X: np.ndarray, label: np.ndarray,
+                  decay_rate: float, params: dict,
+                  weight=None, group=None) -> "Booster":
+    import jax
+    import jax.numpy as jnp
+
+    gbdt = booster._gbdt
+    if getattr(gbdt, "base_model", None) is not None:
+        raise ValueError("refit of a continuation booster is not supported; "
+                         "save and reload the combined model first")
+    if gbdt.cfg.linear_tree:
+        raise ValueError("refit of linear-tree models is not supported "
+                         "(leaf linear coefficients are not refit)")
+    cfg = gbdt.cfg
+    td = gbdt.train_data
+    binned = td.binned
+    bins = binned.apply(X)
+    nan_bins = np.asarray(binned.nan_bins)
+    n = X.shape[0]
+    k_cls = gbdt.num_class
+
+    new_b = copy.copy(booster)
+    new_gbdt = copy.copy(gbdt)
+    new_b._gbdt = new_gbdt
+    new_gbdt.dev_models = [list(m) for m in gbdt.dev_models]
+    new_gbdt._host_cache = [list(m) for m in gbdt._host_cache]
+
+    objective = gbdt.objective
+    if objective is None:
+        raise ValueError("refit requires a built-in objective")
+    objective = copy.copy(objective)
+    objective.init(np.asarray(label),
+                   None if weight is None else np.asarray(weight, np.float32),
+                   None if group is None else np.asarray(group, np.int64),
+                   cfg)
+
+    scores = np.tile(gbdt.init_scores[None, :], (n, 1)).astype(np.float32)
+    n_iters = min(len(m) for m in gbdt.models) if gbdt.models else 0
+    sc_dev_shape = (n,) if k_cls == 1 else (n, k_cls)
+    for it in range(n_iters):
+        sc = scores[:, 0] if k_cls == 1 else scores
+        g_dev, h_dev = objective.get_gradients(jnp.asarray(
+            sc.reshape(sc_dev_shape)))
+        g = np.asarray(jax.device_get(g_dev)).reshape(n, -1)
+        h = np.asarray(jax.device_get(h_dev)).reshape(n, -1)
+        for k in range(k_cls):
+            tree = copy.copy(gbdt.models[k][it])
+            nl = tree.num_leaves
+            leaf = tree.predict_leaf_bins(bins, nan_bins)
+            sum_g = np.bincount(leaf, weights=g[:, k], minlength=nl)
+            sum_h = np.bincount(leaf, weights=h[:, k], minlength=nl) + 1e-15
+            refit_val = (_leaf_output_np(sum_g, sum_h, cfg) * tree.shrinkage)
+            new_leaf = (decay_rate * tree.leaf_value[:nl]
+                        + (1.0 - decay_rate) * refit_val)
+            tree.leaf_value = tree.leaf_value.copy()
+            tree.leaf_value[:nl] = new_leaf
+            tree.leaf_count = np.bincount(leaf, minlength=nl).astype(
+                np.float32)[: len(tree.leaf_count)]
+            new_gbdt._host_cache[k][it] = tree
+            arrays = new_gbdt.dev_models[k][it]
+            lv = np.zeros(arrays.leaf_value.shape[0], np.float32)
+            lv[:nl] = new_leaf
+            new_gbdt.dev_models[k][it] = arrays._replace(
+                leaf_value=jnp.asarray(lv))
+            scores[:, k] += new_leaf[leaf]
+    return new_b

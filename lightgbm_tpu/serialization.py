@@ -95,11 +95,52 @@ def _tree_to_string(tree, index: int, mappers) -> str:
     return "\n".join(lines)
 
 
+def _loaded_tree_to_string(t: "LoadedTree", index: int) -> str:
+    """Re-serialize a loaded (raw-threshold) tree verbatim — used when saving a
+    continuation booster so the base model's trees survive unchanged
+    (reference: continuation re-saves the full ensemble)."""
+    m = max(t.num_leaves - 1, 0)
+    lines = [f"Tree={index}", f"num_leaves={t.num_leaves}"]
+    n_cat = int(np.count_nonzero(t.decision_type[:m] & _CAT_MASK)) if m else 0
+    lines.append(f"num_cat={n_cat}")
+    lines.append("split_feature=" + _fmt_arr(t.split_feature[:m], "%d"))
+    lines.append("split_gain=" + _fmt_arr(t.split_gain[:m], "%g"))
+    lines.append("threshold=" + _fmt_arr(t.threshold[:m]))
+    lines.append("decision_type=" + _fmt_arr(t.decision_type[:m], "%d"))
+    lines.append("left_child=" + _fmt_arr(t.left_child[:m], "%d"))
+    lines.append("right_child=" + _fmt_arr(t.right_child[:m], "%d"))
+    lines.append("leaf_value=" + _fmt_arr(t.leaf_value[: t.num_leaves]))
+    if t.internal_value is not None:
+        lines.append("internal_value=" + _fmt_arr(t.internal_value[:m], "%g"))
+    if t.internal_count is not None:
+        lines.append("internal_count=" + _fmt_arr(t.internal_count[:m], "%d"))
+    if t.cat_boundaries is not None:
+        lines.append("cat_boundaries=" + _fmt_arr(t.cat_boundaries, "%d"))
+        lines.append("cat_threshold=" + _fmt_arr(t.cat_threshold, "%d"))
+    if t.is_linear:
+        nl = t.num_leaves
+        lines.append("is_linear=1")
+        lines.append("leaf_const=" + _fmt_arr(t.leaf_const[:nl]))
+        lines.append("num_features=" + _fmt_arr(
+            [len(f) for f in t.leaf_features[:nl]], "%d"))
+        lines.append("leaf_features=" + _fmt_arr(
+            [int(v) for f in t.leaf_features[:nl] for v in f], "%d"))
+        lines.append("leaf_coeff=" + _fmt_arr(
+            [float(v) for c in t.leaf_coeff[:nl] for v in c]))
+    lines.append(f"shrinkage={t.shrinkage:g}")
+    lines.append("")
+    return "\n".join(lines)
+
+
 def model_to_string(gbdt, num_iteration: Optional[int] = None,
                     start_iteration: int = 0) -> str:
     cfg = gbdt.cfg
     td = gbdt.train_data
     mappers = td.binned.mappers
+    base = getattr(gbdt, "base_model", None)
+    init_scores = np.asarray(gbdt.init_scores, np.float64).copy()
+    if base is not None:
+        init_scores[: len(base.init_scores)] += base.init_scores
     out = ["tree", "version=v4",
            f"num_class={gbdt.num_class}",
            f"num_tree_per_iteration={gbdt.num_class}",
@@ -110,17 +151,25 @@ def model_to_string(gbdt, num_iteration: Optional[int] = None,
                td.feature_names or
                [f"Column_{i}" for i in range(td.num_features)]),
            "feature_infos=" + " ".join(_feature_info(m) for m in mappers),
-           "init_scores=" + _fmt_arr(gbdt.init_scores),
+           "init_scores=" + _fmt_arr(init_scores),
            ""]
     end = None if num_iteration is None else start_iteration + num_iteration
     idx = 0
     # Trees are interleaved per iteration (iter0/class0, iter0/class1, ...)
     # matching the reference's model layout and LoadedModel.predict_raw.
-    n_iters = min(len(m) for m in gbdt.models) if gbdt.models else 0
-    iters = range(start_iteration, n_iters if end is None else min(end, n_iters))
+    # Combined indexing: a continuation base model's iterations come first.
+    n_base = base.iter_ if base is not None else 0
+    n_own = min(len(m) for m in gbdt.models) if gbdt.models else 0
+    n_total = n_base + n_own
+    iters = range(start_iteration, n_total if end is None else min(end, n_total))
     for t in iters:
         for k in range(gbdt.num_class):
-            out.append(_tree_to_string(gbdt.models[k][t], idx, mappers))
+            if t < n_base:
+                out.append(_loaded_tree_to_string(
+                    base.trees[t * gbdt.num_class + k], idx))
+            else:
+                out.append(_tree_to_string(gbdt.models[k][t - n_base], idx,
+                                           mappers))
             idx += 1
     out.append("end of trees")
     out.append("")
@@ -147,6 +196,46 @@ def _feature_info(m) -> str:
 
 
 # -------------------------------------------------------------------- JSON dump
+def _loaded_tree_structure_dict(t: "LoadedTree") -> dict:
+    """Nested node dict for a loaded (raw-threshold) tree."""
+    m = max(t.num_leaves - 1, 0)
+
+    def node(idx: int):
+        if m == 0 or idx < 0:
+            leaf = ~idx if idx < 0 else 0
+            return {"leaf_index": int(leaf),
+                    "leaf_value": float(t.leaf_value[leaf])
+                    if leaf < len(t.leaf_value) else 0.0}
+        dt = int(t.decision_type[idx])
+        is_cat = bool(dt & _CAT_MASK)
+        thr = float(t.threshold[idx])
+        if is_cat and t.cat_boundaries is not None:
+            ci = int(thr)
+            lo, hi = int(t.cat_boundaries[ci]), int(t.cat_boundaries[ci + 1])
+            vals = [w * 32 + b for w in range(hi - lo) for b in range(32)
+                    if (int(t.cat_threshold[lo + w]) >> b) & 1]
+            thr_repr = "||".join(str(v) for v in vals)
+        else:
+            thr_repr = thr
+        return {
+            "split_index": int(idx),
+            "split_feature": int(t.split_feature[idx]),
+            "split_gain": float(t.split_gain[idx]),
+            "threshold": thr_repr,
+            "decision_type": "==" if is_cat else "<=",
+            "default_left": bool(dt & _DEFAULT_LEFT_MASK),
+            "missing_type": ["None", "Zero", "NaN"][min((dt >> 2) & 3, 2)],
+            "internal_value": (float(t.internal_value[idx])
+                               if t.internal_value is not None else 0.0),
+            "internal_count": (int(t.internal_count[idx])
+                               if t.internal_count is not None else 0),
+            "left_child": node(int(t.left_child[idx])),
+            "right_child": node(int(t.right_child[idx])),
+        }
+
+    return node(0) if m else node(-1)
+
+
 def _tree_structure_dict(tree, mappers) -> dict:
     """Nested node dict for one tree (reference ``Tree::ToJSON``,
     ``src/io/tree.cpp``)."""
@@ -197,14 +286,30 @@ def model_to_dict(gbdt, num_iteration: Optional[int] = None,
     names = td.feature_names or [f"Column_{i}"
                                  for i in range(td.num_features)]
     end = None if num_iteration is None else start_iteration + num_iteration
-    n_iters = min(len(m) for m in gbdt.models) if gbdt.models else 0
+    base = getattr(gbdt, "base_model", None)
+    n_base = base.iter_ if base is not None else 0
+    n_own = min(len(m) for m in gbdt.models) if gbdt.models else 0
+    n_total = n_base + n_own
     iters = range(start_iteration,
-                  n_iters if end is None else min(end, n_iters))
+                  n_total if end is None else min(end, n_total))
     tree_info = []
     idx = 0
     for t in iters:
         for k in range(gbdt.num_class):
-            tree = gbdt.models[k][t]
+            if t < n_base:
+                lt = base.trees[t * gbdt.num_class + k]
+                tree_info.append({
+                    "tree_index": idx,
+                    "num_leaves": int(lt.num_leaves),
+                    "num_cat": int(np.count_nonzero(
+                        lt.decision_type[: max(lt.num_leaves - 1, 0)]
+                        & _CAT_MASK)),
+                    "shrinkage": float(lt.shrinkage),
+                    "tree_structure": _loaded_tree_structure_dict(lt),
+                })
+                idx += 1
+                continue
+            tree = gbdt.models[k][t - n_base]
             tree_info.append({
                 "tree_index": idx,
                 "num_leaves": int(tree.num_leaves),
@@ -256,19 +361,30 @@ class LoadedTree:
     split_gain: np.ndarray
     cat_boundaries: Optional[np.ndarray] = None
     cat_threshold: Optional[np.ndarray] = None
+    internal_value: Optional[np.ndarray] = None
+    internal_count: Optional[np.ndarray] = None
     shrinkage: float = 1.0
     is_linear: bool = False
     leaf_const: Optional[np.ndarray] = None
     leaf_features: Optional[list] = None
     leaf_coeff: Optional[list] = None
 
+    def predict_leaf(self, X: np.ndarray) -> np.ndarray:
+        """Leaf index per row (raw-value traversal)."""
+        _, leaf = self._walk(X)
+        return leaf
+
     def predict(self, X: np.ndarray) -> np.ndarray:
         """Vectorized raw-value traversal (reference ``Tree::Predict``)."""
+        out, _ = self._walk(X)
+        return out
+
+    def _walk(self, X: np.ndarray):
         n = X.shape[0]
         out = np.empty(n, np.float64)
         if self.num_leaves <= 1:
             out[:] = self.leaf_value[0] if len(self.leaf_value) else 0.0
-            return out
+            return out, np.zeros(n, np.int64)
         node = np.zeros(n, np.int32)
         leaf_idx = np.zeros(n, np.int64)
         active = np.ones(n, bool)
@@ -314,7 +430,7 @@ class LoadedTree:
                     vals = vals + Xl @ self.leaf_coeff[l]
                     vals[nan] = self.leaf_value[l]
                 out[sel] = vals
-        return out
+        return out, leaf_idx
 
     def _cat_left(self, nodes: np.ndarray, values: np.ndarray) -> np.ndarray:
         res = np.zeros(len(nodes), bool)
@@ -337,13 +453,15 @@ class LoadedModel:
 
     def __init__(self, num_class: int, objective: str, trees: List[LoadedTree],
                  init_scores: np.ndarray, feature_names: List[str],
-                 params: Dict[str, str]):
+                 params: Dict[str, str],
+                 header: Optional[Dict[str, str]] = None):
         self.num_class = num_class
         self.objective_name = objective
         self.trees = trees
         self.init_scores = init_scores
         self.feature_names = feature_names
         self.params = params
+        self.header = dict(header or {})
         self.cfg = Config({"objective": objective.split(" ")[0],
                            "num_class": num_class} if num_class > 1 else
                           {"objective": objective.split(" ")[0]})
@@ -395,6 +513,36 @@ class LoadedModel:
             else:
                 np.add.at(imp, t.split_feature, t.split_gain)
         return imp
+
+    def to_string(self, num_iteration: Optional[int] = None,
+                  start_iteration: int = 0) -> str:
+        """Re-serialize (used by task=refit and continuation saves)."""
+        hdr = dict(self.header)
+        hdr.setdefault("num_class", str(self.num_class))
+        hdr.setdefault("num_tree_per_iteration", str(self.num_class))
+        hdr.setdefault("objective", self.objective_name)
+        hdr.setdefault("feature_names", " ".join(self.feature_names))
+        hdr["init_scores"] = _fmt_arr(self.init_scores)
+        out = ["tree"]
+        for key in ("version", "num_class", "num_tree_per_iteration",
+                    "label_index", "max_feature_idx", "objective",
+                    "feature_names", "feature_infos", "init_scores"):
+            if key in hdr:
+                out.append(f"{key}={hdr[key]}")
+        out.append("")
+        end_it = (self.iter_ if num_iteration is None
+                  else min(self.iter_, start_iteration + num_iteration))
+        lo = start_iteration * self.num_class
+        hi = end_it * self.num_class
+        for i, t in enumerate(self.trees[lo:hi]):
+            out.append(_loaded_tree_to_string(t, i))
+        out.append("end of trees")
+        out.append("")
+        out.append("parameters:")
+        for key, val in sorted(self.params.items()):
+            out.append(f"[{key}: {val}]")
+        out.append("end of parameters")
+        return "\n".join(out)
 
 
 def load_model_string(s: str) -> LoadedModel:
@@ -457,6 +605,8 @@ def load_model_string(s: str) -> LoadedModel:
             split_gain=getf("split_gain", np.zeros(m)),
             cat_boundaries=geti("cat_boundaries"),
             cat_threshold=geti("cat_threshold"),
+            internal_value=getf("internal_value"),
+            internal_count=geti("internal_count"),
             shrinkage=float(block.get("shrinkage", 1.0)),
             is_linear=is_linear,
             leaf_const=leaf_const,
@@ -476,4 +626,5 @@ def load_model_string(s: str) -> LoadedModel:
         init_scores=init_scores,
         feature_names=header.get("feature_names", "").split(),
         params=params,
+        header=header,
     )

@@ -1,0 +1,182 @@
+"""Microbenchmarks for the histogram hot path on the real (axon-tunneled) chip.
+
+Tunnel quirks: ~70ms sync round-trip; identical re-dispatches may be cached.
+Every measurement scans R reps inside ONE jit with a carry dependency and
+reports (T(R2)-T(R1))/(R2-R1) with warmup on different data.
+
+Run: python tools/microbench.py [section ...]   Sections: hist step
+"""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+R1, R2 = 8, 40
+
+
+def timed(name, build, n_rows):
+    """build(vals_f32_perturb) -> jitted fn(bins, vals, r) running r reps."""
+    ts = {}
+    for R in (R1, R2):
+        fn = build(R)
+        np.array(fn(0))      # warmup/compile (seed arg varies data inside)
+        t0 = time.perf_counter()
+        np.array(fn(1))
+        ts[R] = time.perf_counter() - t0
+    t = (ts[R2] - ts[R1]) / (R2 - R1)
+    print(f"{name:<38} {t*1e3:8.2f}ms  {n_rows/t/1e6:8.1f} Mrow/s", flush=True)
+    return t
+
+
+def hist_harness(hist_fn, n, F, B, dtype=jnp.float32):
+    """Wrap a histogram fn into a scan-amortized, cache-proof benchmark fn."""
+    rng = np.random.RandomState(0)
+    bins = jnp.asarray(rng.randint(0, B, size=(n, F), dtype=np.uint8))
+    if dtype == jnp.int8:
+        vals0 = jnp.asarray(rng.randint(-16, 16, size=(n, 3), dtype=np.int8))
+    else:
+        vals0 = jnp.asarray(rng.randn(n, 3).astype(np.float32))
+
+    def build(R):
+        @jax.jit
+        def f(seed):
+            if dtype == jnp.int8:
+                vals = vals0 ^ jnp.asarray(seed, jnp.int8)
+            else:
+                vals = vals0 + jnp.asarray(seed, jnp.float32)
+
+            def body(carry, _):
+                h = hist_fn(bins, carry)
+                if dtype == jnp.int8:
+                    nxt = carry ^ (h.reshape(-1)[0] & 1).astype(jnp.int8)
+                else:
+                    nxt = carry + (h.reshape(-1)[0] * 1e-24).astype(carry.dtype)
+                return nxt, h.reshape(-1)[0]
+            _, s = jax.lax.scan(body, vals, jnp.arange(R))
+            return s[-1]
+        return f
+    return build
+
+
+def sec_hist():
+    n, F, B = 1_000_000, 28, 256
+    from lightgbm_tpu.ops.pallas_histogram import histogram_pallas
+    from lightgbm_tpu.ops.histogram import histogram_onehot
+
+    timed("pallas f32 blk2048 (current)",
+          hist_harness(lambda b, v: histogram_pallas(b, v, num_bins=B,
+                                                     rows_block=2048), n, F, B), n)
+    timed("onehot-einsum f32 blk16384",
+          hist_harness(lambda b, v: histogram_onehot(b, v, num_bins=B,
+                                                     rows_block=16384), n, F, B), n)
+
+    def oh_cast(dt):
+        def f(bins, vals):
+            nb = bins.shape[0] // 16384
+            iota = jnp.arange(B, dtype=jnp.int32)
+
+            def body(acc, blk):
+                b, v = blk
+                onehot = (b.astype(jnp.int32)[:, :, None] == iota).astype(dt)
+                part = jnp.einsum("nfb,nc->fbc", onehot, v.astype(dt),
+                                  preferred_element_type=jnp.float32)
+                return acc + part, None
+            init = jnp.zeros((F, B, 3), jnp.float32)
+            h, _ = jax.lax.scan(body, init,
+                                (bins.reshape(nb, 16384, F),
+                                 vals.reshape(nb, 16384, 3)))
+            return h
+        return f
+    timed("onehot-einsum bf16", hist_harness(oh_cast(jnp.bfloat16), n, F, B), n)
+
+    def flat(dt, blk=16384):
+        def f(bins, vals):
+            nb = bins.shape[0] // blk
+            fofs = (jnp.arange(F, dtype=jnp.int32) * B)[None, :]
+
+            def body(acc, b_v):
+                k, v = b_v
+                key = k.astype(jnp.int32) + fofs
+                oh = (key[:, :, None] ==
+                      jnp.arange(B, dtype=jnp.int32)).reshape(blk, F * B)
+                part = jax.lax.dot_general(
+                    v.astype(dt), oh.astype(dt), (((0,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+                return acc + part, None
+            init = jnp.zeros((3, F * B), jnp.float32)
+            h, _ = jax.lax.scan(body, init,
+                                (bins.reshape(nb, blk, F),
+                                 vals.reshape(nb, blk, 3)))
+            return h
+        return f
+    timed("flat-matmul f32", hist_harness(flat(jnp.float32), n, F, B), n)
+    timed("flat-matmul bf16", hist_harness(flat(jnp.bfloat16), n, F, B), n)
+
+    def flat8(bins, vals):
+        blk = 16384
+        nb = bins.shape[0] // blk
+        fofs = (jnp.arange(F, dtype=jnp.int32) * B)[None, :]
+
+        def body(acc, b_v):
+            k, v = b_v
+            key = k.astype(jnp.int32) + fofs
+            oh = (key[:, :, None] ==
+                  jnp.arange(B, dtype=jnp.int32)).reshape(blk, F * B)
+            part = jax.lax.dot_general(
+                v, oh.astype(jnp.int8), (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32)
+            return acc + part, None
+        init = jnp.zeros((3, F * B), jnp.int32)
+        h, _ = jax.lax.scan(body, init,
+                            (bins.reshape(nb, blk, F),
+                             vals.reshape(nb, blk, 3)))
+        return h
+    timed("flat-matmul int8->s32",
+          hist_harness(flat8, n, F, B, dtype=jnp.int8), n)
+
+
+def sec_step():
+    """Per-split fixed overhead: tree growth at moderate n, varying leaves."""
+    from lightgbm_tpu.models.grower import make_grower, GrowerConfig
+    from lightgbm_tpu.ops.split import SplitConfig
+    n, F = 262144, 28
+    rng = np.random.RandomState(0)
+    bins = jnp.asarray(rng.randint(0, 255, size=(n, F), dtype=np.uint8))
+    grad0 = jnp.asarray(rng.randn(n).astype(np.float32))
+    hess = jnp.ones(n, jnp.float32)
+    ones = jnp.ones(n, jnp.float32)
+    fmask = jnp.ones(F, bool)
+    meta = (jnp.full(F, 255, jnp.int32), jnp.full(F, 255, jnp.int32),
+            jnp.zeros(F, bool), jnp.zeros(F, jnp.int32))
+
+    for L in (15, 255):
+        cfg = GrowerConfig(num_leaves=L, split=SplitConfig(min_sum_hess=1.0))
+        grow = make_grower(cfg)
+
+        def build(R):
+            @jax.jit
+            def f(seed):
+                def body(carry, _):
+                    tree, _rl = grow(bins, carry, hess, ones, fmask, *meta)
+                    return carry + tree.leaf_value[0] * 1e-20, tree.leaf_value[0]
+                _, s = jax.lax.scan(body, grad0 + seed, jnp.arange(R))
+                return s[-1]
+            return f
+        ts = {}
+        for R in (2, 6):
+            fn = build(R)
+            np.array(fn(jnp.asarray(0.0)))
+            t0 = time.perf_counter()
+            np.array(fn(jnp.asarray(1.0)))
+            ts[R] = time.perf_counter() - t0
+        t = (ts[6] - ts[2]) / 4
+        print(f"grow n={n} L={L:>4}: {t*1e3:8.1f}ms/tree "
+              f"({t/(L-1)*1e3:6.2f} ms/split)", flush=True)
+
+
+if __name__ == "__main__":
+    for s in (sys.argv[1:] or ["hist", "step"]):
+        print(f"=== {s} ===", flush=True)
+        globals()[f"sec_{s}"]()
