@@ -319,13 +319,8 @@ class Booster:
         raw = np.asarray(raw, np.float64)
         metrics = getattr(self._gbdt, "metrics", None)
         if metrics is None:  # loaded (prediction-only) booster
-            from .metrics import create_metric, default_metric_for_objective
-            names = self._gbdt.cfg.metric or [
-                default_metric_for_objective(self._gbdt.cfg.objective)]
-            metrics = []
-            for nm in names:
-                if nm not in ("", "none", "null", "na", "custom"):
-                    metrics.extend(create_metric(nm, self._gbdt.cfg))
+            from .metrics import metrics_for_config
+            metrics = metrics_for_config(self._gbdt.cfg)
         out = []
         for m in metrics:
             out.append((name, m.name,

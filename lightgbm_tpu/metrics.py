@@ -340,3 +340,16 @@ def default_metric_for_objective(objective: str) -> str:
         "cross_entropy_lambda": "cross_entropy_lambda",
         "lambdarank": "ndcg", "rank_xendcg": "ndcg",
     }.get(objective, "l2")
+
+
+def metrics_for_config(cfg: Config) -> List[Metric]:
+    """Resolve cfg.metric (or the objective's default) into Metric objects,
+    skipping the none/custom placeholders — shared by the training driver
+    and ``Booster.eval`` (reference ``Config::metric`` handling)."""
+    names = cfg.metric or [default_metric_for_objective(cfg.objective)]
+    out: List[Metric] = []
+    for nm in names:
+        if nm in ("", "none", "null", "na", "custom"):
+            continue
+        out.extend(create_metric(nm, cfg))
+    return out

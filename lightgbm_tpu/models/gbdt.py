@@ -22,7 +22,7 @@ import numpy as np
 
 from ..config import Config
 from ..dataset import TrainData
-from ..metrics import Metric, create_metric, default_metric_for_objective
+from ..metrics import Metric
 from ..objectives import ObjectiveFunction, create_objective
 from ..sampling import FeatureSampler, SampleStrategy
 from ..ops.split import SplitConfig
@@ -36,10 +36,14 @@ def _split_config(cfg: Config, train: Optional[TrainData] = None) -> SplitConfig
     if train is not None:
         binned = train.binned
         mono = train.monotone_constraints
+        is_cat = np.asarray(binned.is_categorical)
+        nbpf = np.asarray(binned.num_bins_per_feature)
         facts = dict(
             has_nan=bool(np.any(np.asarray(binned.nan_bins)
                                 < binned.max_num_bins)),
-            has_categorical=bool(np.any(np.asarray(binned.is_categorical))),
+            has_categorical=bool(np.any(is_cat)),
+            use_sorted_categorical=bool(
+                np.any(is_cat & (nbpf > cfg.max_cat_to_onehot))),
             has_monotone=mono is not None and bool(np.any(mono != 0)),
         )
     return SplitConfig(
@@ -53,7 +57,9 @@ def _split_config(cfg: Config, train: Optional[TrainData] = None) -> SplitConfig
         cat_smooth=cfg.cat_smooth,
         max_cat_threshold=cfg.max_cat_threshold,
         max_cat_to_onehot=cfg.max_cat_to_onehot,
+        min_data_per_group=cfg.min_data_per_group,
         path_smooth=cfg.path_smooth,
+        extra_trees=cfg.extra_trees,
         use_cegb=bool(cfg.cegb_penalty_split > 0.0
                       or cfg.cegb_penalty_feature_coupled
                       or cfg.cegb_penalty_feature_lazy
@@ -137,6 +143,7 @@ class GBDT:
             histogram_impl=hist_impl,
             rows_block=cfg.tpu_rows_block,
             gather_rows=self.mesh is None,
+            feature_fraction_bynode=cfg.feature_fraction_bynode,
             quantized=cfg.use_quantized_grad,
             num_grad_quant_bins=cfg.num_grad_quant_bins,
             stochastic_rounding=cfg.stochastic_rounding,
@@ -144,6 +151,12 @@ class GBDT:
         )
         self._quant_key = (jax.random.PRNGKey(cfg.seed)
                            if cfg.use_quantized_grad else None)
+        # PRNG for per-node randomness (extra_trees thresholds / bynode
+        # feature sampling; reference extra_seed / feature_fraction_seed).
+        self._split_key = None
+        if cfg.extra_trees or cfg.feature_fraction_bynode < 1.0:
+            self._split_key = jax.random.PRNGKey(
+                cfg.extra_seed * 92821 + cfg.feature_fraction_seed)
         self.grow = make_grower(self.grower_cfg)
         self.bins_dev = train.bins_device()
         self.meta_dev = train.feature_meta_device()
@@ -209,12 +222,13 @@ class GBDT:
         shape_k = self._shape_k
 
         def grow_apply(scores_k, grad_k, hess_k, mask, fmask, shrink,
-                       cegb_coupled=None, cegb_lazy=None, quant_key=None):
+                       cegb_coupled=None, cegb_lazy=None, quant_key=None,
+                       split_key=None):
             arrays, row_leaf = grow(
                 self.bins_dev, grad_k, hess_k, mask, fmask,
                 meta["num_bins_per_feature"], meta["nan_bins"],
                 meta["is_categorical"], meta["monotone"],
-                cegb_coupled, cegb_lazy, quant_key)
+                cegb_coupled, cegb_lazy, quant_key, split_key)
             grew = arrays.num_leaves > 1
             lv = jnp.where(grew, arrays.leaf_value * shrink, 0.0)
             arrays = arrays._replace(
@@ -226,7 +240,8 @@ class GBDT:
         self._fused_iter = None
         if (obj is not None and not obj.need_renew_tree_output
                 and not obj.stochastic_gradients):
-            def fused(scores, mask, fmask, shrink, quant_key=None):
+            def fused(scores, mask, fmask, shrink, quant_key=None,
+                      split_key=None):
                 grad, hess = obj.get_gradients(scores)
                 outs = []
                 if shape_k:
@@ -234,15 +249,18 @@ class GBDT:
                     for k in range(num_class):
                         qk = (None if quant_key is None
                               else jax.random.fold_in(quant_key, k))
+                        sk = (None if split_key is None
+                              else jax.random.fold_in(split_key, k))
                         ns_k, arrays, row_leaf = grow_apply(
                             new_scores[:, k], grad[:, k], hess[:, k],
-                            mask, fmask, shrink, quant_key=qk)
+                            mask, fmask, shrink, quant_key=qk, split_key=sk)
                         new_scores = new_scores.at[:, k].set(ns_k)
                         outs.append((arrays, row_leaf))
                     return new_scores, outs
                 ns, arrays, row_leaf = grow_apply(scores, grad, hess,
                                                   mask, fmask, shrink,
-                                                  quant_key=quant_key)
+                                                  quant_key=quant_key,
+                                                  split_key=split_key)
                 return ns, [(arrays, row_leaf)]
             self._fused_iter = jax.jit(fused)
 
@@ -259,15 +277,8 @@ class GBDT:
         return jnp.asarray(base)
 
     def _create_metrics(self) -> List[Metric]:
-        names = self.cfg.metric
-        if not names:
-            names = [default_metric_for_objective(self.cfg.objective)]
-        out: List[Metric] = []
-        for nm in names:
-            if nm in ("", "none", "null", "na", "custom"):
-                continue
-            out.extend(create_metric(nm, self.cfg))
-        return out
+        from ..metrics import metrics_for_config
+        return metrics_for_config(self.cfg)
 
     # ----------------------------------------------------------------- training
     def _iter_masks(self, grad=None, hess=None):
@@ -324,6 +335,8 @@ class GBDT:
         shrink = cfg.learning_rate if cfg.boosting != "rf" else 1.0
         qkey = (jax.random.fold_in(self._quant_key, self.iter_)
                 if self._quant_key is not None else None)
+        skey = (jax.random.fold_in(self._split_key, self.iter_)
+                if self._split_key is not None else None)
 
         results = []
         if (grad is None and self._fused_iter is not None
@@ -332,7 +345,7 @@ class GBDT:
             # Hot path: ONE device dispatch for gradients + all class trees +
             # score updates.
             self.scores, outs = self._fused_iter(self.scores, mask_dev,
-                                                 fmask, shrink, qkey)
+                                                 fmask, shrink, qkey, skey)
             results = [(k, a, rl) for k, (a, rl) in enumerate(outs)]
         else:
             if goss_grads is not None:
@@ -347,9 +360,10 @@ class GBDT:
                 hk = h_dev[:, k] if self._shape_k else h_dev
                 sk = self.scores[:, k] if self._shape_k else self.scores
                 qk = None if qkey is None else jax.random.fold_in(qkey, k)
+                nk = None if skey is None else jax.random.fold_in(skey, k)
                 if cfg.linear_tree:
                     arrays, row_leaf = self._raw_grow(gk, hk, mask_dev, fmask,
-                                                      qk)
+                                                      qk, nk)
                     new_sk = self._fit_and_store_linear(
                         k, arrays, row_leaf, gk, hk, mask_dev, sk, shrink)
                     if self._shape_k:
@@ -360,7 +374,7 @@ class GBDT:
                 if (self.objective is not None
                         and self.objective.need_renew_tree_output):
                     arrays, row_leaf = self._raw_grow(gk, hk, mask_dev, fmask,
-                                                      qk)
+                                                      qk, nk)
                     arrays = self._renew_and_shrink(arrays, row_leaf, sk,
                                                     shrink)
                     new_sk = _add_leaf_outputs(sk, row_leaf,
@@ -370,11 +384,11 @@ class GBDT:
                         self._cegb_coupled_raw * (~self._cegb_used))
                     new_sk, arrays, row_leaf = self._grow_apply(
                         sk, gk, hk, mask_dev, fmask, shrink,
-                        coupled, self._cegb_lazy_dev, qk)
+                        coupled, self._cegb_lazy_dev, qk, nk)
                 else:
                     new_sk, arrays, row_leaf = self._grow_apply(
                         sk, gk, hk, mask_dev, fmask, shrink,
-                        quant_key=qk)
+                        quant_key=qk, split_key=nk)
                 if self._shape_k:
                     self.scores = self.scores.at[:, k].set(new_sk)
                 else:
@@ -394,12 +408,13 @@ class GBDT:
         self._linear_nls = []
         return all(int(x) <= 1 for x in nls)
 
-    def _raw_grow(self, gk, hk, mask_dev, fmask, quant_key=None):
+    def _raw_grow(self, gk, hk, mask_dev, fmask, quant_key=None,
+                  split_key=None):
         return self.grow(
             self.bins_dev, gk, hk, mask_dev, fmask,
             self.meta_dev["num_bins_per_feature"], self.meta_dev["nan_bins"],
             self.meta_dev["is_categorical"], self.meta_dev["monotone"],
-            None, None, quant_key)
+            None, None, quant_key, split_key)
 
     def _renew_and_shrink(self, arrays: TreeArrays, row_leaf, scores_k,
                           shrink: float) -> TreeArrays:

@@ -34,7 +34,8 @@ import jax
 import jax.numpy as jnp
 
 from ..ops.histogram import histogram_from_vals
-from ..ops.split import BestSplit, SplitConfig, best_split, leaf_output
+from ..ops.split import (BestSplit, SplitConfig, best_split, leaf_output,
+                         smoothed_output)
 
 _NEG_INF = -jnp.inf
 _MIN_BUCKET = 2048
@@ -48,6 +49,10 @@ class GrowerConfig:
     split: SplitConfig = dataclasses.field(default_factory=SplitConfig)
     histogram_impl: str = "auto"
     rows_block: int = 16384
+    # Per-node feature subsampling (reference ColSampler
+    # feature_fraction_bynode); per-tree fraction is handled by the caller's
+    # feature_mask.
+    feature_fraction_bynode: float = 1.0
     # Permutation layout on/off (see module docstring).  Disabled under a
     # device mesh: dynamic_slice over globally-grouped rows would destroy the
     # row-sharding locality the distributed path relies on.
@@ -109,8 +114,10 @@ class _GrowState(NamedTuple):
     best_gl: jnp.ndarray         # (L,) split child stats
     best_hl: jnp.ndarray
     best_cl: jnp.ndarray
+    leaf_out: jnp.ndarray        # (L,) f32 leaf output (path-smoothed chain)
     feat_used: jnp.ndarray       # (F,) bool — features split on so far (CEGB)
     leaf_path: jnp.ndarray       # (L, F) bool — features on each leaf's path
+    rng: jnp.ndarray             # (2,) u32 PRNG key (extra_trees / bynode)
     tree: TreeArrays
 
 
@@ -147,34 +154,86 @@ def make_grower(cfg: GrowerConfig):
 
     L, B = cfg.num_leaves, cfg.num_bins
     M = max(L - 1, 1)
+    use_rand = cfg.split.extra_trees
+    use_bynode = cfg.feature_fraction_bynode < 1.0
+    need_key = use_rand or use_bynode
 
-    def _best_for(hist, pg, ph, pc, meta, feature_mask, penalty=None):
+    def _node_inputs(key, feature_mask, nbpf):
+        """Per-node (fmask, rand_bins): extra_trees draws ONE random
+        threshold per feature; feature_fraction_bynode re-samples the
+        feature set per node (reference ColSampler ResetByNode)."""
+        rand_bins = None
+        fmask = feature_mask
+        if use_rand:
+            key, k1 = jax.random.split(key)
+            draw = jax.random.randint(k1, nbpf.shape, 0, 1 << 30)
+            rand_bins = draw % jnp.maximum(nbpf, 1)
+        if use_bynode:
+            key, k2 = jax.random.split(key)
+            sel = jax.random.uniform(k2, fmask.shape) \
+                < cfg.feature_fraction_bynode
+            # keep at least one usable feature (reference ColSampler)
+            fmask = jnp.where(jnp.any(sel & fmask), fmask & sel, fmask)
+        return fmask, rand_bins
+
+    def _best_for(hist, pg, ph, pc, meta, feature_mask, penalty=None,
+                  parent_out=None, key=None):
         nbpf, nan_bins, is_cat, monotone = meta
+        rand_bins = None
+        if need_key and key is not None:
+            feature_mask, rand_bins = _node_inputs(key, feature_mask, nbpf)
         return best_split(
             hist, pg, ph, pc,
             num_bins_per_feature=nbpf, nan_bins=nan_bins, is_categorical=is_cat,
             monotone=monotone, feature_mask=feature_mask, cfg=cfg.split,
-            gain_penalty=penalty,
+            gain_penalty=penalty, parent_output=parent_out,
+            rand_bins=rand_bins,
         )
 
-    def _best_for_pair(hist2, pg2, ph2, pc2, meta, feature_mask, penalty2=None):
+    def _best_for_pair(hist2, pg2, ph2, pc2, meta, feature_mask, penalty2=None,
+                       parent_out2=None, key=None):
         """Both children's split searches in one vmapped program — halves the
         kernel count of the per-split scalar scans."""
         nbpf, nan_bins, is_cat, monotone = meta
+        if parent_out2 is None:
+            parent_out2 = jnp.zeros(2, jnp.float32)
+        fmask2 = jnp.stack([feature_mask, feature_mask])
+        rand2 = None
+        if need_key and key is not None:
+            ka, kb = jax.random.split(key)
+            fm_a, rb_a = _node_inputs(ka, feature_mask, nbpf)
+            fm_b, rb_b = _node_inputs(kb, feature_mask, nbpf)
+            fmask2 = jnp.stack([fm_a, fm_b])
+            if rb_a is not None:
+                rand2 = jnp.stack([rb_a, rb_b])
 
-        def one(hist, pg, ph, pc, penalty):
+        def one(hist, pg, ph, pc, penalty, pout, fmask, rand_bins):
             return best_split(
                 hist, pg, ph, pc,
                 num_bins_per_feature=nbpf, nan_bins=nan_bins,
                 is_categorical=is_cat, monotone=monotone,
-                feature_mask=feature_mask, cfg=cfg.split,
-                gain_penalty=penalty,
+                feature_mask=fmask, cfg=cfg.split,
+                gain_penalty=penalty, parent_output=pout,
+                rand_bins=rand_bins,
             )
 
+        if penalty2 is None and rand2 is None:
+            return jax.vmap(
+                lambda h, g, hh, c, po, fm: one(h, g, hh, c, None, po, fm,
+                                                None))(
+                hist2, pg2, ph2, pc2, parent_out2, fmask2)
         if penalty2 is None:
-            return jax.vmap(lambda h, g, hh, c: one(h, g, hh, c, None))(
-                hist2, pg2, ph2, pc2)
-        return jax.vmap(one)(hist2, pg2, ph2, pc2, penalty2)
+            return jax.vmap(
+                lambda h, g, hh, c, po, fm, rb: one(h, g, hh, c, None, po,
+                                                    fm, rb))(
+                hist2, pg2, ph2, pc2, parent_out2, fmask2, rand2)
+        if rand2 is None:
+            return jax.vmap(
+                lambda h, g, hh, c, pe, po, fm: one(h, g, hh, c, pe, po, fm,
+                                                    None))(
+                hist2, pg2, ph2, pc2, penalty2, parent_out2, fmask2)
+        return jax.vmap(one)(hist2, pg2, ph2, pc2, penalty2, parent_out2,
+                             fmask2, rand2)
 
     def _cegb_penalty(count, feat_used, path_used, coupled, lazy):
         """Per-feature gain penalty (reference CEGB ``DeltaGain``):
@@ -190,7 +249,7 @@ def make_grower(cfg: GrowerConfig):
         pen = pen + t * lazy * count * (~path_used)
         return pen
 
-    def _init_state(n, f, root_hist, root_g, root_h, root_c):
+    def _init_state(n, f, root_hist, root_g, root_h, root_c, key=None):
         tree = TreeArrays(
             split_feature=jnp.zeros(M, jnp.int32),
             split_bin=jnp.zeros(M, jnp.int32),
@@ -228,8 +287,12 @@ def make_grower(cfg: GrowerConfig):
             best_gl=jnp.zeros(L, jnp.float32),
             best_hl=jnp.zeros(L, jnp.float32),
             best_cl=jnp.zeros(L, jnp.float32),
+            leaf_out=jnp.zeros(L, jnp.float32).at[0].set(
+                leaf_output(root_g, root_h, cfg.split)),
             feat_used=jnp.zeros(f, bool),
             leaf_path=jnp.zeros((L, f), bool),
+            rng=(key if key is not None
+                 else jnp.zeros(2, jnp.uint32)),
             tree=tree,
         )
 
@@ -253,15 +316,16 @@ def make_grower(cfg: GrowerConfig):
             left_child=left_child.at[node].set(~leaf),
             right_child=right_child.at[node].set(~new_leaf),
             split_gain=tr.split_gain.at[node].set(st.best_gain[leaf]),
-            internal_value=tr.internal_value.at[node].set(
-                leaf_output(pg, ph, cfg.split)),
+            internal_value=tr.internal_value.at[node].set(st.leaf_out[leaf]),
             internal_count=tr.internal_count.at[node].set(pc),
         )
 
     def _finish(state: _GrowState) -> TreeArrays:
         leaf_ids = jnp.arange(L)
         active = leaf_ids < state.num_leaves
-        values = leaf_output(state.leaf_sum_grad, state.leaf_sum_hess, cfg.split)
+        # leaf_out carries the (possibly path-smoothed) output chain; without
+        # smoothing it equals leaf_output(sum_grad, sum_hess) exactly.
+        values = state.leaf_out
         return state.tree._replace(
             leaf_value=jnp.where(active, values, 0.0),
             leaf_count=jnp.where(active, state.leaf_count, 0.0),
@@ -277,6 +341,13 @@ def make_grower(cfg: GrowerConfig):
         depth = st.leaf_depth[leaf] + 1
         node = st.num_leaves - 1
         pair = jnp.stack([leaf, new_leaf])
+        parent_out = st.leaf_out[leaf]
+        out_l = smoothed_output(gl, hl, cl, parent_out, cfg.split)
+        out_r = smoothed_output(gr, hr, cr, parent_out, cfg.split)
+        node_key = None
+        if need_key:
+            rng, node_key = jax.random.split(st.rng)
+            st = st._replace(rng=rng)
         penalty2 = None
         if cfg.split.use_cegb and cegb is not None:
             coupled, lazy = cegb
@@ -307,10 +378,12 @@ def make_grower(cfg: GrowerConfig):
             leaf_parent=st.leaf_parent.at[pair].set(jnp.stack([node, node])),
             leaf_is_left=st.leaf_is_left.at[pair].set(
                 jnp.asarray([True, False])),
+            leaf_out=st.leaf_out.at[pair].set(jnp.stack([out_l, out_r])),
         )
         depth_ok = jnp.asarray(True) if cfg.max_depth <= 0 \
             else depth < cfg.max_depth
-        bs2 = _best_for_pair(hist2, g2, h2, c2, meta, feature_mask, penalty2)
+        bs2 = _best_for_pair(hist2, g2, h2, c2, meta, feature_mask, penalty2,
+                             jnp.stack([out_l, out_r]), node_key)
         gain2 = jnp.where(depth_ok, bs2.gain, _NEG_INF)
         return st._replace(
             best_gain=st.best_gain.at[pair].set(gain2),
@@ -332,8 +405,20 @@ def make_grower(cfg: GrowerConfig):
             return hist
         return hist.astype(jnp.float32) * scale3
 
+    def _root_best(state, meta, feature_mask, root_pen):
+        """Root split search (shared by both layouts)."""
+        key = None
+        if need_key:
+            rng, key = jax.random.split(state.rng)
+            state = state._replace(rng=rng)
+        bs = _best_for(state.leaf_hist[0], state.leaf_sum_grad[0],
+                       state.leaf_sum_hess[0], state.leaf_count[0], meta,
+                       feature_mask, root_pen, state.leaf_out[0], key)
+        return state, bs
+
     # ------------------------------------------------------------------ perm path
-    def _grow_perm(bins, vals, scale3, feature_mask, meta, cegb=None):
+    def _grow_perm(bins, vals, scale3, feature_mask, meta, cegb=None,
+                   key=None):
         """Permutation-layout growth (single device)."""
         n, f = bins.shape
         nan_bins = meta[1]
@@ -351,14 +436,13 @@ def make_grower(cfg: GrowerConfig):
         root_tot = jnp.sum(root_hist[0], axis=0)
         root_g, root_h, root_c = root_tot[0], root_tot[1], root_tot[2]
 
-        state = _init_state(n, f, root_hist, root_g, root_h, root_c)
+        state = _init_state(n, f, root_hist, root_g, root_h, root_c, key)
         state = state._replace(perm=perm0)
         root_pen = None
         if cfg.split.use_cegb and cegb is not None:
             root_pen = _cegb_penalty(root_c, state.feat_used,
                                      state.leaf_path[0], *cegb)
-        root_bs = _best_for(root_hist, root_g, root_h, root_c, meta,
-                            feature_mask, root_pen)
+        state, root_bs = _root_best(state, meta, feature_mask, root_pen)
         state = _store_best(state, jnp.asarray(0), root_bs, jnp.asarray(True))
 
         def _make_part_branch(S):
@@ -467,7 +551,8 @@ def make_grower(cfg: GrowerConfig):
         return _finish(state), row_leaf
 
     # ------------------------------------------------------------------ mask path
-    def _grow_mask(bins, vals, scale3, feature_mask, meta, cegb=None):
+    def _grow_mask(bins, vals, scale3, feature_mask, meta, cegb=None,
+                   key=None):
         """Mask-layout growth (sharding-friendly; full-N pass per split)."""
         n, f = bins.shape
 
@@ -485,14 +570,13 @@ def make_grower(cfg: GrowerConfig):
             rows_block=cfg.rows_block), scale3)
         root_tot = jnp.sum(root_hist[0], axis=0)
         root_g, root_h, root_c = root_tot[0], root_tot[1], root_tot[2]
-        state = _init_state(n, f, root_hist, root_g, root_h, root_c)
+        state = _init_state(n, f, root_hist, root_g, root_h, root_c, key)
         row_leaf0 = jnp.zeros(n, jnp.int32)
         root_pen = None
         if cfg.split.use_cegb and cegb is not None:
             root_pen = _cegb_penalty(root_c, state.feat_used,
                                      state.leaf_path[0], *cegb)
-        root_bs = _best_for(root_hist, root_g, root_h, root_c, meta,
-                            feature_mask, root_pen)
+        state, root_bs = _root_best(state, meta, feature_mask, root_pen)
         state = _store_best(state, jnp.asarray(0), root_bs, jnp.asarray(True))
 
         def body(carry):
@@ -559,6 +643,8 @@ def make_grower(cfg: GrowerConfig):
         cegb_coupled: Optional[jnp.ndarray] = None,  # (F,) f32 (CEGB)
         cegb_lazy: Optional[jnp.ndarray] = None,     # (F,) f32 (CEGB)
         quant_key: Optional[jnp.ndarray] = None,     # PRNG key (quantized)
+        split_key: Optional[jnp.ndarray] = None,     # PRNG key
+                                                     # (extra_trees / bynode)
     ) -> Tuple[TreeArrays, jnp.ndarray]:
         meta = (num_bins_per_feature, nan_bins, is_categorical, monotone)
         cegb = None
@@ -588,12 +674,14 @@ def make_grower(cfg: GrowerConfig):
         else:
             vals = jnp.stack([g, h, in_bag.astype(jnp.float32)], axis=-1)
             scale3 = None
+        if need_key and split_key is None:
+            split_key = jax.random.PRNGKey(0)
         if cfg.gather_rows and bins.shape[0] > _MIN_BUCKET:
             tree, row_leaf = _grow_perm(bins, vals, scale3, feature_mask,
-                                        meta, cegb)
+                                        meta, cegb, split_key)
         else:
             tree, row_leaf = _grow_mask(bins, vals, scale3, feature_mask,
-                                        meta, cegb)
+                                        meta, cegb, split_key)
         if cfg.quantized and cfg.quant_renew_leaf:
             # quant_train_renew_leaf: recompute leaf outputs from the TRUE
             # (unquantized) gradients (reference RenewIntGradTreeOutput).

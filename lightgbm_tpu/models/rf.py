@@ -23,6 +23,11 @@ class RandomForest(GBDT):
                 "rf boosting requires bagging (bagging_freq>0 and "
                 "bagging_fraction<1) or feature_fraction<1  "
                 "(reference rf.hpp constructor check)")
+        if base_model is not None:
+            raise ValueError(
+                "training continuation (init_model) is not supported with "
+                "boosting=rf: averaged outputs cannot replay a base model "
+                "through init scores")
         super().__init__(cfg, train, valids, base_model=base_model)
         # Scores are frozen at the init score; trees are averaged at predict.
         self._init_train_scores = self.scores

@@ -18,8 +18,9 @@ no data-dependent control flow, one reduction.
 from __future__ import annotations
 
 import dataclasses
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
+import jax
 import jax.numpy as jnp
 
 _EPS = 1e-15
@@ -41,10 +42,16 @@ class SplitConfig:
     max_cat_to_onehot: int = 4
     min_data_per_group: int = 100
     path_smooth: float = 0.0
+    # Extremely-randomized trees (reference col_sampler + USE_RAND scans):
+    # when set, each (node, feature) evaluates ONE random threshold.
+    extra_trees: bool = False
     # Static dataset facts (set from the bin mappers) that let the compiled
     # scan skip whole candidate families.  True = "may be present" (safe).
     has_nan: bool = True
     has_categorical: bool = True
+    # Any categorical feature with num_bins > max_cat_to_onehot (enables the
+    # sorted many-vs-many scan; one-hot-only datasets skip it entirely).
+    use_sorted_categorical: bool = True
     has_monotone: bool = True
     # Cost-effective gradient boosting (reference
     # ``cost_effective_gradient_boosting.hpp:79`` DeltaGain).
@@ -91,6 +98,121 @@ def leaf_gain(g, h, cfg: SplitConfig, l2_extra: float = 0.0):
     return (t * t) / (h + cfg.lambda_l2 + l2_extra + _EPS)
 
 
+def smoothed_output(g, h, count, parent_output, cfg: SplitConfig,
+                    l2_extra: float = 0.0):
+    """``CalculateSplittedLeafOutput`` with path smoothing (reference
+    ``feature_histogram.hpp``): ``w*(n/s)/(n/s+1) + parent/(n/s+1)``."""
+    w = leaf_output(g, h, cfg, l2_extra)
+    if cfg.path_smooth <= 0.0:
+        return w
+    ratio = count / cfg.path_smooth
+    return w * ratio / (ratio + 1.0) + parent_output / (ratio + 1.0)
+
+
+def gain_given_output(g, h, out, cfg: SplitConfig, l2_extra: float = 0.0):
+    """``GetLeafGainGivenOutput``: ``-(2*TL1(g)*w + (h+l2)*w^2)``."""
+    t = threshold_l1(g, cfg.lambda_l1)
+    return -(2.0 * t * out + (h + cfg.lambda_l2 + l2_extra) * out * out)
+
+
+def child_gain(g, h, count, parent_output, cfg: SplitConfig,
+               l2_extra: float = 0.0):
+    """Per-child gain; closed form without smoothing, output-based with
+    (reference GetSplitGains USE_SMOOTHING dispatch)."""
+    if cfg.path_smooth <= 0.0:
+        return leaf_gain(g, h, cfg, l2_extra)
+    w = smoothed_output(g, h, count, parent_output, cfg, l2_extra)
+    return gain_given_output(g, h, w, cfg, l2_extra)
+
+
+def _sorted_categorical(G, H, C, parent_grad, parent_hess, parent_count,
+                        parent_output, in_feature, cfg: SplitConfig,
+                        min_count: float, rand_bins=None):
+    """Sorted many-vs-many categorical scan (reference
+    ``FindBestThresholdCategoricalInner`` sorted branch,
+    ``feature_histogram.cpp:241-340``): bins with enough data are sorted by
+    ``grad/(hess+cat_smooth)``; prefixes of length <= ``max_cat_threshold``
+    are scanned from both ends with ``min_data_per_group`` grouping; child
+    gains use ``l2 + cat_l2``.
+
+    Returns per-feature ``(gain, cat_mask, gl, hl, cl)``; gain is the child
+    sum (the caller subtracts the parent gain shift).
+    """
+    f, b = G.shape
+    K = min(b, max(int(cfg.max_cat_threshold), 1))
+    mdpg = float(cfg.min_data_per_group)
+    valid = in_feature & (C >= cfg.cat_smooth)
+    ctr = G / (H + cfg.cat_smooth)
+    key = jnp.where(valid, ctr, jnp.inf)
+    order = jnp.argsort(key, axis=1, stable=True)              # (F, B)
+    rank = jnp.argsort(order, axis=1)                          # inverse perm
+    used = jnp.sum(valid, axis=1).astype(jnp.int32)            # (F,)
+    vs = jnp.take_along_axis(valid, order, axis=1)
+    Gs = jnp.where(vs, jnp.take_along_axis(G, order, axis=1), 0.0)
+    Hs = jnp.where(vs, jnp.take_along_axis(H, order, axis=1), 0.0)
+    Cs = jnp.where(vs, jnp.take_along_axis(C, order, axis=1), 0.0)
+    max_num_cat = jnp.minimum(cfg.max_cat_threshold, (used + 1) // 2)
+    iidx = jnp.arange(K, dtype=jnp.int32)[None, :]             # (1, K)
+    rand_pos = None
+    if rand_bins is not None:
+        max_thr = jnp.maximum(jnp.minimum(max_num_cat, used) - 1, 0) + 1
+        rand_pos = (rand_bins % max_thr)[:, None]
+
+    def direction(Gd, Hd, Cd):
+        cg = jnp.cumsum(Gd, axis=1)
+        ch = jnp.cumsum(Hd, axis=1) + _EPS
+        cc = jnp.cumsum(Cd, axis=1)
+        pos_ok = (iidx < used[:, None]) & (iidx < max_num_cat[:, None])
+        left_ok = (cc >= min_count) & (ch >= cfg.min_sum_hessian_in_leaf)
+        rc = parent_count - cc
+        rh = parent_hess - ch
+        right_ok = ((rc >= min_count) & (rc >= mdpg)
+                    & (rh >= cfg.min_sum_hessian_in_leaf))
+        ok = pos_ok & left_ok & right_ok
+
+        def step(carry, x):
+            cnt_i, ok_i = x
+            acc = carry + cnt_i
+            cand = ok_i & (acc >= mdpg)
+            return jnp.where(cand, 0.0, acc), cand
+
+        _, emit = jax.lax.scan(step, jnp.zeros(f, cg.dtype),
+                               (Cd.T, ok.T))
+        emit = emit.T                                          # (F, K)
+        if rand_pos is not None:
+            emit = emit & (iidx == rand_pos)
+        gl, hl, cl = cg, ch, cc
+        gr, hr, cr = (parent_grad - gl, parent_hess - hl, parent_count - cl)
+        gain = (child_gain(gl, hl, cl, parent_output, cfg, cfg.cat_l2)
+                + child_gain(gr, hr, cr, parent_output, cfg, cfg.cat_l2))
+        return jnp.where(emit, gain, -jnp.inf), gl, hl, cl
+
+    gain_f, glf, hlf, clf = direction(Gs[:, :K], Hs[:, :K], Cs[:, :K])
+    # Backward direction starts at the last USED position per feature.
+    bidx = jnp.clip(used[:, None] - 1 - iidx, 0, b - 1)        # (F, K)
+    in_back = iidx < used[:, None]
+    Gb = jnp.where(in_back, jnp.take_along_axis(Gs, bidx, axis=1), 0.0)
+    Hb = jnp.where(in_back, jnp.take_along_axis(Hs, bidx, axis=1), 0.0)
+    Cb = jnp.where(in_back, jnp.take_along_axis(Cs, bidx, axis=1), 0.0)
+    gain_b, glb, hlb, clb = direction(Gb, Hb, Cb)
+
+    gain2 = jnp.stack([gain_f, gain_b], axis=1)                # (F, 2, K)
+    flat = jnp.argmax(gain2.reshape(f, 2 * K), axis=1)
+    best_dir = (flat // K).astype(jnp.int32)                   # 0 fwd, 1 bwd
+    best_i = (flat % K).astype(jnp.int32)
+    take = lambda a2: jnp.take_along_axis(
+        a2.reshape(f, 2 * K), flat[:, None], axis=1)[:, 0]
+    gain = take(gain2)
+    gl = take(jnp.stack([glf, glb], axis=1))
+    hl = take(jnp.stack([hlf, hlb], axis=1))
+    cl = take(jnp.stack([clf, clb], axis=1))
+    # cat_mask: the chosen prefix of the sorted order routes LEFT.
+    fwd_mask = rank <= best_i[:, None]
+    bwd_mask = rank >= (used - 1 - best_i)[:, None]
+    cat_mask = valid & jnp.where((best_dir == 0)[:, None], fwd_mask, bwd_mask)
+    return gain, cat_mask, gl, hl, cl
+
+
 def best_split(
     hist: jnp.ndarray,            # (F, B, 3) leaf histogram
     parent_grad: jnp.ndarray,     # scalar ΣG over the leaf (includes NaN bin)
@@ -105,6 +227,10 @@ def best_split(
     cfg: SplitConfig,
     gain_penalty: jnp.ndarray | None = None,  # (F,) subtracted from every gain
                                               # (CEGB DeltaGain)
+    parent_output: jnp.ndarray | None = None,  # scalar leaf output
+                                               # (path_smooth anchor)
+    rand_bins: jnp.ndarray | None = None,      # (F,) i32 random threshold per
+                                               # feature (extra_trees)
 ) -> BestSplit:
     """Evaluate every (feature, threshold, missing-direction) candidate and argmax."""
     f, b, _ = hist.shape
@@ -113,6 +239,8 @@ def best_split(
     in_feature = biota < num_bins_per_feature[:, None]
     nan_pos = biota == nan_bins[:, None]
     value_mask = in_feature & ~nan_pos
+    if parent_output is None:
+        parent_output = leaf_output(parent_grad, parent_hess, cfg)
 
     Gv = jnp.where(value_mask, G, 0.0)
     Hv = jnp.where(value_mask, H, 0.0)
@@ -125,10 +253,16 @@ def best_split(
     cumH = jnp.cumsum(Hv, axis=1)
     cumC = jnp.cumsum(Cv, axis=1)
 
-    parent_gain = leaf_gain(parent_grad, parent_hess, cfg)
+    # Parent gain shift: closed form without smoothing, output-based with
+    # (reference BeforeNumerical / FindBestThresholdCategoricalInner).
+    if cfg.path_smooth > 0.0:
+        parent_gain = gain_given_output(parent_grad, parent_hess,
+                                        parent_output, cfg)
+    else:
+        parent_gain = leaf_gain(parent_grad, parent_hess, cfg)
     min_count = float(max(cfg.min_data_in_leaf, 1))
 
-    def eval_dir(GL, HL, CL):
+    def eval_dir(GL, HL, CL, l2_extra=0.0):
         GR = parent_grad - GL
         HR = parent_hess - HL
         CR = parent_count - CL
@@ -138,7 +272,9 @@ def best_split(
             & (HL >= cfg.min_sum_hessian_in_leaf)
             & (HR >= cfg.min_sum_hessian_in_leaf)
         )
-        gain = leaf_gain(GL, HL, cfg) + leaf_gain(GR, HR, cfg) - parent_gain
+        gain = (child_gain(GL, HL, CL, parent_output, cfg, l2_extra)
+                + child_gain(GR, HR, CR, parent_output, cfg, l2_extra)
+                - parent_gain)
         gain = jnp.where(valid & (gain > cfg.min_gain_to_split + _EPS), gain, -jnp.inf)
         return gain, (GL, HL, CL, GR, HR, CR)
 
@@ -158,32 +294,29 @@ def best_split(
         num_default_left = jnp.zeros_like(gain_mr, bool)
     num_gain = jnp.where(value_mask, num_gain, -jnp.inf)
 
-    # Categorical one-hot: "bin == k goes left" (reference one-hot branch of
-    # FindBestThreshold; uses cat_l2 in place of plain l2).
-    def eval_cat(GL, HL, CL):
-        GR = parent_grad - GL
-        HR = parent_hess - HL
-        CR = parent_count - CL
-        valid = (
-            (CL >= min_count) & (CR >= min_count)
-            & (HL >= cfg.min_sum_hessian_in_leaf)
-            & (HR >= cfg.min_sum_hessian_in_leaf)
-        )
-        pg = leaf_gain(parent_grad, parent_hess, cfg, l2_extra=cfg.cat_l2)
-        gain = (leaf_gain(GL, HL, cfg, l2_extra=cfg.cat_l2)
-                + leaf_gain(GR, HR, cfg, l2_extra=cfg.cat_l2) - pg)
-        gain = jnp.where(valid & (gain > cfg.min_gain_to_split + _EPS), gain, -jnp.inf)
-        return gain, (GL, HL, CL, GR, HR, CR)
-
     if cfg.has_categorical:
-        cat_gain, cat_stats = eval_cat(G, H, C)
+        # One-hot categorical: "bin == k goes left" (reference one-hot branch
+        # of FindBestThresholdCategoricalInner — plain lambda_l2, not cat_l2,
+        # which only applies in the sorted branch).
+        cat_gain, cat_stats = eval_dir(G, H, C)
         cat_gain = jnp.where(in_feature, cat_gain, -jnp.inf)
+        # Sorted features are excluded from the one-hot table; they compete
+        # through the per-feature sorted scan below.
+        sorted_eligible = (is_categorical
+                           & (num_bins_per_feature > cfg.max_cat_to_onehot))
         is_cat_col = is_categorical[:, None]
         gain_fb = jnp.where(is_cat_col, cat_gain, num_gain)
+        gain_fb = jnp.where(sorted_eligible[:, None], -jnp.inf, gain_fb)
     else:
         cat_stats = stats_mr
+        sorted_eligible = None
         is_cat_col = jnp.zeros_like(is_categorical, bool)[:, None]
         gain_fb = num_gain
+
+    if rand_bins is not None and cfg.extra_trees:
+        # extra_trees (reference USE_RAND scans): one random threshold per
+        # (node, feature); all other candidates are masked out.
+        gain_fb = jnp.where(biota == rand_bins[:, None], gain_fb, -jnp.inf)
 
     if monotone is not None and cfg.has_monotone:
         # Basic monotone mode: reject splits whose child outputs violate the
@@ -200,8 +333,10 @@ def best_split(
         viol = ((mono > 0) & (out_l > out_r)) | ((mono < 0) & (out_l < out_r))
         gain_fb = jnp.where(viol, -jnp.inf, gain_fb)
 
+    penalty_col = None
     if gain_penalty is not None and cfg.use_cegb:
-        gain_fb = gain_fb - gain_penalty[:, None]
+        penalty_col = gain_penalty[:, None]
+        gain_fb = gain_fb - penalty_col
         # Penalized gains that drop to <= 0 are no longer worth splitting
         # (reference stops on "gain <= 0").
         gain_fb = jnp.where(gain_fb > _EPS, gain_fb, -jnp.inf)
@@ -225,9 +360,51 @@ def best_split(
     GL, HL, CL, GR, HR, CR = (pick(cat_stats, stats_ml, stats_mr, i) for i in range(6))
     cat_mask = (jnp.arange(b, dtype=jnp.int32) == bb) & bis_cat
 
-    return BestSplit(
+    best = BestSplit(
         gain=bgain, feature=bf, bin=bb,
         default_left=bdefault_left, is_cat=bis_cat, cat_mask=cat_mask,
         sum_grad_left=GL, sum_hess_left=HL, count_left=CL,
         sum_grad_right=GR, sum_hess_right=HR, count_right=CR,
+    )
+
+    if cfg.has_categorical and cfg.use_sorted_categorical:
+        best = _merge_sorted_categorical(
+            best, G, H, C, parent_grad, parent_hess, parent_count,
+            parent_output, parent_gain, in_feature, sorted_eligible,
+            feature_mask, penalty_col, cfg, min_count,
+            rand_bins if cfg.extra_trees else None)
+    return best
+
+
+def _merge_sorted_categorical(best, G, H, C, parent_grad, parent_hess,
+                              parent_count, parent_output, parent_gain,
+                              in_feature, sorted_eligible, feature_mask,
+                              penalty_col, cfg, min_count, rand_bins):
+    """Run the sorted many-vs-many scan and take it when it beats ``best``."""
+    s_gain, s_mask, s_gl, s_hl, s_cl = _sorted_categorical(
+        G, H, C, parent_grad, parent_hess, parent_count, parent_output,
+        in_feature, cfg, min_count, rand_bins)
+    s_gain = s_gain - parent_gain
+    s_gain = jnp.where(s_gain > cfg.min_gain_to_split + _EPS, s_gain, -jnp.inf)
+    if penalty_col is not None:
+        s_gain = s_gain - penalty_col[:, 0]
+        s_gain = jnp.where(s_gain > _EPS, s_gain, -jnp.inf)
+    s_gain = jnp.where(sorted_eligible & feature_mask, s_gain, -jnp.inf)
+    sf = jnp.argmax(s_gain).astype(jnp.int32)
+    sg = s_gain[sf]
+    better = sg > best.gain
+    pickf = lambda a_new, a_old: jnp.where(better, a_new, a_old)
+    return BestSplit(
+        gain=pickf(sg, best.gain),
+        feature=pickf(sf, best.feature),
+        bin=pickf(jnp.asarray(0, jnp.int32), best.bin),
+        default_left=pickf(jnp.asarray(False), best.default_left),
+        is_cat=pickf(jnp.asarray(True), best.is_cat),
+        cat_mask=jnp.where(better, s_mask[sf], best.cat_mask),
+        sum_grad_left=pickf(s_gl[sf], best.sum_grad_left),
+        sum_hess_left=pickf(s_hl[sf], best.sum_hess_left),
+        count_left=pickf(s_cl[sf], best.count_left),
+        sum_grad_right=pickf(parent_grad - s_gl[sf], best.sum_grad_right),
+        sum_hess_right=pickf(parent_hess - s_hl[sf], best.sum_hess_right),
+        count_right=pickf(parent_count - s_cl[sf], best.count_right),
     )

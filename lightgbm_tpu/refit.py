@@ -14,7 +14,7 @@ a training hot loop.
 from __future__ import annotations
 
 import copy
-from typing import TYPE_CHECKING
+from typing import TYPE_CHECKING, Callable, Tuple
 
 import numpy as np
 
@@ -33,37 +33,31 @@ def _leaf_output_np(g: np.ndarray, h: np.ndarray, cfg: Config) -> np.ndarray:
     return out
 
 
-def refit_loaded(model, X: np.ndarray, label: np.ndarray,
-                 decay_rate: float, weight=None, group=None):
-    """Refit a LoadedModel (raw-threshold trees) in place-free fashion and
-    return the new LoadedModel.  Reference flow: ``Application`` task=refit —
-    predict leaf indices with the loaded model, then ``GBDT::RefitTree``."""
+def _init_objective(objective, label, weight, group, cfg):
+    if objective is None:
+        raise ValueError("refit requires a built-in objective")
+    objective.init(
+        np.asarray(label),
+        None if weight is None else np.asarray(weight, np.float32),
+        None if group is None else np.asarray(group, np.int64),
+        cfg)
+    return objective
+
+
+def _refit_pass(
+    n: int, k_cls: int, n_iters: int, init_scores: np.ndarray,
+    objective, cfg: Config, decay_rate: float,
+    route: Callable[[int, int], Tuple[np.ndarray, int, float, np.ndarray]],
+    store: Callable[[int, int, np.ndarray, np.ndarray], None],
+) -> None:
+    """Shared refit loop.  ``route(it, k) -> (leaf_idx, num_leaves,
+    shrinkage, old_leaf_values)``; ``store(it, k, new_leaf_values,
+    leaf_counts)`` writes them back.  Scores progress exactly as the
+    reference's ``Boosting(); FitByExistingTree`` sequence."""
     import jax
     import jax.numpy as jnp
 
-    cfg = Config({k: v for k, v in model.params.items()})
-    if model.cfg.num_class > 1:
-        cfg.update({"objective": model.cfg.objective,
-                    "num_class": model.cfg.num_class})
-    from .objectives import create_objective
-    objective = create_objective(cfg)
-    if objective is None:
-        raise ValueError("refit requires a built-in objective")
-    objective.init(np.asarray(label),
-                   None if weight is None else np.asarray(weight, np.float32),
-                   None if group is None else np.asarray(group, np.int64),
-                   cfg)
-
-    if any(t.is_linear for t in model.trees):
-        raise ValueError("refit of linear-tree models is not supported "
-                         "(leaf linear coefficients are not refit)")
-    X = np.asarray(X, np.float64)
-    n = X.shape[0]
-    k_cls = model.num_class
-    new_model = copy.copy(model)
-    new_model.trees = [copy.copy(t) for t in model.trees]
-    n_iters = len(model.trees) // k_cls
-    scores = np.tile(np.asarray(model.init_scores, np.float64)[None, :k_cls],
+    scores = np.tile(np.asarray(init_scores, np.float64)[None, :k_cls],
                      (n, 1)).astype(np.float32)
     for it in range(n_iters):
         sc = scores[:, 0] if k_cls == 1 else scores
@@ -71,25 +65,56 @@ def refit_loaded(model, X: np.ndarray, label: np.ndarray,
         g = np.asarray(jax.device_get(g_dev)).reshape(n, -1)
         h = np.asarray(jax.device_get(h_dev)).reshape(n, -1)
         for k in range(k_cls):
-            tree = new_model.trees[it * k_cls + k]
-            nl = tree.num_leaves
-            leaf = tree.predict_leaf(X)
+            leaf, nl, shrinkage, old = route(it, k)
             sum_g = np.bincount(leaf, weights=g[:, k], minlength=nl)
             sum_h = np.bincount(leaf, weights=h[:, k], minlength=nl) + 1e-15
-            refit_val = _leaf_output_np(sum_g, sum_h, cfg) * tree.shrinkage
-            new_leaf = (decay_rate * np.asarray(tree.leaf_value[:nl],
-                                                np.float64)
+            refit_val = _leaf_output_np(sum_g, sum_h, cfg) * shrinkage
+            new_leaf = (decay_rate * np.asarray(old[:nl], np.float64)
                         + (1.0 - decay_rate) * refit_val)
-            tree.leaf_value = np.asarray(tree.leaf_value, np.float64).copy()
-            tree.leaf_value[:nl] = new_leaf
+            store(it, k, new_leaf,
+                  np.bincount(leaf, minlength=nl).astype(np.float32))
             scores[:, k] += new_leaf[leaf].astype(np.float32)
+
+
+def refit_loaded(model, X: np.ndarray, label: np.ndarray,
+                 decay_rate: float, weight=None, group=None):
+    """Refit a LoadedModel (raw-threshold trees) in place-free fashion and
+    return the new LoadedModel.  Reference flow: ``Application`` task=refit —
+    predict leaf indices with the loaded model, then ``GBDT::RefitTree``."""
+    cfg = Config({k: v for k, v in model.params.items()})
+    if model.cfg.num_class > 1:
+        cfg.update({"objective": model.cfg.objective,
+                    "num_class": model.cfg.num_class})
+    from .objectives import create_objective
+    objective = _init_objective(create_objective(cfg), label, weight, group,
+                                cfg)
+
+    if any(t.is_linear for t in model.trees):
+        raise ValueError("refit of linear-tree models is not supported "
+                         "(leaf linear coefficients are not refit)")
+    X = np.asarray(X, np.float64)
+    k_cls = model.num_class
+    new_model = copy.copy(model)
+    new_model.trees = [copy.copy(t) for t in model.trees]
+
+    def route(it, k):
+        tree = new_model.trees[it * k_cls + k]
+        return (tree.predict_leaf(X), tree.num_leaves, tree.shrinkage,
+                np.asarray(tree.leaf_value, np.float64))
+
+    def store(it, k, new_leaf, _counts):
+        tree = new_model.trees[it * k_cls + k]
+        tree.leaf_value = np.asarray(tree.leaf_value, np.float64).copy()
+        tree.leaf_value[: len(new_leaf)] = new_leaf
+
+    _refit_pass(X.shape[0], k_cls, len(model.trees) // k_cls,
+                model.init_scores, objective, cfg, decay_rate, route, store)
     return new_model
 
 
 def refit_booster(booster: "Booster", X: np.ndarray, label: np.ndarray,
                   decay_rate: float, params: dict,
                   weight=None, group=None) -> "Booster":
-    import jax
     import jax.numpy as jnp
 
     gbdt = booster._gbdt
@@ -100,11 +125,9 @@ def refit_booster(booster: "Booster", X: np.ndarray, label: np.ndarray,
         raise ValueError("refit of linear-tree models is not supported "
                          "(leaf linear coefficients are not refit)")
     cfg = gbdt.cfg
-    td = gbdt.train_data
-    binned = td.binned
-    bins = binned.apply(X)
+    binned = gbdt.train_data.binned
+    bins = binned.apply(np.asarray(X))
     nan_bins = np.asarray(binned.nan_bins)
-    n = X.shape[0]
     k_cls = gbdt.num_class
 
     new_b = copy.copy(booster)
@@ -112,43 +135,28 @@ def refit_booster(booster: "Booster", X: np.ndarray, label: np.ndarray,
     new_b._gbdt = new_gbdt
     new_gbdt.dev_models = [list(m) for m in gbdt.dev_models]
     new_gbdt._host_cache = [list(m) for m in gbdt._host_cache]
+    objective = _init_objective(copy.copy(gbdt.objective), label, weight,
+                                group, cfg)
 
-    objective = gbdt.objective
-    if objective is None:
-        raise ValueError("refit requires a built-in objective")
-    objective = copy.copy(objective)
-    objective.init(np.asarray(label),
-                   None if weight is None else np.asarray(weight, np.float32),
-                   None if group is None else np.asarray(group, np.int64),
-                   cfg)
+    def route(it, k):
+        tree = copy.copy(gbdt.models[k][it])
+        new_gbdt._host_cache[k][it] = tree
+        return (tree.predict_leaf_bins(bins, nan_bins), tree.num_leaves,
+                tree.shrinkage, np.asarray(tree.leaf_value, np.float64))
 
-    scores = np.tile(gbdt.init_scores[None, :], (n, 1)).astype(np.float32)
+    def store(it, k, new_leaf, counts):
+        tree = new_gbdt._host_cache[k][it]
+        nl = len(new_leaf)
+        tree.leaf_value = tree.leaf_value.copy()
+        tree.leaf_value[:nl] = new_leaf
+        tree.leaf_count = counts[: len(tree.leaf_count)]
+        arrays = new_gbdt.dev_models[k][it]
+        lv = np.zeros(arrays.leaf_value.shape[0], np.float32)
+        lv[:nl] = new_leaf
+        new_gbdt.dev_models[k][it] = arrays._replace(
+            leaf_value=jnp.asarray(lv))
+
     n_iters = min(len(m) for m in gbdt.models) if gbdt.models else 0
-    sc_dev_shape = (n,) if k_cls == 1 else (n, k_cls)
-    for it in range(n_iters):
-        sc = scores[:, 0] if k_cls == 1 else scores
-        g_dev, h_dev = objective.get_gradients(jnp.asarray(
-            sc.reshape(sc_dev_shape)))
-        g = np.asarray(jax.device_get(g_dev)).reshape(n, -1)
-        h = np.asarray(jax.device_get(h_dev)).reshape(n, -1)
-        for k in range(k_cls):
-            tree = copy.copy(gbdt.models[k][it])
-            nl = tree.num_leaves
-            leaf = tree.predict_leaf_bins(bins, nan_bins)
-            sum_g = np.bincount(leaf, weights=g[:, k], minlength=nl)
-            sum_h = np.bincount(leaf, weights=h[:, k], minlength=nl) + 1e-15
-            refit_val = (_leaf_output_np(sum_g, sum_h, cfg) * tree.shrinkage)
-            new_leaf = (decay_rate * tree.leaf_value[:nl]
-                        + (1.0 - decay_rate) * refit_val)
-            tree.leaf_value = tree.leaf_value.copy()
-            tree.leaf_value[:nl] = new_leaf
-            tree.leaf_count = np.bincount(leaf, minlength=nl).astype(
-                np.float32)[: len(tree.leaf_count)]
-            new_gbdt._host_cache[k][it] = tree
-            arrays = new_gbdt.dev_models[k][it]
-            lv = np.zeros(arrays.leaf_value.shape[0], np.float32)
-            lv[:nl] = new_leaf
-            new_gbdt.dev_models[k][it] = arrays._replace(
-                leaf_value=jnp.asarray(lv))
-            scores[:, k] += new_leaf[leaf]
+    _refit_pass(np.asarray(X).shape[0], k_cls, n_iters, gbdt.init_scores,
+                objective, cfg, decay_rate, route, store)
     return new_b

@@ -198,6 +198,9 @@ def _feature_info(m) -> str:
 # -------------------------------------------------------------------- JSON dump
 def _loaded_tree_structure_dict(t: "LoadedTree") -> dict:
     """Nested node dict for a loaded (raw-threshold) tree."""
+    import sys
+    sys.setrecursionlimit(max(sys.getrecursionlimit(),
+                              4 * t.num_leaves + 1000))
     m = max(t.num_leaves - 1, 0)
 
     def node(idx: int):

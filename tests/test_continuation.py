@@ -6,6 +6,7 @@ Reference behaviors: ``boosting.cpp:34-59`` (input_model), ``gbdt.cpp:250-254``
 """
 
 import os
+import shutil
 import subprocess
 import sys
 
@@ -168,6 +169,7 @@ def test_cli_refit_and_convert_model(tmp_path):
     assert "PredictTree0" in src and "PredictRaw" in src
 
 
+@pytest.mark.skipif(shutil.which("g++") is None, reason="no C++ toolchain")
 def test_convert_model_compiles_and_matches(tmp_path):
     """The generated C++ compiles and reproduces raw predictions."""
     X, y = _make(n=300, f=5)
