@@ -26,13 +26,17 @@ import time
 
 import numpy as np
 
-ROWS = int(os.environ.get("BENCH_ROWS", 1_000_000))
+ROWS = int(os.environ.get("BENCH_ROWS", 10_500_000))
 FEATURES = 28
-ITERS = int(os.environ.get("BENCH_ITERS", 20))
+ITERS = int(os.environ.get("BENCH_ITERS", 60))
 NUM_LEAVES = 255
 REFERENCE_ROW_ITERS_PER_SEC = 10_500_000 * 500 / 130.094
-ATTEMPT_TIMEOUT = int(os.environ.get("BENCH_ATTEMPT_TIMEOUT", 1500))
+ATTEMPT_TIMEOUT = int(os.environ.get("BENCH_ATTEMPT_TIMEOUT", 2400))
 BACKEND_PROBE_TIMEOUT = int(os.environ.get("BENCH_PROBE_TIMEOUT", 240))
+# Wave growth width for the bench config (quality-equivalent best-first
+# set; see models/grower.py GrowerConfig.leaf_batch).
+LEAF_BATCH = int(os.environ.get("BENCH_LEAF_BATCH", 16))
+QUANTIZED = os.environ.get("BENCH_QUANTIZED", "0") == "1"
 
 
 def make_higgs_like(n, f, seed=0):
@@ -91,7 +95,10 @@ def run_bench(rows, iters):
         "min_sum_hessian_in_leaf": 100.0,
         "metric": "none",
         "verbosity": -1,
+        "tpu_leaf_batch": LEAF_BATCH,
     }
+    if QUANTIZED:
+        params["use_quantized_grad"] = True
     ds = lgb.Dataset(X, label=y)
     t_bin0 = time.time()
     ds.construct(params)
@@ -101,11 +108,15 @@ def run_bench(rows, iters):
     # reference excludes data loading).
     bst = lgb.Booster(params=params, train_set=ds)
     bst.update()
+    # The tunneled backend's block_until_ready can return before compute
+    # finishes; a host readback of a score slice is the only reliable
+    # fence, so time against that.
+    np.array(jax.device_get(bst._gbdt.scores[:8]))
 
     t0 = time.time()
     for _ in range(iters):
         bst.update()
-    jax.block_until_ready(bst._gbdt.scores)
+    np.array(jax.device_get(bst._gbdt.scores[:8]))
     elapsed = time.time() - t0
 
     iters_per_sec = iters / elapsed
@@ -127,7 +138,8 @@ def run_bench(rows, iters):
         "vs_baseline": round(row_iters_per_sec / REFERENCE_ROW_ITERS_PER_SEC, 4),
         "detail": {
             "rows": rows, "features": FEATURES, "iters": iters,
-            "num_leaves": NUM_LEAVES,
+            "num_leaves": NUM_LEAVES, "leaf_batch": LEAF_BATCH,
+            "quantized": QUANTIZED,
             "platform": platform, "devices": n_dev,
             "train_time_s": round(elapsed, 3),
             "iters_per_sec": round(iters_per_sec, 3),

@@ -174,9 +174,11 @@ _PARAMS: List[Tuple[str, Any, Any, Tuple[str, ...], Optional[Tuple[Any, Any]]]] 
     ("gpu_use_dp", bool, False, (), None),
     ("num_gpu", int, 1, (), (1, None)),
     # TPU-specific knobs (no reference analog).
-    ("tpu_histogram_impl", str, "auto", (), None),  # auto|onehot|segment
+    ("tpu_histogram_impl", str, "auto", (), None),  # auto|pallas|flat_bf16|onehot|segment
     ("tpu_rows_block", int, 16384, (), (256, None)),
     ("tpu_donate_buffers", bool, True, (), None),
+    # Leaves split per growth step (wave growth); 1 = strict best-first.
+    ("tpu_leaf_batch", int, 1, (), (1, 128)),
 ]
 
 _CANONICAL: Dict[str, Tuple[str, Any, Any, Optional[Tuple[Any, Any]]]] = {}

@@ -143,6 +143,7 @@ class GBDT:
             histogram_impl=hist_impl,
             rows_block=cfg.tpu_rows_block,
             gather_rows=self.mesh is None,
+            leaf_batch=cfg.tpu_leaf_batch,
             feature_fraction_bynode=cfg.feature_fraction_bynode,
             quantized=cfg.use_quantized_grad,
             num_grad_quant_bins=cfg.num_grad_quant_bins,

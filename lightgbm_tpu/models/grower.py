@@ -33,7 +33,7 @@ from typing import NamedTuple, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from ..ops.histogram import histogram_from_vals
+from ..ops.histogram import histogram_from_vals, histogram_sib_from_vals
 from ..ops.split import (BestSplit, SplitConfig, best_split, leaf_output,
                          smoothed_output)
 
@@ -57,6 +57,15 @@ class GrowerConfig:
     # device mesh: dynamic_slice over globally-grouped rows would destroy the
     # row-sharding locality the distributed path relies on.
     gather_rows: bool = True
+    # Wave growth: split up to this many leaves per while-loop step.  The
+    # split SET stays best-first (each wave takes the current top-gain
+    # leaves, truncated to the leaf budget by gain order); only the
+    # interleaving differs from the reference's strictly sequential
+    # leaf-wise order.  >1 packs the multi-sibling histogram kernel's M
+    # dimension (siblings x channels, up to 128) and divides the
+    # sequential-step count — the TPU-shaped analog of the CUDA learner's
+    # per-leaf kernel pipeline (cuda_single_gpu_tree_learner.cpp:174).
+    leaf_batch: int = 1
     # Quantized training (reference GradientDiscretizer,
     # gradient_discretizer.hpp:128): int8 grad/hess levels, int32 histogram
     # accumulation, per-iteration scales; see ops/quantize.py.
@@ -190,22 +199,33 @@ def make_grower(cfg: GrowerConfig):
             rand_bins=rand_bins,
         )
 
-    def _best_for_pair(hist2, pg2, ph2, pc2, meta, feature_mask, penalty2=None,
-                       parent_out2=None, key=None):
-        """Both children's split searches in one vmapped program — halves the
-        kernel count of the per-split scalar scans."""
+    def _batch_node_inputs(key, feature_mask, nbpf, k):
+        """Per-node (fmask (k,F), rand_bins (k,F) or None) for k children."""
+        fmaskk = jnp.broadcast_to(feature_mask, (k,) + feature_mask.shape)
+        randk = None
+        if not need_key or key is None:
+            return fmaskk, randk
+        if use_rand:
+            key, k1 = jax.random.split(key)
+            draw = jax.random.randint(k1, (k,) + nbpf.shape, 0, 1 << 30)
+            randk = draw % jnp.maximum(nbpf, 1)[None, :]
+        if use_bynode:
+            key, k2 = jax.random.split(key)
+            sel = jax.random.uniform(k2, fmaskk.shape) \
+                < cfg.feature_fraction_bynode
+            keep = jnp.any(sel & fmaskk, axis=1, keepdims=True)
+            fmaskk = jnp.where(keep, fmaskk & sel, fmaskk)
+        return fmaskk, randk
+
+    def _best_for_batch(histk, pgk, phk, pck, meta, feature_mask,
+                        penaltyk=None, parent_outk=None, key=None):
+        """All k children's split searches in one vmapped program — one
+        kernel set per wave instead of per child."""
         nbpf, nan_bins, is_cat, monotone = meta
-        if parent_out2 is None:
-            parent_out2 = jnp.zeros(2, jnp.float32)
-        fmask2 = jnp.stack([feature_mask, feature_mask])
-        rand2 = None
-        if need_key and key is not None:
-            ka, kb = jax.random.split(key)
-            fm_a, rb_a = _node_inputs(ka, feature_mask, nbpf)
-            fm_b, rb_b = _node_inputs(kb, feature_mask, nbpf)
-            fmask2 = jnp.stack([fm_a, fm_b])
-            if rb_a is not None:
-                rand2 = jnp.stack([rb_a, rb_b])
+        k = histk.shape[0]
+        if parent_outk is None:
+            parent_outk = jnp.zeros(k, jnp.float32)
+        fmaskk, randk = _batch_node_inputs(key, feature_mask, nbpf, k)
 
         def one(hist, pg, ph, pc, penalty, pout, fmask, rand_bins):
             return best_split(
@@ -217,23 +237,25 @@ def make_grower(cfg: GrowerConfig):
                 rand_bins=rand_bins,
             )
 
-        if penalty2 is None and rand2 is None:
+        if penaltyk is None and randk is None:
             return jax.vmap(
                 lambda h, g, hh, c, po, fm: one(h, g, hh, c, None, po, fm,
                                                 None))(
-                hist2, pg2, ph2, pc2, parent_out2, fmask2)
-        if penalty2 is None:
+                histk, pgk, phk, pck, parent_outk, fmaskk)
+        if penaltyk is None:
             return jax.vmap(
                 lambda h, g, hh, c, po, fm, rb: one(h, g, hh, c, None, po,
                                                     fm, rb))(
-                hist2, pg2, ph2, pc2, parent_out2, fmask2, rand2)
-        if rand2 is None:
+                histk, pgk, phk, pck, parent_outk, fmaskk, randk)
+        if randk is None:
             return jax.vmap(
                 lambda h, g, hh, c, pe, po, fm: one(h, g, hh, c, pe, po, fm,
                                                     None))(
-                hist2, pg2, ph2, pc2, penalty2, parent_out2, fmask2)
-        return jax.vmap(one)(hist2, pg2, ph2, pc2, penalty2, parent_out2,
-                             fmask2, rand2)
+                histk, pgk, phk, pck, penaltyk, parent_outk, fmaskk)
+        return jax.vmap(one)(histk, pgk, phk, pck, penaltyk, parent_outk,
+                             fmaskk, randk)
+
+    _best_for_pair = _best_for_batch
 
     def _cegb_penalty(count, feat_used, path_used, coupled, lazy):
         """Per-feature gain penalty (reference CEGB ``DeltaGain``):
@@ -405,6 +427,29 @@ def make_grower(cfg: GrowerConfig):
             return hist
         return hist.astype(jnp.float32) * scale3
 
+    def _part_branch_for(bins_pad, nan_bins, S):
+        """Partition one leaf's contiguous perm slice of static size S
+        (cheap S-ops; no histogram).  Shared by the perm and wave layouts."""
+        def branch(perm, start, cnt, feat, sbin, dleft, scat, cmask):
+            seg = jax.lax.dynamic_slice(perm, (start,), (S,))
+            valid = jnp.arange(S, dtype=jnp.int32) < cnt
+            col = bins_pad[seg, feat].astype(jnp.int32)
+            is_nan = col == nan_bins[feat]
+            go_left = jnp.where(scat, cmask[col], col <= sbin)
+            go_left = jnp.where(is_nan & ~scat, dleft, go_left)
+            go_left = go_left & valid
+            go_right = valid & ~go_left
+            nl_phys = jnp.sum(go_left.astype(jnp.int32))
+            lpos = jnp.cumsum(go_left.astype(jnp.int32)) - go_left
+            rpos = nl_phys + jnp.cumsum(go_right.astype(jnp.int32)) - go_right
+            pos = jnp.where(go_left, lpos,
+                            jnp.where(go_right, rpos,
+                                      jnp.arange(S, dtype=jnp.int32)))
+            new_seg = jnp.zeros(S, jnp.int32).at[pos].set(seg)
+            perm = jax.lax.dynamic_update_slice(perm, new_seg, (start,))
+            return perm, nl_phys
+        return branch
+
     def _root_best(state, meta, feature_mask, root_pen):
         """Root split search (shared by both layouts)."""
         key = None
@@ -416,12 +461,10 @@ def make_grower(cfg: GrowerConfig):
                        feature_mask, root_pen, state.leaf_out[0], key)
         return state, bs
 
-    # ------------------------------------------------------------------ perm path
-    def _grow_perm(bins, vals, scale3, feature_mask, meta, cegb=None,
-                   key=None):
-        """Permutation-layout growth (single device)."""
+    def _perm_setup(bins, vals, scale3, meta, feature_mask, cegb, key):
+        """Shared permutation-layout prologue: padded arrays, buckets, root
+        histogram/state/best-split."""
         n, f = bins.shape
-        nan_bins = meta[1]
         bins_pad = jnp.concatenate([bins, jnp.zeros((1, f), bins.dtype)], 0)
         vals_pad = jnp.concatenate([vals, jnp.zeros((1, 3), vals.dtype)], 0)
         buckets = _split_buckets(n)
@@ -429,13 +472,11 @@ def make_grower(cfg: GrowerConfig):
         buckets_arr = jnp.asarray(buckets, jnp.int32)
         perm0 = jnp.concatenate([jnp.arange(n, dtype=jnp.int32),
                                  jnp.full(max_bucket, n, jnp.int32)])
-
         root_hist = _scale_hist(histogram_from_vals(
             bins, vals, num_bins=B, impl=cfg.histogram_impl,
             rows_block=cfg.rows_block), scale3)
         root_tot = jnp.sum(root_hist[0], axis=0)
         root_g, root_h, root_c = root_tot[0], root_tot[1], root_tot[2]
-
         state = _init_state(n, f, root_hist, root_g, root_h, root_c, key)
         state = state._replace(perm=perm0)
         root_pen = None
@@ -444,28 +485,30 @@ def make_grower(cfg: GrowerConfig):
                                      state.leaf_path[0], *cegb)
         state, root_bs = _root_best(state, meta, feature_mask, root_pen)
         state = _store_best(state, jnp.asarray(0), root_bs, jnp.asarray(True))
+        return state, bins_pad, vals_pad, buckets, buckets_arr, max_bucket
 
-        def _make_part_branch(S):
-            """Partition the leaf's slice (cheap S-ops; no histogram)."""
-            def branch(perm, start, cnt, feat, sbin, dleft, scat, cmask):
-                seg = jax.lax.dynamic_slice(perm, (start,), (S,))
-                valid = jnp.arange(S, dtype=jnp.int32) < cnt
-                col = bins_pad[seg, feat].astype(jnp.int32)
-                is_nan = col == nan_bins[feat]
-                go_left = jnp.where(scat, cmask[col], col <= sbin)
-                go_left = jnp.where(is_nan & ~scat, dleft, go_left)
-                go_left = go_left & valid
-                go_right = valid & ~go_left
-                nl_phys = jnp.sum(go_left.astype(jnp.int32))
-                lpos = jnp.cumsum(go_left.astype(jnp.int32)) - go_left
-                rpos = nl_phys + jnp.cumsum(go_right.astype(jnp.int32)) - go_right
-                pos = jnp.where(go_left, lpos,
-                                jnp.where(go_right, rpos,
-                                          jnp.arange(S, dtype=jnp.int32)))
-                new_seg = jnp.zeros(S, jnp.int32).at[pos].set(seg)
-                perm = jax.lax.dynamic_update_slice(perm, new_seg, (start,))
-                return perm, nl_phys
-            return branch
+    def _row_leaf_from_perm(state, n, max_bucket):
+        """row -> leaf assignment from the final grouped permutation:
+        position i belongs to the leaf whose [start, start+rows) range
+        contains i."""
+        starts = jnp.where(jnp.arange(L) < state.num_leaves,
+                           state.leaf_start, n + max_bucket)
+        order = jnp.argsort(starts)
+        sorted_starts = starts[order]
+        pos_leaf = order[jnp.clip(
+            jnp.searchsorted(sorted_starts, jnp.arange(n, dtype=jnp.int32),
+                             side="right") - 1, 0, L - 1)].astype(jnp.int32)
+        return jnp.zeros(n, jnp.int32).at[state.perm[:n]].set(pos_leaf)
+
+    # ------------------------------------------------------------------ perm path
+    def _grow_perm(bins, vals, scale3, feature_mask, meta, cegb=None,
+                   key=None):
+        """Permutation-layout growth (single device)."""
+        n, f = bins.shape
+        nan_bins = meta[1]
+        (state, bins_pad, vals_pad, buckets, buckets_arr,
+         max_bucket) = _perm_setup(bins, vals, scale3, meta, feature_mask,
+                                   cegb, key)
 
         def _make_hist_branch(S):
             """Histogram of a contiguous child range (the smaller sibling —
@@ -483,7 +526,8 @@ def make_grower(cfg: GrowerConfig):
                     rows_block=min(cfg.rows_block, S)), scale3)
             return branch
 
-        part_branches = [_make_part_branch(S) for S in buckets]
+        part_branches = [_part_branch_for(bins_pad, nan_bins, S)
+                         for S in buckets]
         hist_branches = [_make_hist_branch(S) for S in buckets]
 
         def _bucket_of(cnt):
@@ -537,18 +581,234 @@ def make_grower(cfg: GrowerConfig):
             return (st.num_leaves < L) & (jnp.max(st.best_gain) > _NEG_INF)
 
         state = jax.lax.while_loop(cond, body, state)
+        return _finish(state), _row_leaf_from_perm(state, n, max_bucket)
 
-        # row -> leaf assignment from the final grouped permutation: position i
-        # belongs to the leaf whose [start, start+rows) range contains i.
-        starts = jnp.where(jnp.arange(L) < state.num_leaves,
-                           state.leaf_start, n + max_bucket)
-        order = jnp.argsort(starts)
-        sorted_starts = starts[order]
-        pos_leaf = order[jnp.clip(
-            jnp.searchsorted(sorted_starts, jnp.arange(n, dtype=jnp.int32),
-                             side="right") - 1, 0, L - 1)].astype(jnp.int32)
-        row_leaf = jnp.zeros(n, jnp.int32).at[state.perm[:n]].set(pos_leaf)
-        return _finish(state), row_leaf
+    # ------------------------------------------------------------------ wave path
+    def _grow_wave(bins, vals, scale3, feature_mask, meta, cegb=None,
+                   key=None):
+        """Wave growth (permutation layout): split the top-W leaves per step.
+
+        Per wave: partition each chosen leaf's contiguous segment, compact
+        every SMALLER sibling's rows into one buffer, histogram all of them
+        in a single multi-sibling kernel (M = W x channels on the MXU), get
+        the larger siblings by subtraction, and run one vmapped split search
+        over all 2W children.  Sequential depth per tree drops from
+        num_leaves-1 steps to ~ceil((num_leaves-1)/W)."""
+        n, f = bins.shape
+        W = min(cfg.leaf_batch, max(L - 1, 1))
+        nan_bins = meta[1]
+        (state, bins_pad, vals_pad, buckets, buckets_arr,
+         max_bucket) = _perm_setup(bins, vals, scale3, meta, feature_mask,
+                                   cegb, key)
+
+        def _make_wave_hist_branch(S):
+            """Histogram ALL W smaller siblings from one compacted buffer."""
+            def branch(perm, small_start, small_cnt, offs):
+                pos = jnp.arange(S, dtype=jnp.int32)
+                s_id = jnp.clip(
+                    jnp.searchsorted(offs, pos, side="right") - 1, 0, W - 1
+                ).astype(jnp.int32)
+                within = pos - offs[s_id]
+                valid = within < small_cnt[s_id]
+                src = small_start[s_id] + jnp.where(valid, within, 0)
+                rows = jnp.where(valid, perm[src], n)
+                sib = jnp.where(valid, s_id, -1)
+                hist = histogram_sib_from_vals(
+                    bins_pad[rows], vals_pad[rows], sib,
+                    num_bins=B, num_sibs=W,
+                    impl=cfg.histogram_impl,
+                    rows_block=min(cfg.rows_block, S))
+                return _scale_hist(hist, scale3)
+            return branch
+
+        part_branches = [_part_branch_for(bins_pad, nan_bins, S)
+                         for S in buckets]
+        wave_hist_branches = [_make_wave_hist_branch(S) for S in buckets]
+
+        def _bucket_of(cnt):
+            return jnp.clip(jnp.searchsorted(buckets_arr, cnt, side="left"),
+                            0, len(buckets) - 1).astype(jnp.int32)
+
+        def body(st: _GrowState) -> _GrowState:
+            budget = L - st.num_leaves
+            top_g, top_l = jax.lax.top_k(st.best_gain, W)
+            slot = jnp.arange(W, dtype=jnp.int32)
+            active = (top_g > _NEG_INF) & (slot < budget)
+            n_act = jnp.sum(active.astype(jnp.int32))
+            rank = (jnp.cumsum(active.astype(jnp.int32))
+                    - active.astype(jnp.int32))
+            # Inactive slots scatter out-of-bounds (dropped by XLA).
+            node_j = jnp.where(active, st.num_leaves - 1 + rank, M + L)
+            newleaf_j = jnp.where(active, st.num_leaves + rank, L + M)
+            leaf_j = jnp.where(active, top_l, L + M)
+
+            starts = st.leaf_start[top_l]
+            cnts = jnp.where(active, st.leaf_rows[top_l], 0)
+            feats = st.best_feature[top_l]
+            sbins = st.best_bin[top_l]
+            dlefts = st.best_default_left[top_l]
+            scats = st.best_is_cat[top_l]
+            cmasks = st.best_cat_mask[top_l]
+
+            def part_one(j, carry):
+                perm, nls = carry
+
+                def do(p):
+                    return jax.lax.switch(
+                        _bucket_of(cnts[j]), part_branches, p, starts[j],
+                        cnts[j], feats[j], sbins[j], dlefts[j], scats[j],
+                        cmasks[j])
+
+                perm, nl = jax.lax.cond(
+                    active[j], do, lambda p: (p, jnp.asarray(0, jnp.int32)),
+                    perm)
+                return perm, nls.at[j].set(nl)
+
+            perm, nl_phys = jax.lax.fori_loop(
+                0, W, part_one, (st.perm, jnp.zeros(W, jnp.int32)))
+
+            small_left = nl_phys <= cnts - nl_phys
+            small_start = jnp.where(small_left, starts, starts + nl_phys)
+            small_cnt = jnp.minimum(nl_phys, cnts - nl_phys)
+            offs = jnp.cumsum(small_cnt) - small_cnt
+            total_small = jnp.sum(small_cnt)
+            hist_small = jax.lax.switch(
+                _bucket_of(total_small), wave_hist_branches, perm,
+                small_start, small_cnt, offs)                 # (W, F, B, 3)
+
+            parent_hist = st.leaf_hist[top_l]
+            hist_big = parent_hist - hist_small
+            sl = small_left[:, None, None, None]
+            hist_left = jnp.where(sl, hist_small, hist_big)
+            hist_right = jnp.where(sl, hist_big, hist_small)
+
+            pg = st.leaf_sum_grad[top_l]
+            ph = st.leaf_sum_hess[top_l]
+            pc = st.leaf_count[top_l]
+            gl, hl, cl = st.best_gl[top_l], st.best_hl[top_l], st.best_cl[top_l]
+            gr, hr, cr = pg - gl, ph - hl, pc - cl
+            pout = st.leaf_out[top_l]
+            out_l = smoothed_output(gl, hl, cl, pout, cfg.split)
+            out_r = smoothed_output(gr, hr, cr, pout, cfg.split)
+
+            # ---- tree updates (batched scatters over W nodes)
+            tr = st.tree
+            parent = st.leaf_parent[top_l]
+            was_left = st.leaf_is_left[top_l]
+            pl_idx = jnp.where(active & (parent >= 0) & was_left,
+                               jnp.maximum(parent, 0), M + L)
+            pr_idx = jnp.where(active & (parent >= 0) & ~was_left,
+                               jnp.maximum(parent, 0), M + L)
+            left_child = tr.left_child.at[pl_idx].set(node_j, mode="drop")
+            right_child = tr.right_child.at[pr_idx].set(node_j, mode="drop")
+            tree = tr._replace(
+                split_feature=tr.split_feature.at[node_j].set(
+                    feats, mode="drop"),
+                split_bin=tr.split_bin.at[node_j].set(sbins, mode="drop"),
+                default_left=tr.default_left.at[node_j].set(
+                    dlefts, mode="drop"),
+                is_cat=tr.is_cat.at[node_j].set(scats, mode="drop"),
+                cat_mask=tr.cat_mask.at[node_j].set(cmasks, mode="drop"),
+                left_child=left_child.at[node_j].set(~leaf_j, mode="drop"),
+                right_child=right_child.at[node_j].set(
+                    ~newleaf_j, mode="drop"),
+                split_gain=tr.split_gain.at[node_j].set(top_g, mode="drop"),
+                internal_value=tr.internal_value.at[node_j].set(
+                    pout, mode="drop"),
+                internal_count=tr.internal_count.at[node_j].set(
+                    pc, mode="drop"),
+            )
+
+            # ---- per-leaf state (batched scatters over 2W children)
+            idx2 = jnp.concatenate([leaf_j, newleaf_j])
+            cat2 = lambda a, b: jnp.concatenate([a, b])
+            depth = st.leaf_depth[top_l] + 1
+            st = st._replace(
+                perm=perm,
+                tree=tree,
+                num_leaves=st.num_leaves + n_act,
+                leaf_start=st.leaf_start.at[newleaf_j].set(
+                    starts + nl_phys, mode="drop"),
+                leaf_rows=st.leaf_rows.at[leaf_j].set(nl_phys, mode="drop")
+                                     .at[newleaf_j].set(cnts - nl_phys,
+                                                        mode="drop"),
+                leaf_hist=st.leaf_hist.at[idx2].set(
+                    cat2(hist_left, hist_right), mode="drop"),
+                leaf_sum_grad=st.leaf_sum_grad.at[idx2].set(
+                    cat2(gl, gr), mode="drop"),
+                leaf_sum_hess=st.leaf_sum_hess.at[idx2].set(
+                    cat2(hl, hr), mode="drop"),
+                leaf_count=st.leaf_count.at[idx2].set(
+                    cat2(cl, cr), mode="drop"),
+                leaf_depth=st.leaf_depth.at[idx2].set(
+                    cat2(depth, depth), mode="drop"),
+                leaf_parent=st.leaf_parent.at[idx2].set(
+                    cat2(node_j, node_j), mode="drop"),
+                leaf_is_left=st.leaf_is_left.at[idx2].set(
+                    cat2(jnp.ones(W, bool), jnp.zeros(W, bool)),
+                    mode="drop"),
+                leaf_out=st.leaf_out.at[idx2].set(
+                    cat2(out_l, out_r), mode="drop"),
+            )
+
+            # ---- CEGB bookkeeping + penalties
+            penalty2 = None
+            if cfg.split.use_cegb and cegb is not None:
+                coupled, lazy = cegb
+                fhot = (jnp.arange(f)[None, :] == feats[:, None]) \
+                    & active[:, None]                        # (W, F)
+                feat_used = st.feat_used | jnp.any(fhot, axis=0)
+                child_path = st.leaf_path[top_l] | fhot      # (W, F)
+                st = st._replace(
+                    feat_used=feat_used,
+                    leaf_path=st.leaf_path.at[idx2].set(
+                        cat2(child_path, child_path), mode="drop"))
+                pen_l = jax.vmap(
+                    lambda c, p: _cegb_penalty(c, feat_used, p, coupled,
+                                               lazy))(cl, child_path)
+                pen_r = jax.vmap(
+                    lambda c, p: _cegb_penalty(c, feat_used, p, coupled,
+                                               lazy))(cr, child_path)
+                penalty2 = cat2(pen_l, pen_r)
+
+            # ---- best splits for all 2W children in one vmapped search
+            node_key = None
+            if need_key:
+                rng, node_key = jax.random.split(st.rng)
+                st = st._replace(rng=rng)
+            hist2 = cat2(hist_left, hist_right)
+            bs = _best_for_batch(hist2, cat2(gl, gr), cat2(hl, hr),
+                                 cat2(cl, cr), meta, feature_mask, penalty2,
+                                 cat2(out_l, out_r), node_key)
+            if cfg.max_depth <= 0:
+                depth_ok = jnp.ones(2 * W, bool)
+            else:
+                depth_ok = cat2(depth, depth) < cfg.max_depth
+            gain2 = jnp.where(depth_ok, bs.gain, _NEG_INF)
+            return st._replace(
+                best_gain=st.best_gain.at[idx2].set(gain2, mode="drop"),
+                best_feature=st.best_feature.at[idx2].set(
+                    bs.feature, mode="drop"),
+                best_bin=st.best_bin.at[idx2].set(bs.bin, mode="drop"),
+                best_default_left=st.best_default_left.at[idx2].set(
+                    bs.default_left, mode="drop"),
+                best_is_cat=st.best_is_cat.at[idx2].set(
+                    bs.is_cat, mode="drop"),
+                best_cat_mask=st.best_cat_mask.at[idx2].set(
+                    bs.cat_mask, mode="drop"),
+                best_gl=st.best_gl.at[idx2].set(
+                    bs.sum_grad_left, mode="drop"),
+                best_hl=st.best_hl.at[idx2].set(
+                    bs.sum_hess_left, mode="drop"),
+                best_cl=st.best_cl.at[idx2].set(
+                    bs.count_left, mode="drop"),
+            )
+
+        def cond(st: _GrowState):
+            return (st.num_leaves < L) & (jnp.max(st.best_gain) > _NEG_INF)
+
+        state = jax.lax.while_loop(cond, body, state)
+        return _finish(state), _row_leaf_from_perm(state, n, max_bucket)
 
     # ------------------------------------------------------------------ mask path
     def _grow_mask(bins, vals, scale3, feature_mask, meta, cegb=None,
@@ -677,8 +937,9 @@ def make_grower(cfg: GrowerConfig):
         if need_key and split_key is None:
             split_key = jax.random.PRNGKey(0)
         if cfg.gather_rows and bins.shape[0] > _MIN_BUCKET:
-            tree, row_leaf = _grow_perm(bins, vals, scale3, feature_mask,
-                                        meta, cegb, split_key)
+            grow_fn = _grow_wave if cfg.leaf_batch > 1 else _grow_perm
+            tree, row_leaf = grow_fn(bins, vals, scale3, feature_mask,
+                                     meta, cegb, split_key)
         else:
             tree, row_leaf = _grow_mask(bins, vals, scale3, feature_mask,
                                         meta, cegb, split_key)

@@ -1,24 +1,29 @@
-"""Pallas TPU histogram kernel — the framework's hottest op.
+"""Pallas TPU histogram kernels — the framework's hottest op.
 
 Reference counterpart: the CUDA shared-memory histogram kernels
 (``src/treelearner/cuda/cuda_histogram_constructor.cu:31-66`` — per-block
 shared-mem scatter-add + atomics).  TPUs have no atomics and scatters
-serialize, so the kernel uses a different decomposition:
+serialize, so the kernel computes the histogram as a **matmul against a
+flattened one-hot**, generated inside VMEM:
 
-    hist[c, f*B+b] = sum_n vals[n, c] * (bins[n, f] == b)
+    out[(l, c), f*B + b] = sum_n  vals[n, c] * (sib[n] == l) * (bins[n, f] == b)
 
-i.e. a matmul ``valsᵀ (C × n) @ onehot (n × B)`` per feature, accumulated in
-VMEM across row blocks.  Two properties make this the right TPU shape:
+Why this shape wins on the MXU:
 
-- The channel axis C (grad, hess, count) sits on the MXU's **sublane** side
-  where the padding floor is 8, not on the lane side where it would be 128 —
-  a 16x reduction in wasted MACs vs the naive ``onehotᵀ @ vals`` layout.
-- The one-hot matrix is generated **inside VMEM** from the (blk, F) uint8 bin
-  tile, so HBM traffic is just bins + vals (the XLA einsum fallback
-  materializes the (blk, F, B) one-hot through HBM, ~B× more traffic).
-
-Output layout is (F, C_pad, B); the public wrapper transposes to the (F, B, 3)
-histogram the split scan consumes.
+- The one-hot (the big streamed operand) never touches HBM: it is built in
+  VMEM from the (blk, ft) uint8 bin tile, so HBM traffic is just bins + vals.
+- A whole feature TILE shares ONE dot per row-block (N = ft*B lanes),
+  instead of per-feature M=8 matmuls — fewer, larger matmuls with identical
+  streamed volume.  The grid tiles (row-blocks x feature-tiles) so the VMEM
+  one-hot stays bounded for arbitrarily wide datasets.
+- The M dimension carries (sibling x channel).  Growing multiple leaves per
+  wave packs M up to 128 (16 siblings x 8 channels), so the systolic array's
+  row dimension is fully used while the streamed K x N volume stays
+  proportional to the rows actually histogrammed (the reference's
+  smaller-sibling trick, ``serial_tree_learner.cpp:369``).
+- int8 variant: s8 vals x s8 one-hot -> s32 accumulation — the reference's
+  quantized-training histograms (``Int32HistogramSumReducer``, ``bin.h:48``)
+  on the MXU's double-rate int8 path.
 """
 
 from __future__ import annotations
@@ -30,66 +35,206 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-C_PAD = 8  # f32 sublane tile
+C_PAD = 8  # channels (grad, hess, count) padded to one f32 sublane tile
+
+_DTYPES = {
+    "f32": (jnp.float32, jnp.float32, 4),
+    "bf16": (jnp.bfloat16, jnp.float32, 2),
+    "int8": (jnp.int8, jnp.int32, 1),
+}
 
 
-def _hist_kernel(bins_ref, vals_ref, out_ref, *, num_bins: int,
-                 num_features: int):
-    i = pl.program_id(0)
+def _pick_tiles(f: int, num_bins: int, itemsize: int, rows_block: int,
+                num_sibs: int = 1, acc_size: int = 4):
+    """(rows_block, features_per_tile) bounding the kernel's VMEM working
+    set (the in-VMEM one-hot PLUS the (num_sibs*C_PAD, ft*B) accumulator
+    block) to ~12MB.
 
-    @pl.when(i == 0)
+    The row block is fixed first (1024 unless the caller asks for less) and
+    the feature tile is sized from the remaining budget — wide matmul N
+    (ft*B lanes) beats a deep K, and arbitrarily wide datasets tile along
+    the feature grid dimension instead of blowing VMEM."""
+    budget = 12 * 1024 * 1024
+    # rows_block > 4096 means "tuned for the XLA einsum path" — auto-pick.
+    blk = 1024 if (rows_block <= 0 or rows_block > 4096) else rows_block
+    per_ft = num_bins * (blk * itemsize + num_sibs * C_PAD * acc_size)
+    ft = max(1, min(f, budget // per_ft))
+    while blk > 256 and ft * num_bins * (blk * itemsize
+                                         + num_sibs * C_PAD * acc_size) \
+            > budget:
+        blk //= 2
+    return blk, ft
+
+
+def _prep(bins, vals, rows_block, ftile, sib=None):
+    """Pad rows to the block size, features to the tile size, channels to
+    C_PAD; returns (bins, valsT, sib2, nblocks, nftiles).
+
+    Phantom feature columns are filled with bin 0; their histogram blocks
+    are sliced off by the caller, so the garbage never escapes.
+    """
+    n, f = bins.shape
+    pad = (-n) % rows_block
+    fpad = (-f) % ftile
+    if pad or fpad:
+        bins = jnp.pad(bins, ((0, pad), (0, fpad)))
+    if pad:
+        vals = jnp.pad(vals, ((0, pad), (0, 0)))
+        if sib is not None:
+            sib = jnp.pad(sib, (0, pad), constant_values=-1)
+    c = vals.shape[1]
+    valsT = jnp.pad(vals, ((0, 0), (0, C_PAD - c))).T  # (C_PAD, ntot)
+    ntot = n + pad
+    sib2 = None if sib is None else sib.reshape(1, ntot)
+    return bins, valsT, sib2, ntot // rows_block, (f + fpad) // ftile
+
+
+def _flat_kernel(bins_ref, valsT_ref, out_ref, *, num_bins, ftile,
+                 oh_dtype, acc_dtype):
+    rb = pl.program_id(1)  # row-block index (grid dim 1, iterates fastest)
+
+    @pl.when(rb == 0)
     def _init():
         out_ref[:] = jnp.zeros_like(out_ref)
 
-    bins_blk = bins_ref[:].astype(jnp.int32)        # (blk, F)
-    vals_blk = vals_ref[:]                          # (blk, C_PAD) f32
+    bins_blk = bins_ref[:].astype(jnp.int32)            # (blk, ft)
+    valsT = valsT_ref[:]                                # (C_PAD, blk)
     blk = bins_blk.shape[0]
-    iota_b = jax.lax.broadcasted_iota(jnp.int32, (blk, num_bins), 1)
-    for f in range(num_features):
-        onehot = (bins_blk[:, f][:, None] == iota_b).astype(jnp.float32)
-        # (C_PAD, blk) @ (blk, B) on the MXU, f32 accumulation.
-        partial = jax.lax.dot_general(
-            vals_blk, onehot,
-            dimension_numbers=(((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )                                           # (C_PAD, B)
-        out_ref[f, :, :] += partial
+    iota_b = jax.lax.broadcasted_iota(jnp.int32, (blk, ftile, num_bins), 2)
+    oh = (bins_blk[:, :, None] == iota_b).astype(oh_dtype)
+    oh = oh.reshape(blk, ftile * num_bins)              # (blk, ft*B)
+    out_ref[:, :] += jax.lax.dot_general(
+        valsT.astype(oh_dtype) if oh_dtype != valsT.dtype else valsT,
+        oh, dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=acc_dtype)
 
 
+def _flat_sib_kernel(bins_ref, valsT_ref, sib_ref, out_ref, *, num_bins,
+                     ftile, num_sibs, oh_dtype, acc_dtype):
+    rb = pl.program_id(1)  # row-block index (grid dim 1, iterates fastest)
+
+    @pl.when(rb == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    bins_blk = bins_ref[:].astype(jnp.int32)            # (blk, ft)
+    valsT = valsT_ref[:]                                # (C_PAD, blk)
+    sib = sib_ref[:].astype(jnp.int32)                  # (1, blk)
+    blk = bins_blk.shape[0]
+    iota_b = jax.lax.broadcasted_iota(jnp.int32, (blk, ftile, num_bins), 2)
+    oh = (bins_blk[:, :, None] == iota_b).astype(oh_dtype)
+    oh = oh.reshape(blk, ftile * num_bins)
+    iota_s = jax.lax.broadcasted_iota(jnp.int32, (num_sibs, blk), 0)
+    sib_oh = (iota_s == sib).astype(valsT.dtype)        # (W, blk)
+    # A[(l, c), r] = vals[c, r] * (sib[r] == l)  -> (W*C_PAD, blk)
+    A = (sib_oh[:, None, :] * valsT[None, :, :]).reshape(
+        num_sibs * C_PAD, blk)
+    out_ref[:, :] += jax.lax.dot_general(
+        A.astype(oh_dtype) if oh_dtype != A.dtype else A,
+        oh, dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=acc_dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("num_bins", "rows_block", "dtype", "interpret"))
+def histogram_flat(
+    bins: jnp.ndarray,   # (N, F) uint8/uint16
+    vals: jnp.ndarray,   # (N, 3) f32 masked (grad, hess, count) — or int8
+    *,
+    num_bins: int,
+    rows_block: int = 0,
+    dtype: str = "f32",  # one-hot/compute dtype: f32 | bf16 | int8
+    interpret: bool = False,
+) -> jnp.ndarray:        # (F, num_bins, 3) f32 (int32 for int8)
+    """Single-leaf flat-matmul histogram."""
+    n, f = bins.shape
+    oh_dtype, acc_dtype, isz = _DTYPES[dtype]
+    rows_block, ftile = _pick_tiles(f, num_bins, isz, rows_block)
+    bins, valsT, _, nblocks, nftiles = _prep(bins, vals, rows_block, ftile)
+    out = pl.pallas_call(
+        functools.partial(_flat_kernel, num_bins=num_bins, ftile=ftile,
+                          oh_dtype=oh_dtype, acc_dtype=acc_dtype),
+        grid=(nftiles, nblocks),
+        in_specs=[
+            pl.BlockSpec((rows_block, ftile), lambda j, i: (i, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((C_PAD, rows_block), lambda j, i: (0, i),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((C_PAD, ftile * num_bins),
+                               lambda j, i: (0, j), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct(
+            (C_PAD, nftiles * ftile * num_bins), acc_dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary")),
+        interpret=interpret,
+    )(bins, valsT)
+    # (C_PAD, Fpad*B) -> (F, B, 3), dropping phantom feature blocks
+    out = out.reshape(C_PAD, nftiles * ftile, num_bins)[:3, :f]
+    return jnp.transpose(out, (1, 2, 0))
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("num_bins", "num_sibs", "rows_block", "dtype",
+                     "interpret"))
+def histogram_flat_sib(
+    bins: jnp.ndarray,   # (S, F) gathered rows (padded; pad rows sib=-1)
+    vals: jnp.ndarray,   # (S, 3)
+    sib: jnp.ndarray,    # (S,) i32 sibling slot in [0, num_sibs); -1 = pad
+    *,
+    num_bins: int,
+    num_sibs: int,
+    rows_block: int = 0,
+    dtype: str = "f32",
+    interpret: bool = False,
+) -> jnp.ndarray:        # (num_sibs, F, num_bins, 3)
+    """Multi-leaf wave histogram: all siblings in ONE kernel, M = sibs x
+    channels (up to 128)."""
+    n, f = bins.shape
+    oh_dtype, acc_dtype, isz = _DTYPES[dtype]
+    rows_block, ftile = _pick_tiles(f, num_bins, isz, rows_block,
+                                    num_sibs=num_sibs)
+    bins, valsT, sib2, nblocks, nftiles = _prep(bins, vals, rows_block,
+                                                ftile, sib)
+    out = pl.pallas_call(
+        functools.partial(_flat_sib_kernel, num_bins=num_bins, ftile=ftile,
+                          num_sibs=num_sibs, oh_dtype=oh_dtype,
+                          acc_dtype=acc_dtype),
+        grid=(nftiles, nblocks),
+        in_specs=[
+            pl.BlockSpec((rows_block, ftile), lambda j, i: (i, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((C_PAD, rows_block), lambda j, i: (0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, rows_block), lambda j, i: (0, i),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((num_sibs * C_PAD, ftile * num_bins),
+                               lambda j, i: (0, j), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct(
+            (num_sibs * C_PAD, nftiles * ftile * num_bins), acc_dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary")),
+        interpret=interpret,
+    )(bins, valsT, sib2)
+    # (W*C_PAD, Fpad*B) -> (W, F, B, 3), dropping phantom feature blocks
+    out = out.reshape(num_sibs, C_PAD, nftiles * ftile, num_bins)[:, :3, :f]
+    return jnp.transpose(out, (0, 2, 3, 1))
+
+
+# Backwards-compatible name: the per-feature-loop kernel is superseded by the
+# flat formulation; histogram_pallas now routes to it.
 @functools.partial(jax.jit,
                    static_argnames=("num_bins", "rows_block", "interpret"))
 def histogram_pallas(
-    bins: jnp.ndarray,   # (N, F) uint8/uint16
-    vals: jnp.ndarray,   # (N, 3) f32 masked (grad, hess, count)
+    bins: jnp.ndarray,
+    vals: jnp.ndarray,
     *,
     num_bins: int,
-    rows_block: int = 2048,
+    rows_block: int = 0,
     interpret: bool = False,
-) -> jnp.ndarray:        # (F, num_bins, 3) f32
-    n, f = bins.shape
-    pad = (-n) % rows_block
-    if pad:
-        bins = jnp.pad(bins, ((0, pad), (0, 0)))
-        vals = jnp.pad(vals, ((0, pad), (0, 0)))
-    ntot = n + pad
-    vals8 = jnp.pad(vals.astype(jnp.float32), ((0, 0), (0, C_PAD - 3)))
-    nblocks = ntot // rows_block
-
-    out = pl.pallas_call(
-        functools.partial(_hist_kernel, num_bins=num_bins, num_features=f),
-        grid=(nblocks,),
-        in_specs=[
-            pl.BlockSpec((rows_block, f), lambda i: (i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((rows_block, C_PAD), lambda i: (i, 0),
-                         memory_space=pltpu.VMEM),
-        ],
-        out_specs=pl.BlockSpec((f, C_PAD, num_bins), lambda i: (0, 0, 0),
-                               memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((f, C_PAD, num_bins), jnp.float32),
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("arbitrary",),
-        ),
-        interpret=interpret,
-    )(bins, vals8)
-    return jnp.transpose(out[:, :3, :], (0, 2, 1))  # (F, B, 3)
+) -> jnp.ndarray:
+    return histogram_flat(bins, vals, num_bins=num_bins,
+                          rows_block=rows_block, dtype="f32",
+                          interpret=interpret)

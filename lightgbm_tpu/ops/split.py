@@ -384,6 +384,10 @@ def _merge_sorted_categorical(best, G, H, C, parent_grad, parent_hess,
     s_gain, s_mask, s_gl, s_hl, s_cl = _sorted_categorical(
         G, H, C, parent_grad, parent_hess, parent_count, parent_output,
         in_feature, cfg, min_count, rand_bins)
+    # NOTE: the parent gain shift deliberately uses PLAIN lambda_l2 even
+    # though the sorted children use l2+cat_l2 — the reference computes
+    # gain_shift (feature_histogram.cpp:161-173) before `l2 += cat_l2`
+    # (:250), and comments that this asymmetry is intentional.
     s_gain = s_gain - parent_gain
     s_gain = jnp.where(s_gain > cfg.min_gain_to_split + _EPS, s_gain, -jnp.inf)
     if penalty_col is not None:
