@@ -2,8 +2,8 @@
 
 Reference: ``python-package/lightgbm/basic.py`` (``Dataset:1764``, ``Booster:3586``).
 There is no ctypes boundary here — the "C API" equivalent is the in-process
-:class:`~lightgbm_tpu.models.gbdt.GBDT` driver whose compute runs as XLA programs;
-a C-ABI shim for external bindings lives in ``capi/``.
+:class:`~lightgbm_tpu.models.gbdt.GBDT` driver whose compute runs as XLA
+programs.
 """
 
 from __future__ import annotations
@@ -43,6 +43,16 @@ class Dataset:
         params: Optional[Dict[str, Any]] = None,
         free_raw_data: bool = False,
     ):
+        self._binary_path = None
+        if isinstance(data, str):
+            # Binary dataset cache (reference Dataset(path) +
+            # CheckCanLoadFromBin, dataset_loader.cpp:1466).
+            from .dataset import is_binary_dataset_file
+            if not is_binary_dataset_file(data):
+                raise ValueError(f"{data!r} is not a lightgbm_tpu binary "
+                                 "dataset file (see Dataset.save_binary)")
+            self._binary_path = data
+            data = np.zeros((0, 0))
         self.data = _as_2d(data)
         self.label = None if label is None else np.asarray(label)
         self.reference = reference
@@ -56,6 +66,11 @@ class Dataset:
         self._train_data: Optional[TrainData] = None
 
     def construct(self, params: Optional[Dict[str, Any]] = None) -> "TrainData":
+        if self._train_data is None and self._binary_path is not None:
+            self._train_data = TrainData.load_binary(self._binary_path)
+            self.label = self._train_data.label
+            self.weight = self._train_data.weight
+            self.group = self._train_data.group
         if self._train_data is None:
             merged = dict(self.params)
             merged.update(params or {})
@@ -124,6 +139,12 @@ class Dataset:
     def set_group(self, group):
         self.group = None if group is None else np.asarray(group, np.int64)
         self._train_data = None
+        return self
+
+    def save_binary(self, filename: str) -> "Dataset":
+        """Save the constructed dataset to a binary cache file (reference
+        ``Dataset.save_binary`` -> ``LGBM_DatasetSaveBinary``)."""
+        self.construct().save_binary(filename)
         return self
 
     def create_valid(self, data, label=None, weight=None, group=None,
@@ -223,9 +244,12 @@ class Booster:
             from .explain import predict_leaf_index, predict_contrib
             fn = predict_leaf_index if pred_leaf else predict_contrib
             return fn(self._gbdt, _as_2d(data), start_iteration, num_iteration)
+        es_kwargs = {kk: vv for kk, vv in kwargs.items()
+                     if kk.startswith("pred_early_stop")}
         return self._gbdt.predict(_as_2d(data), raw_score=raw_score,
                                   num_iteration=num_iteration,
-                                  start_iteration=start_iteration)
+                                  start_iteration=start_iteration,
+                                  **es_kwargs)
 
     # -------------------------------------------------------------------- misc
     @property

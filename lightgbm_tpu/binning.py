@@ -302,3 +302,59 @@ class BinnedData:
         for j, m in enumerate(self.mappers):
             out[:, j] = m.value_to_bin(X[:, j]).astype(self.bins.dtype)
         return out
+
+
+# ---------------------------------------------------------------- binary cache
+def mappers_to_arrays(mappers: List[BinMapper]) -> dict:
+    """Flatten per-feature mappers into fixed arrays for the binary dataset
+    cache (reference ``Dataset::SaveBinaryFile``, ``dataset_loader.cpp:417``
+    reload path)."""
+    f = len(mappers)
+    num_bins = np.array([m.num_bins for m in mappers], np.int32)
+    missing = np.array([m.missing_type for m in mappers], np.int32)
+    is_cat = np.array([m.is_categorical for m in mappers], bool)
+    trivial = np.array([m.is_trivial for m in mappers], bool)
+    default_bin = np.array([m.default_bin for m in mappers], np.int32)
+    ub_flat, ub_off = [], [0]
+    cat_flat, cat_off = [], [0]
+    for m in mappers:
+        ub = m.upper_bounds if m.upper_bounds is not None else np.zeros(0)
+        ub_flat.append(np.asarray(ub, np.float64))
+        ub_off.append(ub_off[-1] + len(ub))
+        cats = m.categories if m.categories is not None else np.zeros(0, np.int64)
+        cat_flat.append(np.asarray(cats, np.int64))
+        cat_off.append(cat_off[-1] + len(cats))
+    return {
+        "mapper_num_bins": num_bins, "mapper_missing": missing,
+        "mapper_is_cat": is_cat, "mapper_trivial": trivial,
+        "mapper_default_bin": default_bin,
+        "mapper_ub": np.concatenate(ub_flat) if f else np.zeros(0),
+        "mapper_ub_off": np.array(ub_off, np.int64),
+        "mapper_cats": np.concatenate(cat_flat) if f else np.zeros(0, np.int64),
+        "mapper_cat_off": np.array(cat_off, np.int64),
+    }
+
+
+def mappers_from_arrays(d: dict) -> List[BinMapper]:
+    # Materialize members once: NpzFile.__getitem__ decompresses the whole
+    # array on every access, which would make this loop O(F^2).
+    d = {k: np.asarray(d[k]) for k in (
+        "mapper_num_bins", "mapper_missing", "mapper_is_cat",
+        "mapper_trivial", "mapper_default_bin", "mapper_ub",
+        "mapper_ub_off", "mapper_cats", "mapper_cat_off")}
+    f = len(d["mapper_num_bins"])
+    out: List[BinMapper] = []
+    for j in range(f):
+        is_cat = bool(d["mapper_is_cat"][j])
+        lo, hi = int(d["mapper_ub_off"][j]), int(d["mapper_ub_off"][j + 1])
+        clo, chi = int(d["mapper_cat_off"][j]), int(d["mapper_cat_off"][j + 1])
+        out.append(BinMapper(
+            num_bins=int(d["mapper_num_bins"][j]),
+            missing_type=int(d["mapper_missing"][j]),
+            is_categorical=is_cat,
+            upper_bounds=None if is_cat else d["mapper_ub"][lo:hi],
+            categories=d["mapper_cats"][clo:chi] if is_cat else None,
+            is_trivial=bool(d["mapper_trivial"][j]),
+            default_bin=int(d["mapper_default_bin"][j]),
+        ))
+    return out

@@ -45,12 +45,27 @@ def run(argv: List[str]) -> int:
     params = parse_cli_params(argv)
     task = params.pop("task", "train")
     cfg = Config(dict(params))
-    if task == "train":
+    if task in ("train", "save_binary"):
         data_path = params.pop("data", None)
         if not data_path:
-            Log.fatal("task=train requires data=<file>")
-        X, y, w, g = load_data_file(data_path, cfg.label_column, cfg.header)
-        ds = Dataset(X, label=y, weight=w, group=g, params=params)
+            Log.fatal(f"task={task} requires data=<file>")
+        from .dataset import is_binary_dataset_file
+        if is_binary_dataset_file(data_path):
+            ds = Dataset(data_path, params=params)
+        else:
+            X, y, w, g = load_data_file(data_path, cfg.label_column,
+                                        cfg.header)
+            ds = Dataset(X, label=y, weight=w, group=g, params=params)
+        if task == "save_binary" or cfg.save_binary:
+            # reference application task=save_binary / save_binary=true:
+            # write "<data>.bin" next to the input and, for the standalone
+            # task, stop there.
+            out_bin = data_path + ".bin"
+            ds.construct(params)
+            ds.save_binary(out_bin)
+            Log.info(f"Saved binary dataset to {out_bin}")
+            if task == "save_binary":
+                return 0
         valid_sets, valid_names = [], []
         valid = params.pop("valid", params.pop("valid_data", ""))
         for i, vp in enumerate(p for p in valid.split(",") if p):
@@ -75,7 +90,11 @@ def run(argv: List[str]) -> int:
             Log.fatal("task=predict requires data=<file>")
         bst = Booster(model_file=model_path)
         X, _, _, _ = load_data_file(data_path, cfg.label_column, cfg.header)
-        pred = bst.predict(X, raw_score=cfg.predict_raw_score)
+        pred = bst.predict(
+            X, raw_score=cfg.predict_raw_score,
+            pred_early_stop=cfg.pred_early_stop,
+            pred_early_stop_freq=cfg.pred_early_stop_freq,
+            pred_early_stop_margin=cfg.pred_early_stop_margin)
         out = params.get("output_result", "LightGBM_predict_result.txt")
         np.savetxt(out, np.atleast_2d(pred.T).T, fmt="%.9g")
         Log.info(f"Finished prediction; results saved to {out}")

@@ -11,6 +11,7 @@ predicate directly.  Metadata (label/weight/group/init_score) mirrors
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import List, Optional, Sequence
 
 import jax
@@ -117,3 +118,72 @@ class TrainData:
         if self.group is None:
             return None
         return np.concatenate([[0], np.cumsum(self.group)])
+
+    # ------------------------------------------------------------ binary cache
+    def save_binary(self, path: str) -> None:
+        """Save the binned dataset + metadata (reference ``save_binary`` /
+        ``Dataset::SaveBinaryFile`` — the fast-reload path that skips text
+        parsing and bin construction)."""
+        from .binning import mappers_to_arrays
+        b = self.binned
+        arrs = dict(
+            magic=np.asarray([0x4C47424D]),  # 'LGBM'
+            bins=b.bins, label=self.label,
+            upper_bounds_padded=b.upper_bounds_padded,
+            nan_bins=b.nan_bins,
+            num_bins_per_feature=b.num_bins_per_feature,
+            is_categorical=b.is_categorical,
+            max_num_bins=np.asarray([b.max_num_bins]),
+            **mappers_to_arrays(b.mappers),
+        )
+        if self.weight is not None:
+            arrs["weight"] = self.weight
+        if self.group is not None:
+            arrs["group"] = self.group
+        if self.init_score is not None:
+            arrs["init_score"] = self.init_score
+        if self.monotone_constraints is not None:
+            arrs["monotone"] = self.monotone_constraints
+        if self.feature_names:
+            arrs["feature_names"] = np.asarray(self.feature_names)
+        # write through a handle so numpy keeps the exact filename (no
+        # forced .npz suffix)
+        with open(path, "wb") as fh:
+            np.savez_compressed(fh, **arrs)
+
+    @classmethod
+    def load_binary(cls, path: str) -> "TrainData":
+        """Load a dataset saved by :meth:`save_binary`."""
+        from .binning import BinnedData, mappers_from_arrays
+        with np.load(path, allow_pickle=False) as d:
+            mappers = mappers_from_arrays(d)
+            binned = BinnedData(
+                bins=d["bins"], mappers=mappers,
+                max_num_bins=int(d["max_num_bins"][0]),
+                upper_bounds_padded=d["upper_bounds_padded"],
+                nan_bins=d["nan_bins"],
+                num_bins_per_feature=d["num_bins_per_feature"],
+                is_categorical=d["is_categorical"],
+            )
+            names = (list(map(str, d["feature_names"]))
+                     if "feature_names" in d else None)
+            return cls(
+                binned=binned,
+                label=d["label"],
+                weight=d["weight"] if "weight" in d else None,
+                group=d["group"] if "group" in d else None,
+                init_score=d["init_score"] if "init_score" in d else None,
+                feature_names=names,
+                monotone_constraints=d["monotone"] if "monotone" in d else None,
+            )
+
+
+def is_binary_dataset_file(path) -> bool:
+    """reference ``DatasetLoader::CheckCanLoadFromBin``."""
+    if not isinstance(path, str) or not os.path.exists(path):
+        return False
+    try:
+        with np.load(path, allow_pickle=False) as d:
+            return "magic" in d and int(d["magic"][0]) == 0x4C47424D
+    except Exception:  # noqa: BLE001
+        return False

@@ -59,6 +59,7 @@ def _split_config(cfg: Config, train: Optional[TrainData] = None) -> SplitConfig
         max_cat_to_onehot=cfg.max_cat_to_onehot,
         min_data_per_group=cfg.min_data_per_group,
         path_smooth=cfg.path_smooth,
+        monotone_penalty=cfg.monotone_penalty,
         extra_trees=cfg.extra_trees,
         use_cegb=bool(cfg.cegb_penalty_split > 0.0
                       or cfg.cegb_penalty_feature_coupled
@@ -130,6 +131,25 @@ class GBDT:
         # (see parallel/mesh.py; reference §2.9 data/feature/voting learners).
         from ..parallel.mesh import mesh_for_tree_learner, shard_arrays
         self.mesh = mesh_for_tree_learner(cfg.tree_learner)
+        self.feature_sampler = FeatureSampler(cfg, train.num_features)
+        if (train.monotone_constraints is not None
+                and np.any(train.monotone_constraints != 0)
+                and cfg.monotone_constraints_method not in ("basic",)):
+            raise ValueError(
+                f"monotone_constraints_method="
+                f"{cfg.monotone_constraints_method} is not supported; only "
+                f"'basic' (with monotone_penalty) is implemented")
+        # Storage-layout knobs with no TPU analog: the dense (N, F) uint8 HBM
+        # layout has no sparse bins, no EFB bundles and no two-pass text
+        # loading, so these parse but cannot change behavior — say so loudly
+        # instead of silently ignoring them.
+        from ..utils.log import Log
+        for pname in ("is_enable_sparse", "enable_bundle", "two_round"):
+            if pname in cfg.raw_params:
+                Log.warning(
+                    f"{pname} has no effect on the TPU build: bins are "
+                    "stored as one dense (rows, features) device array "
+                    "(see binning.py)")
         hist_impl = cfg.tpu_histogram_impl
         if hist_impl == "auto" and self.mesh is not None:
             # GSPMD partitions the einsum path across the mesh; the pallas
@@ -145,6 +165,7 @@ class GBDT:
             gather_rows=self.mesh is None,
             leaf_batch=cfg.tpu_leaf_batch,
             feature_fraction_bynode=cfg.feature_fraction_bynode,
+            interaction_groups=self.feature_sampler.interaction_groups,
             quantized=cfg.use_quantized_grad,
             num_grad_quant_bins=cfg.num_grad_quant_bins,
             stochastic_rounding=cfg.stochastic_rounding,
@@ -165,7 +186,6 @@ class GBDT:
             self.bins_dev = shard_arrays(self.mesh, self.bins_dev)
         self.sample_strategy = SampleStrategy(
             cfg, train.num_data, train.label, train.query_boundaries())
-        self.feature_sampler = FeatureSampler(cfg, train.num_features)
 
         # CEGB (reference cost_effective_gradient_boosting.hpp): coupled
         # penalties apply on a feature's FIRST use in the model, so the host
@@ -609,7 +629,21 @@ class GBDT:
 
     def predict(self, X: np.ndarray, raw_score: bool = False,
                 num_iteration: Optional[int] = None,
-                start_iteration: int = 0) -> np.ndarray:
+                start_iteration: int = 0, **kwargs) -> np.ndarray:
+        if kwargs.get("pred_early_stop"):
+            # Margin-based early exit runs on the host raw-threshold trees
+            # (reference Predictor + prediction_early_stop.cpp); the
+            # serialized mirror is cached and rebuilt only when trees were
+            # added/removed since.
+            from ..serialization import load_model_string, model_to_string
+            cache = getattr(self, "_loaded_mirror", None)
+            if cache is None or cache[0] != self.num_trees:
+                cache = (self.num_trees,
+                         load_model_string(model_to_string(self)))
+                self._loaded_mirror = cache
+            return cache[1].predict(X, raw_score=raw_score,
+                                    num_iteration=num_iteration,
+                                    start_iteration=start_iteration, **kwargs)
         raw = self.predict_raw(X, num_iteration, start_iteration)
         if raw_score or self.objective is None:
             return raw

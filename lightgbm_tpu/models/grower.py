@@ -32,6 +32,7 @@ from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..ops.histogram import histogram_from_vals, histogram_sib_from_vals
 from ..ops.split import (BestSplit, SplitConfig, best_split, leaf_output,
@@ -53,6 +54,11 @@ class GrowerConfig:
     # feature_fraction_bynode); per-tree fraction is handled by the caller's
     # feature_mask.
     feature_fraction_bynode: float = 1.0
+    # Interaction constraints (reference ColSampler::GetByNode,
+    # col_sampler.hpp:92-111): tuple of tuples of feature ids.  A node may
+    # split only on features on its branch plus any group CONTAINING the
+    # whole branch feature set.
+    interaction_groups: Optional[Tuple[Tuple[int, ...], ...]] = None
     # Permutation layout on/off (see module docstring).  Disabled under a
     # device mesh: dynamic_slice over globally-grouped rows would destroy the
     # row-sharding locality the distributed path relies on.
@@ -124,6 +130,8 @@ class _GrowState(NamedTuple):
     best_hl: jnp.ndarray
     best_cl: jnp.ndarray
     leaf_out: jnp.ndarray        # (L,) f32 leaf output (path-smoothed chain)
+    leaf_lo: jnp.ndarray         # (L,) f32 monotone lower output bound
+    leaf_hi: jnp.ndarray         # (L,) f32 monotone upper output bound
     feat_used: jnp.ndarray       # (F,) bool — features split on so far (CEGB)
     leaf_path: jnp.ndarray       # (L, F) bool — features on each leaf's path
     rng: jnp.ndarray             # (2,) u32 PRNG key (extra_trees / bynode)
@@ -166,6 +174,24 @@ def make_grower(cfg: GrowerConfig):
     use_rand = cfg.split.extra_trees
     use_bynode = cfg.feature_fraction_bynode < 1.0
     need_key = use_rand or use_bynode
+    use_groups = bool(cfg.interaction_groups)
+    track_path = cfg.split.use_cegb or use_groups
+
+    def _groups_matrix(f):
+        gm = np.zeros((len(cfg.interaction_groups), f), bool)
+        for gi, grp in enumerate(cfg.interaction_groups):
+            for feat in grp:
+                if 0 <= feat < f:
+                    gm[gi, feat] = True
+        return jnp.asarray(gm)
+
+    def _allowed_for_paths(pathk, groups_mat):
+        """(k, F) allowed-feature masks per branch (reference
+        ColSampler::GetByNode): branch features plus every group containing
+        the whole branch set; an empty branch allows all groups' union."""
+        ok = ~jnp.any(pathk[:, None, :] & ~groups_mat[None, :, :], axis=2)
+        allowed = jnp.any(ok[:, :, None] & groups_mat[None, :, :], axis=1)
+        return pathk | allowed
 
     def _node_inputs(key, feature_mask, nbpf):
         """Per-node (fmask, rand_bins): extra_trees draws ONE random
@@ -186,17 +212,22 @@ def make_grower(cfg: GrowerConfig):
         return fmask, rand_bins
 
     def _best_for(hist, pg, ph, pc, meta, feature_mask, penalty=None,
-                  parent_out=None, key=None):
+                  parent_out=None, key=None, path=None, groups_mat=None,
+                  out_lo=None, out_hi=None, leaf_depth=None):
         nbpf, nan_bins, is_cat, monotone = meta
         rand_bins = None
         if need_key and key is not None:
             feature_mask, rand_bins = _node_inputs(key, feature_mask, nbpf)
+        if use_groups and path is not None and groups_mat is not None:
+            feature_mask = feature_mask & _allowed_for_paths(
+                path[None, :], groups_mat)[0]
         return best_split(
             hist, pg, ph, pc,
             num_bins_per_feature=nbpf, nan_bins=nan_bins, is_categorical=is_cat,
             monotone=monotone, feature_mask=feature_mask, cfg=cfg.split,
             gain_penalty=penalty, parent_output=parent_out,
-            rand_bins=rand_bins,
+            rand_bins=rand_bins, out_lo=out_lo, out_hi=out_hi,
+            leaf_depth=leaf_depth,
         )
 
     def _batch_node_inputs(key, feature_mask, nbpf, k):
@@ -218,7 +249,9 @@ def make_grower(cfg: GrowerConfig):
         return fmaskk, randk
 
     def _best_for_batch(histk, pgk, phk, pck, meta, feature_mask,
-                        penaltyk=None, parent_outk=None, key=None):
+                        penaltyk=None, parent_outk=None, key=None,
+                        pathk=None, groups_mat=None, boundsk=None,
+                        depthk=None):
         """All k children's split searches in one vmapped program — one
         kernel set per wave instead of per child."""
         nbpf, nan_bins, is_cat, monotone = meta
@@ -226,8 +259,19 @@ def make_grower(cfg: GrowerConfig):
         if parent_outk is None:
             parent_outk = jnp.zeros(k, jnp.float32)
         fmaskk, randk = _batch_node_inputs(key, feature_mask, nbpf, k)
+        if use_groups and pathk is not None and groups_mat is not None:
+            fmaskk = fmaskk & _allowed_for_paths(pathk, groups_mat)
+        if boundsk is None:
+            lok = hik = jnp.zeros(k, jnp.float32)
+            use_b = False
+        else:
+            lok, hik = boundsk
+            use_b = True
+        if depthk is None:
+            depthk = jnp.zeros(k, jnp.int32)
 
-        def one(hist, pg, ph, pc, penalty, pout, fmask, rand_bins):
+        def one(hist, pg, ph, pc, penalty, pout, fmask, rand_bins, lo, hi,
+                dep):
             return best_split(
                 hist, pg, ph, pc,
                 num_bins_per_feature=nbpf, nan_bins=nan_bins,
@@ -235,25 +279,30 @@ def make_grower(cfg: GrowerConfig):
                 feature_mask=fmask, cfg=cfg.split,
                 gain_penalty=penalty, parent_output=pout,
                 rand_bins=rand_bins,
+                out_lo=lo if use_b else None,
+                out_hi=hi if use_b else None,
+                leaf_depth=dep,
             )
 
         if penaltyk is None and randk is None:
             return jax.vmap(
-                lambda h, g, hh, c, po, fm: one(h, g, hh, c, None, po, fm,
-                                                None))(
-                histk, pgk, phk, pck, parent_outk, fmaskk)
+                lambda h, g, hh, c, po, fm, lo, hi, dep: one(
+                    h, g, hh, c, None, po, fm, None, lo, hi, dep))(
+                histk, pgk, phk, pck, parent_outk, fmaskk, lok, hik, depthk)
         if penaltyk is None:
             return jax.vmap(
-                lambda h, g, hh, c, po, fm, rb: one(h, g, hh, c, None, po,
-                                                    fm, rb))(
-                histk, pgk, phk, pck, parent_outk, fmaskk, randk)
+                lambda h, g, hh, c, po, fm, rb, lo, hi, dep: one(
+                    h, g, hh, c, None, po, fm, rb, lo, hi, dep))(
+                histk, pgk, phk, pck, parent_outk, fmaskk, randk, lok, hik,
+                depthk)
         if randk is None:
             return jax.vmap(
-                lambda h, g, hh, c, pe, po, fm: one(h, g, hh, c, pe, po, fm,
-                                                    None))(
-                histk, pgk, phk, pck, penaltyk, parent_outk, fmaskk)
+                lambda h, g, hh, c, pe, po, fm, lo, hi, dep: one(
+                    h, g, hh, c, pe, po, fm, None, lo, hi, dep))(
+                histk, pgk, phk, pck, penaltyk, parent_outk, fmaskk, lok,
+                hik, depthk)
         return jax.vmap(one)(histk, pgk, phk, pck, penaltyk, parent_outk,
-                             fmaskk, randk)
+                             fmaskk, randk, lok, hik, depthk)
 
     _best_for_pair = _best_for_batch
 
@@ -311,6 +360,8 @@ def make_grower(cfg: GrowerConfig):
             best_cl=jnp.zeros(L, jnp.float32),
             leaf_out=jnp.zeros(L, jnp.float32).at[0].set(
                 leaf_output(root_g, root_h, cfg.split)),
+            leaf_lo=jnp.full(L, -jnp.inf, jnp.float32),
+            leaf_hi=jnp.full(L, jnp.inf, jnp.float32),
             feat_used=jnp.zeros(f, bool),
             leaf_path=jnp.zeros((L, f), bool),
             rng=(key if key is not None
@@ -357,7 +408,7 @@ def make_grower(cfg: GrowerConfig):
 
     def _children_updates(st, leaf, new_leaf, hist_left, hist_right,
                           gl, hl, cl, gr, hr, cr, meta, feature_mask,
-                          cegb=None):
+                          cegb=None, groups_mat=None):
         """Store child stats + their best splits (both children batched into
         single 2-row scatters to minimize kernel count in the hot loop)."""
         depth = st.leaf_depth[leaf] + 1
@@ -366,22 +417,44 @@ def make_grower(cfg: GrowerConfig):
         parent_out = st.leaf_out[leaf]
         out_l = smoothed_output(gl, hl, cl, parent_out, cfg.split)
         out_r = smoothed_output(gr, hr, cr, parent_out, cfg.split)
+        bounds2 = None
+        depth2 = jnp.stack([st.leaf_depth[leaf] + 1,
+                            st.leaf_depth[leaf] + 1])
+        if cfg.split.has_monotone:
+            # Basic monotone bounds (reference BasicLeafConstraints::Update,
+            # monotone_constraints.hpp:487): a numerical split on a monotone
+            # feature caps both children at the child-output midpoint;
+            # outputs are always clipped to the leaf's inherited bounds.
+            plo, phi = st.leaf_lo[leaf], st.leaf_hi[leaf]
+            out_l = jnp.clip(out_l, plo, phi)
+            out_r = jnp.clip(out_r, plo, phi)
+            mono_t = meta[3][st.best_feature[leaf]]
+            is_num = ~st.best_is_cat[leaf]
+            mid = (out_l + out_r) / 2.0
+            lo_l = jnp.where((mono_t < 0) & is_num, jnp.maximum(plo, mid), plo)
+            hi_l = jnp.where((mono_t > 0) & is_num, jnp.minimum(phi, mid), phi)
+            lo_r = jnp.where((mono_t > 0) & is_num, jnp.maximum(plo, mid), plo)
+            hi_r = jnp.where((mono_t < 0) & is_num, jnp.minimum(phi, mid), phi)
+            st = st._replace(
+                leaf_lo=st.leaf_lo.at[pair].set(jnp.stack([lo_l, lo_r])),
+                leaf_hi=st.leaf_hi.at[pair].set(jnp.stack([hi_l, hi_r])))
+            bounds2 = (jnp.stack([lo_l, lo_r]), jnp.stack([hi_l, hi_r]))
         node_key = None
         if need_key:
             rng, node_key = jax.random.split(st.rng)
             st = st._replace(rng=rng)
         penalty2 = None
-        if cfg.split.use_cegb and cegb is not None:
-            coupled, lazy = cegb
+        path2 = None
+        if track_path:
             feat = st.best_feature[leaf]
             fhot = jnp.arange(st.feat_used.shape[0]) == feat
-            feat_used = st.feat_used | fhot
             child_path = st.leaf_path[leaf] | fhot
-            st = st._replace(
-                feat_used=feat_used,
-                leaf_path=st.leaf_path.at[pair].set(
-                    jnp.stack([child_path, child_path])),
-            )
+            path2 = jnp.stack([child_path, child_path])
+            st = st._replace(leaf_path=st.leaf_path.at[pair].set(path2))
+        if cfg.split.use_cegb and cegb is not None:
+            coupled, lazy = cegb
+            feat_used = st.feat_used | fhot
+            st = st._replace(feat_used=feat_used)
             penalty2 = jnp.stack([
                 _cegb_penalty(cl, feat_used, child_path, coupled, lazy),
                 _cegb_penalty(cr, feat_used, child_path, coupled, lazy),
@@ -405,7 +478,8 @@ def make_grower(cfg: GrowerConfig):
         depth_ok = jnp.asarray(True) if cfg.max_depth <= 0 \
             else depth < cfg.max_depth
         bs2 = _best_for_pair(hist2, g2, h2, c2, meta, feature_mask, penalty2,
-                             jnp.stack([out_l, out_r]), node_key)
+                             jnp.stack([out_l, out_r]), node_key,
+                             path2, groups_mat, bounds2, depth2)
         gain2 = jnp.where(depth_ok, bs2.gain, _NEG_INF)
         return st._replace(
             best_gain=st.best_gain.at[pair].set(gain2),
@@ -450,7 +524,7 @@ def make_grower(cfg: GrowerConfig):
             return perm, nl_phys
         return branch
 
-    def _root_best(state, meta, feature_mask, root_pen):
+    def _root_best(state, meta, feature_mask, root_pen, groups_mat=None):
         """Root split search (shared by both layouts)."""
         key = None
         if need_key:
@@ -458,10 +532,15 @@ def make_grower(cfg: GrowerConfig):
             state = state._replace(rng=rng)
         bs = _best_for(state.leaf_hist[0], state.leaf_sum_grad[0],
                        state.leaf_sum_hess[0], state.leaf_count[0], meta,
-                       feature_mask, root_pen, state.leaf_out[0], key)
+                       feature_mask, root_pen, state.leaf_out[0], key,
+                       state.leaf_path[0], groups_mat,
+                       state.leaf_lo[0] if cfg.split.has_monotone else None,
+                       state.leaf_hi[0] if cfg.split.has_monotone else None,
+                       state.leaf_depth[0])
         return state, bs
 
-    def _perm_setup(bins, vals, scale3, meta, feature_mask, cegb, key):
+    def _perm_setup(bins, vals, scale3, meta, feature_mask, cegb, key,
+                    groups_mat=None):
         """Shared permutation-layout prologue: padded arrays, buckets, root
         histogram/state/best-split."""
         n, f = bins.shape
@@ -483,7 +562,8 @@ def make_grower(cfg: GrowerConfig):
         if cfg.split.use_cegb and cegb is not None:
             root_pen = _cegb_penalty(root_c, state.feat_used,
                                      state.leaf_path[0], *cegb)
-        state, root_bs = _root_best(state, meta, feature_mask, root_pen)
+        state, root_bs = _root_best(state, meta, feature_mask, root_pen,
+                                    groups_mat)
         state = _store_best(state, jnp.asarray(0), root_bs, jnp.asarray(True))
         return state, bins_pad, vals_pad, buckets, buckets_arr, max_bucket
 
@@ -506,9 +586,10 @@ def make_grower(cfg: GrowerConfig):
         """Permutation-layout growth (single device)."""
         n, f = bins.shape
         nan_bins = meta[1]
+        groups_mat = _groups_matrix(f) if use_groups else None
         (state, bins_pad, vals_pad, buckets, buckets_arr,
          max_bucket) = _perm_setup(bins, vals, scale3, meta, feature_mask,
-                                   cegb, key)
+                                   cegb, key, groups_mat)
 
         def _make_hist_branch(S):
             """Histogram of a contiguous child range (the smaller sibling —
@@ -573,9 +654,9 @@ def make_grower(cfg: GrowerConfig):
                 leaf_rows=st.leaf_rows.at[leaf].set(nl_phys)
                                       .at[new_leaf].set(cnt - nl_phys),
             )
-            return _children_updates(st, leaf, new_leaf, hist_left, hist_right,
-                                     gl, hl, cl, gr, hr, cr, meta, feature_mask,
-                                     cegb)
+            return _children_updates(st, leaf, new_leaf, hist_left,
+                                     hist_right, gl, hl, cl, gr, hr, cr,
+                                     meta, feature_mask, cegb, groups_mat)
 
         def cond(st: _GrowState):
             return (st.num_leaves < L) & (jnp.max(st.best_gain) > _NEG_INF)
@@ -597,9 +678,10 @@ def make_grower(cfg: GrowerConfig):
         n, f = bins.shape
         W = min(cfg.leaf_batch, max(L - 1, 1))
         nan_bins = meta[1]
+        groups_mat = _groups_matrix(f) if use_groups else None
         (state, bins_pad, vals_pad, buckets, buckets_arr,
          max_bucket) = _perm_setup(bins, vals, scale3, meta, feature_mask,
-                                   cegb, key)
+                                   cegb, key, groups_mat)
 
         def _make_wave_hist_branch(S):
             """Histogram ALL W smaller siblings from one compacted buffer."""
@@ -690,6 +772,31 @@ def make_grower(cfg: GrowerConfig):
             pout = st.leaf_out[top_l]
             out_l = smoothed_output(gl, hl, cl, pout, cfg.split)
             out_r = smoothed_output(gr, hr, cr, pout, cfg.split)
+            bounds2 = None
+            if cfg.split.has_monotone:
+                plo, phi = st.leaf_lo[top_l], st.leaf_hi[top_l]
+                out_l = jnp.clip(out_l, plo, phi)
+                out_r = jnp.clip(out_r, plo, phi)
+                mono_t = meta[3][feats]
+                is_num = ~scats
+                mid = (out_l + out_r) / 2.0
+                lo_l = jnp.where((mono_t < 0) & is_num,
+                                 jnp.maximum(plo, mid), plo)
+                hi_l = jnp.where((mono_t > 0) & is_num,
+                                 jnp.minimum(phi, mid), phi)
+                lo_r = jnp.where((mono_t > 0) & is_num,
+                                 jnp.maximum(plo, mid), plo)
+                hi_r = jnp.where((mono_t < 0) & is_num,
+                                 jnp.minimum(phi, mid), phi)
+                st = st._replace(
+                    leaf_lo=st.leaf_lo.at[
+                        jnp.concatenate([leaf_j, newleaf_j])].set(
+                        jnp.concatenate([lo_l, lo_r]), mode="drop"),
+                    leaf_hi=st.leaf_hi.at[
+                        jnp.concatenate([leaf_j, newleaf_j])].set(
+                        jnp.concatenate([hi_l, hi_r]), mode="drop"))
+                bounds2 = (jnp.concatenate([lo_l, lo_r]),
+                           jnp.concatenate([hi_l, hi_r]))
 
             # ---- tree updates (batched scatters over W nodes)
             tr = st.tree
@@ -751,18 +858,20 @@ def make_grower(cfg: GrowerConfig):
                     cat2(out_l, out_r), mode="drop"),
             )
 
-            # ---- CEGB bookkeeping + penalties
+            # ---- path tracking (CEGB / interaction constraints)
             penalty2 = None
-            if cfg.split.use_cegb and cegb is not None:
-                coupled, lazy = cegb
+            path2 = None
+            if track_path:
                 fhot = (jnp.arange(f)[None, :] == feats[:, None]) \
                     & active[:, None]                        # (W, F)
-                feat_used = st.feat_used | jnp.any(fhot, axis=0)
                 child_path = st.leaf_path[top_l] | fhot      # (W, F)
+                path2 = cat2(child_path, child_path)
                 st = st._replace(
-                    feat_used=feat_used,
-                    leaf_path=st.leaf_path.at[idx2].set(
-                        cat2(child_path, child_path), mode="drop"))
+                    leaf_path=st.leaf_path.at[idx2].set(path2, mode="drop"))
+            if cfg.split.use_cegb and cegb is not None:
+                coupled, lazy = cegb
+                feat_used = st.feat_used | jnp.any(fhot, axis=0)
+                st = st._replace(feat_used=feat_used)
                 pen_l = jax.vmap(
                     lambda c, p: _cegb_penalty(c, feat_used, p, coupled,
                                                lazy))(cl, child_path)
@@ -779,7 +888,9 @@ def make_grower(cfg: GrowerConfig):
             hist2 = cat2(hist_left, hist_right)
             bs = _best_for_batch(hist2, cat2(gl, gr), cat2(hl, hr),
                                  cat2(cl, cr), meta, feature_mask, penalty2,
-                                 cat2(out_l, out_r), node_key)
+                                 cat2(out_l, out_r), node_key,
+                                 path2, groups_mat, bounds2,
+                                 cat2(depth, depth))
             if cfg.max_depth <= 0:
                 depth_ok = jnp.ones(2 * W, bool)
             else:
@@ -815,6 +926,7 @@ def make_grower(cfg: GrowerConfig):
                    key=None):
         """Mask-layout growth (sharding-friendly; full-N pass per split)."""
         n, f = bins.shape
+        groups_mat = _groups_matrix(f) if use_groups else None
 
         def hist_for(mask):
             # vals already carries bagging weights + in-bag zeroing; the
@@ -836,7 +948,8 @@ def make_grower(cfg: GrowerConfig):
         if cfg.split.use_cegb and cegb is not None:
             root_pen = _cegb_penalty(root_c, state.feat_used,
                                      state.leaf_path[0], *cegb)
-        state, root_bs = _root_best(state, meta, feature_mask, root_pen)
+        state, root_bs = _root_best(state, meta, feature_mask, root_pen,
+                                    groups_mat)
         state = _store_best(state, jnp.asarray(0), root_bs, jnp.asarray(True))
 
         def body(carry):
@@ -877,9 +990,9 @@ def make_grower(cfg: GrowerConfig):
 
             tree = _update_tree(st, leaf, new_leaf, node, pg, ph, pc)
             st = st._replace(tree=tree)
-            st = _children_updates(st, leaf, new_leaf, hist_left, hist_right,
-                                   gl, hl, cl, gr, hr, cr, meta, feature_mask,
-                                   cegb)
+            st = _children_updates(st, leaf, new_leaf, hist_left,
+                                   hist_right, gl, hl, cl, gr, hr, cr,
+                                   meta, feature_mask, cegb, groups_mat)
             return st, row_leaf
 
         def cond(carry):

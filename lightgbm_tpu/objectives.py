@@ -116,15 +116,29 @@ def _renew_by_percentile(residual_fn, alpha: float):
 
 # --------------------------------------------------------------------- regression
 class RegressionL2(ObjectiveFunction):
-    """reference ``RegressionL2loss`` (``regression_objective.hpp:82``)."""
+    """reference ``RegressionL2loss`` (``regression_objective.hpp:82``);
+    ``reg_sqrt`` fits on ``sign(y)*sqrt(|y|)`` and squares predictions back
+    (``regression_objective.hpp:116-123,141-146``)."""
 
     def __init__(self):
         super().__init__(name="regression", is_constant_hessian=True)
+        self.sqrt = False
+
+    def init(self, label, weight, group, cfg):
+        super().init(label, weight, group, cfg)
+        self.sqrt = bool(cfg.reg_sqrt)
+        if self.sqrt:
+            self.label = jnp.sign(self.label) * jnp.sqrt(jnp.abs(self.label))
 
     def get_gradients(self, score):
         grad = score - self.label
         hess = jnp.ones_like(score)
         return self._apply_weight(grad, hess)
+
+    def convert_output(self, score):
+        if self.sqrt:
+            return jnp.sign(score) * score * score
+        return score
 
     def boost_from_score(self, class_id: int = 0) -> float:
         label = self._np_label()

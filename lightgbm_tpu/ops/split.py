@@ -42,6 +42,9 @@ class SplitConfig:
     max_cat_to_onehot: int = 4
     min_data_per_group: int = 100
     path_smooth: float = 0.0
+    # Monotone split-gain penalty near the root (reference
+    # ComputeMonotoneSplitGainPenalty, monotone_constraints.hpp:357).
+    monotone_penalty: float = 0.0
     # Extremely-randomized trees (reference col_sampler + USE_RAND scans):
     # when set, each (node, feature) evaluates ONE random threshold.
     extra_trees: bool = False
@@ -116,12 +119,15 @@ def gain_given_output(g, h, out, cfg: SplitConfig, l2_extra: float = 0.0):
 
 
 def child_gain(g, h, count, parent_output, cfg: SplitConfig,
-               l2_extra: float = 0.0):
-    """Per-child gain; closed form without smoothing, output-based with
-    (reference GetSplitGains USE_SMOOTHING dispatch)."""
-    if cfg.path_smooth <= 0.0:
+               l2_extra: float = 0.0, out_lo=None, out_hi=None):
+    """Per-child gain; closed form without smoothing/constraints,
+    output-based otherwise (reference GetSplitGains USE_SMOOTHING/USE_MC
+    dispatch: outputs clipped to the leaf's monotone bounds)."""
+    if cfg.path_smooth <= 0.0 and out_lo is None:
         return leaf_gain(g, h, cfg, l2_extra)
     w = smoothed_output(g, h, count, parent_output, cfg, l2_extra)
+    if out_lo is not None:
+        w = jnp.clip(w, out_lo, out_hi)
     return gain_given_output(g, h, w, cfg, l2_extra)
 
 
@@ -231,6 +237,9 @@ def best_split(
                                                # (path_smooth anchor)
     rand_bins: jnp.ndarray | None = None,      # (F,) i32 random threshold per
                                                # feature (extra_trees)
+    out_lo: jnp.ndarray | None = None,         # scalar monotone lower bound
+    out_hi: jnp.ndarray | None = None,         # scalar monotone upper bound
+    leaf_depth: jnp.ndarray | None = None,     # scalar (monotone_penalty)
 ) -> BestSplit:
     """Evaluate every (feature, threshold, missing-direction) candidate and argmax."""
     f, b, _ = hist.shape
@@ -262,6 +271,11 @@ def best_split(
         parent_gain = leaf_gain(parent_grad, parent_hess, cfg)
     min_count = float(max(cfg.min_data_in_leaf, 1))
 
+    mono_bounds = (out_lo is not None and out_hi is not None
+                   and cfg.has_monotone)
+    blo = out_lo if mono_bounds else None
+    bhi = out_hi if mono_bounds else None
+
     def eval_dir(GL, HL, CL, l2_extra=0.0):
         GR = parent_grad - GL
         HR = parent_hess - HL
@@ -272,8 +286,9 @@ def best_split(
             & (HL >= cfg.min_sum_hessian_in_leaf)
             & (HR >= cfg.min_sum_hessian_in_leaf)
         )
-        gain = (child_gain(GL, HL, CL, parent_output, cfg, l2_extra)
-                + child_gain(GR, HR, CR, parent_output, cfg, l2_extra)
+        gain = (child_gain(GL, HL, CL, parent_output, cfg, l2_extra, blo, bhi)
+                + child_gain(GR, HR, CR, parent_output, cfg, l2_extra,
+                             blo, bhi)
                 - parent_gain)
         gain = jnp.where(valid & (gain > cfg.min_gain_to_split + _EPS), gain, -jnp.inf)
         return gain, (GL, HL, CL, GR, HR, CR)
@@ -329,9 +344,23 @@ def best_split(
         HRm = parent_hess - HLm
         out_l = leaf_output(GLm, HLm, cfg)
         out_r = leaf_output(GRm, HRm, cfg)
+        if mono_bounds:
+            out_l = jnp.clip(out_l, blo, bhi)
+            out_r = jnp.clip(out_r, blo, bhi)
         mono = monotone[:, None]
         viol = ((mono > 0) & (out_l > out_r)) | ((mono < 0) & (out_l < out_r))
         gain_fb = jnp.where(viol, -jnp.inf, gain_fb)
+        if cfg.monotone_penalty > 0.0 and leaf_depth is not None:
+            # reference ComputeMonotoneSplitGainPenalty
+            # (monotone_constraints.hpp:357): multiplies the gain of splits
+            # on monotone features, fading with depth.
+            p = cfg.monotone_penalty
+            d = leaf_depth.astype(jnp.float32)
+            pen = jnp.where(
+                p >= d + 1.0, _EPS,
+                jnp.where(p <= 1.0, 1.0 - p / (2.0 ** d) + _EPS,
+                          1.0 - 2.0 ** (p - 1.0 - d) + _EPS))
+            gain_fb = jnp.where(mono != 0, gain_fb * pen, gain_fb)
 
     penalty_col = None
     if gain_penalty is not None and cfg.use_cegb:

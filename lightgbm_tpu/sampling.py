@@ -107,16 +107,24 @@ class FeatureSampler:
         self.num_features = num_features
         self.rng = np.random.RandomState(cfg.feature_fraction_seed)
         self.used = np.ones(num_features, bool)
+        # Interaction constraint groups (reference ColSampler ctor,
+        # col_sampler.hpp:27-30).  The per-BRANCH narrowing (a node may only
+        # split on its branch features plus groups containing the whole
+        # branch) lives in the grower; here the tree-level mask is the union
+        # of all groups, which equals the root's allowed set.
+        self.interaction_groups = None
         if cfg.interaction_constraints:
-            # Restrict to features present in any constraint group.
-            allowed = set()
+            groups = []
             for grp in cfg.interaction_constraints:
-                for tok in str(grp).strip("[] ").split(","):
-                    if tok.strip():
-                        allowed.add(int(tok))
-            if allowed:
+                ids = tuple(int(tok) for tok in str(grp).strip("[] ").split(",")
+                            if tok.strip())
+                if ids:
+                    groups.append(ids)
+            if groups:
+                self.interaction_groups = tuple(groups)
+                allowed = sorted({i for g in groups for i in g})
                 self.used = np.zeros(num_features, bool)
-                self.used[sorted(allowed)] = True
+                self.used[allowed] = True
 
     def tree_mask(self, iteration: int) -> np.ndarray:
         frac = self.cfg.feature_fraction

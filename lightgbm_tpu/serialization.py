@@ -146,7 +146,10 @@ def model_to_string(gbdt, num_iteration: Optional[int] = None,
            f"num_tree_per_iteration={gbdt.num_class}",
            "label_index=0",
            f"max_feature_idx={td.num_features - 1}",
-           f"objective={cfg.objective}",
+           # reference RegressionL2loss::ToString appends " sqrt"
+           f"objective={cfg.objective}"
+           + (" sqrt" if cfg.objective == "regression" and cfg.reg_sqrt
+              else ""),
            "feature_names=" + " ".join(
                td.feature_names or
                [f"Column_{i}" for i in range(td.num_features)]),
@@ -471,6 +474,11 @@ class LoadedModel:
         from .objectives import create_objective
         self.objective = create_objective(self.cfg) \
             if self.cfg.objective != "custom" else None
+        # Objective string extras (reference objective ToString suffixes):
+        # "regression sqrt" restores the reg_sqrt back-transform on load.
+        if (self.objective is not None and "sqrt" in objective.split()
+                and self.cfg.objective == "regression"):
+            self.objective.sqrt = True
 
     @property
     def iter_(self) -> int:
@@ -481,24 +489,52 @@ class LoadedModel:
         return len(self.trees)
 
     def predict_raw(self, X: np.ndarray, num_iteration: Optional[int] = None,
-                    start_iteration: int = 0) -> np.ndarray:
+                    start_iteration: int = 0, pred_early_stop: bool = False,
+                    pred_early_stop_freq: int = 10,
+                    pred_early_stop_margin: float = 10.0) -> np.ndarray:
         X = np.asarray(X, np.float64)
         n = X.shape[0]
         k = self.num_class
         out = np.tile(self.init_scores[None, :], (n, 1))
         per_class = [self.trees[i::k] if k > 1 else self.trees
                      for i in range(k)]
-        for kk in range(k):
-            trees = per_class[kk]
-            end = len(trees) if num_iteration is None else min(
-                len(trees), start_iteration + num_iteration)
-            for tree in trees[start_iteration:end]:
-                out[:, kk] += tree.predict(X)
+        end = (len(per_class[0]) if num_iteration is None else
+               min(len(per_class[0]), start_iteration + num_iteration))
+        iters = range(start_iteration, end)
+        if not pred_early_stop:
+            for kk in range(k):
+                for it in iters:
+                    out[:, kk] += per_class[kk][it].predict(X)
+            return out[:, 0] if k == 1 else out
+        # Margin-based prediction early stop (reference
+        # prediction_early_stop.cpp): every `freq` iterations, rows whose
+        # margin (binary: |score|; multiclass: top1-top2) exceeds the
+        # threshold stop accumulating further trees.
+        active = np.arange(n)
+        for step, it in enumerate(iters):
+            if len(active) == 0:
+                break
+            Xa = X[active]
+            for kk in range(k):
+                out[active, kk] += per_class[kk][it].predict(Xa)
+            if (step + 1) % max(pred_early_stop_freq, 1) == 0:
+                sub = out[active]
+                if k == 1:
+                    margin = np.abs(sub[:, 0])
+                else:
+                    part = np.partition(sub, k - 2, axis=1)
+                    margin = part[:, k - 1] - part[:, k - 2]
+                active = active[margin <= pred_early_stop_margin]
         return out[:, 0] if k == 1 else out
 
     def predict(self, X, raw_score: bool = False, num_iteration=None,
-                start_iteration: int = 0):
-        raw = self.predict_raw(X, num_iteration, start_iteration)
+                start_iteration: int = 0, **kwargs):
+        raw = self.predict_raw(
+            X, num_iteration, start_iteration,
+            pred_early_stop=bool(kwargs.get("pred_early_stop", False)),
+            pred_early_stop_freq=int(kwargs.get("pred_early_stop_freq", 10)),
+            pred_early_stop_margin=float(
+                kwargs.get("pred_early_stop_margin", 10.0)))
         if raw_score or self.objective is None:
             return raw
         import jax
