@@ -150,11 +150,28 @@ class GBDT:
                     f"{pname} has no effect on the TPU build: bins are "
                     "stored as one dense (rows, features) device array "
                     "(see binning.py)")
+        from ..parallel.mesh import DATA_AXIS, FEATURE_AXIS
+        # Data-only meshes use the sharded permutation layout (shard_map:
+        # per-shard pallas histograms + one psum per wave).  Feature-sharded
+        # meshes stay on the GSPMD mask path, whose einsum the compiler
+        # partitions — the pallas kernel is per-device-only there.
+        data_only_mesh = (self.mesh is not None
+                          and int(self.mesh.shape[FEATURE_AXIS]) == 1)
         hist_impl = cfg.tpu_histogram_impl
-        if hist_impl == "auto" and self.mesh is not None:
-            # GSPMD partitions the einsum path across the mesh; the pallas
-            # kernel is single-device (shard_map wrapping is future work).
+        if hist_impl == "auto" and self.mesh is not None and not data_only_mesh:
             hist_impl = "onehot" if jax.default_backend() == "tpu" else "segment"
+        voting = cfg.tree_learner == "voting" and data_only_mesh
+        if voting and (cfg.extra_trees or cfg.feature_fraction_bynode < 1.0
+                       or cfg.interaction_constraints
+                       or bool(cfg.cegb_penalty_split > 0.0
+                               or cfg.cegb_penalty_feature_coupled
+                               or cfg.cegb_penalty_feature_lazy
+                               or cfg.cegb_tradeoff < 1.0)):
+            Log.warning(
+                "tree_learner=voting does not compose with extra_trees/"
+                "feature_fraction_bynode/interaction_constraints/CEGB; "
+                "falling back to data-parallel")
+            voting = False
         self.grower_cfg = GrowerConfig(
             num_leaves=cfg.num_leaves,
             max_depth=cfg.max_depth,
@@ -162,7 +179,7 @@ class GBDT:
             split=_split_config(cfg, train),
             histogram_impl=hist_impl,
             rows_block=cfg.tpu_rows_block,
-            gather_rows=self.mesh is None,
+            gather_rows=self.mesh is None or data_only_mesh,
             leaf_batch=cfg.tpu_leaf_batch,
             feature_fraction_bynode=cfg.feature_fraction_bynode,
             interaction_groups=self.feature_sampler.interaction_groups,
@@ -170,6 +187,8 @@ class GBDT:
             num_grad_quant_bins=cfg.num_grad_quant_bins,
             stochastic_rounding=cfg.stochastic_rounding,
             quant_renew_leaf=cfg.quant_train_renew_leaf,
+            voting=voting,
+            vote_top_k=cfg.top_k,
         )
         self._quant_key = (jax.random.PRNGKey(cfg.seed)
                            if cfg.use_quantized_grad else None)
@@ -179,10 +198,20 @@ class GBDT:
         if cfg.extra_trees or cfg.feature_fraction_bynode < 1.0:
             self._split_key = jax.random.PRNGKey(
                 cfg.extra_seed * 92821 + cfg.feature_fraction_seed)
-        self.grow = make_grower(self.grower_cfg)
+        self.grow = make_grower(self.grower_cfg, mesh=self.mesh,
+                                data_axis=DATA_AXIS)
         self.bins_dev = train.bins_device()
         self.meta_dev = train.feature_meta_device()
         if self.mesh is not None:
+            if data_only_mesh:
+                # Pre-pad rows once so the sharded grower's shard_map sees
+                # even shards without re-copying bins every iteration (pad
+                # rows carry zero values — see grower.grow).
+                pad = (-self.bins_dev.shape[0]) % int(
+                    self.mesh.shape[DATA_AXIS])
+                if pad:
+                    self.bins_dev = jnp.pad(self.bins_dev,
+                                            ((0, pad), (0, 0)))
             self.bins_dev = shard_arrays(self.mesh, self.bins_dev)
         self.sample_strategy = SampleStrategy(
             cfg, train.num_data, train.label, train.query_boundaries())

@@ -16,12 +16,23 @@ state.  Two interchangeable data layouts:
   the splitting leaf's rows via ``dynamic_slice`` with a static power-of-two
   bucket chosen by a ``lax.switch`` on the leaf's row count.  Per-tree work is
   O(N · avg_depth) like the reference, not O(N · num_leaves).
-- **Mask layout** (sharded meshes): rows carry a ``row_leaf`` assignment vector
-  and leaf membership is a predicate folded into the histogram contraction.
-  Slower (full-N pass per split) but preserves row-sharding locality: all
-  reductions cross the mesh via compiler-inserted collectives (the reference's
-  histogram ReduceScatter + split AllGather,
-  ``data_parallel_tree_learner.cpp:284,441``).
+- **Sharded permutation layout** (data-axis meshes): the SAME permutation
+  machinery runs per-shard inside ``shard_map`` — each shard keeps a local
+  row permutation grouped by leaf and histograms only its local slice of the
+  splitting leaf; ONE ``psum`` per wave produces the replicated global
+  histograms (the reference's histogram reduce,
+  ``data_parallel_tree_learner.cpp:284``), so every split decision is
+  replicated across shards and per-tree cost stays O(N·depth / shards).
+- **Mask layout** (feature-axis meshes / tiny data): rows carry a
+  ``row_leaf`` assignment vector and leaf membership is a predicate folded
+  into the histogram contraction.  Slower (full-N pass per split) but works
+  under arbitrary GSPMD shardings: reductions cross the mesh via
+  compiler-inserted collectives (``data_parallel_tree_learner.cpp:284,441``).
+
+Histograms are carried RAW in ``leaf_hist`` (int32 under quantized training)
+and scaled to f32 only at split-scan consumption, so sibling subtraction is
+EXACT integer arithmetic and cross-shard reduction moves integer tensors —
+the reference's integer histogram reducers (``bin.h:48-81``).
 """
 
 from __future__ import annotations
@@ -34,7 +45,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..ops.histogram import histogram_from_vals, histogram_sib_from_vals
+from ..ops.histogram import histogram_from_vals
 from ..ops.split import (BestSplit, SplitConfig, best_split, leaf_output,
                          smoothed_output)
 
@@ -79,6 +90,13 @@ class GrowerConfig:
     num_grad_quant_bins: int = 4
     stochastic_rounding: bool = True
     quant_renew_leaf: bool = False
+    # Voting-parallel (reference VotingParallelTreeLearner / PV-Tree,
+    # voting_parallel_tree_learner.cpp): under a data mesh, keep leaf
+    # histograms LOCAL; each shard votes its top-k features by local gain and
+    # only the global top-2k features' histogram slices are psum'd — comm
+    # volume drops from F*B to 2k*B per child.
+    voting: bool = False
+    vote_top_k: int = 20
 
 
 class TreeArrays(NamedTuple):
@@ -165,9 +183,13 @@ def _split_buckets(n: int) -> list:
     return sizes
 
 
-def make_grower(cfg: GrowerConfig):
+def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
     """Build the jitted ``grow(bins, grad, hess, sample_mask, feature_mask, meta...)``
-    function.  All shapes/hyper-params are compile-time; data is traced."""
+    function.  All shapes/hyper-params are compile-time; data is traced.
+
+    With ``mesh`` (and ``cfg.gather_rows``), the permutation/wave layouts run
+    per-shard inside ``shard_map`` over ``data_axis`` with one histogram
+    ``psum`` per wave (see module docstring)."""
 
     L, B = cfg.num_leaves, cfg.num_bins
     M = max(L - 1, 1)
@@ -306,6 +328,73 @@ def make_grower(cfg: GrowerConfig):
 
     _best_for_pair = _best_for_batch
 
+    if cfg.voting and (use_rand or use_bynode or use_groups
+                       or cfg.split.use_cegb):
+        raise ValueError(
+            "voting-parallel does not support extra_trees / "
+            "feature_fraction_bynode / interaction_constraints / CEGB; "
+            "use tree_learner=data")
+
+    def _vote_best_batch(hist_loc, pgk, phk, pck, poutk, scale3, meta,
+                         feature_mask, boundsk, depthk, axis):
+        """Voting-parallel split search for k children (reference
+        ``GlobalVoting`` + ``SyncUpHistograms``,
+        ``voting_parallel_tree_learner.cpp``): each shard votes its local
+        top-k features by LOCAL split gain; only the global top-2k features'
+        histogram slices are psum'd, then the real split search runs on the
+        compact global slices."""
+        nbpf, nan_bins, is_cat, monotone = meta
+        k_child, f = hist_loc.shape[0], hist_loc.shape[1]
+        kk = min(cfg.vote_top_k, f)
+        sel_k = min(2 * kk, f)
+        hist_loc_s = _scale_hist(hist_loc, scale3)
+        loc_tot = jnp.sum(hist_loc_s[:, 0], axis=1)            # (k, 3)
+        if depthk is None:
+            depthk = jnp.zeros(k_child, jnp.int32)
+        if boundsk is None:
+            lok = hik = jnp.zeros(k_child, jnp.float32)
+            use_b = False
+        else:
+            lok, hik = boundsk
+            use_b = True
+
+        def local_gains(h, g, hh, c):
+            _, fg = best_split(
+                h, g, hh, c, num_bins_per_feature=nbpf, nan_bins=nan_bins,
+                is_categorical=is_cat, monotone=monotone,
+                feature_mask=feature_mask, cfg=cfg.split,
+                with_feature_gains=True)
+            return fg
+
+        fg = jax.vmap(local_gains)(hist_loc_s, loc_tot[:, 0],
+                                   loc_tot[:, 1], loc_tot[:, 2])   # (k, F)
+        _, top_idx = jax.lax.top_k(fg, kk)
+        votes = jnp.zeros((k_child, f), jnp.int32).at[
+            jnp.arange(k_child)[:, None], top_idx].add(1)
+        votes = jax.lax.psum(votes, axis)
+        gsum = jax.lax.psum(jnp.where(jnp.isfinite(fg), fg, 0.0), axis)
+        score = votes.astype(jnp.float32) * 1e6 + gsum
+        _, sel = jax.lax.top_k(score, sel_k)           # (k, 2k) replicated
+        hist_sel = jnp.take_along_axis(
+            hist_loc, sel[:, :, None, None], axis=1)   # (k, 2k, B, 3) local
+        hist_sel = jax.lax.psum(hist_sel, axis)        # ONLY winners cross
+        hist_sel = _scale_hist(hist_sel, scale3)
+
+        def one(h, pg, ph, pc, po, selj, lo, hi, dep):
+            bs = best_split(
+                h, pg, ph, pc,
+                num_bins_per_feature=nbpf[selj], nan_bins=nan_bins[selj],
+                is_categorical=is_cat[selj], monotone=monotone[selj],
+                feature_mask=feature_mask[selj], cfg=cfg.split,
+                parent_output=po,
+                out_lo=lo if use_b else None,
+                out_hi=hi if use_b else None,
+                leaf_depth=dep)
+            return bs._replace(feature=selj[bs.feature])
+
+        return jax.vmap(one)(hist_sel, pgk, phk, pck, poutk, sel, lok, hik,
+                             depthk)
+
     def _cegb_penalty(count, feat_used, path_used, coupled, lazy):
         """Per-feature gain penalty (reference CEGB ``DeltaGain``):
         tradeoff * (penalty_split*count + coupled[f]*first-use-in-model
@@ -342,7 +431,8 @@ def make_grower(cfg: GrowerConfig):
             perm=jnp.zeros(0, jnp.int32),  # set by caller when used
             leaf_start=jnp.zeros(L, jnp.int32),
             leaf_rows=jnp.zeros(L, jnp.int32).at[0].set(n),
-            leaf_hist=jnp.zeros((L, f, B, 3), jnp.float32).at[0].set(root_hist),
+            leaf_hist=jnp.zeros((L, f, B, 3),
+                                root_hist.dtype).at[0].set(root_hist),
             leaf_sum_grad=jnp.zeros(L, jnp.float32).at[0].set(root_g),
             leaf_sum_hess=jnp.zeros(L, jnp.float32).at[0].set(root_h),
             leaf_count=jnp.zeros(L, jnp.float32).at[0].set(root_c),
@@ -408,7 +498,7 @@ def make_grower(cfg: GrowerConfig):
 
     def _children_updates(st, leaf, new_leaf, hist_left, hist_right,
                           gl, hl, cl, gr, hr, cr, meta, feature_mask,
-                          cegb=None, groups_mat=None):
+                          cegb=None, groups_mat=None, scale3=None):
         """Store child stats + their best splits (both children batched into
         single 2-row scatters to minimize kernel count in the hot loop)."""
         depth = st.leaf_depth[leaf] + 1
@@ -459,7 +549,8 @@ def make_grower(cfg: GrowerConfig):
                 _cegb_penalty(cl, feat_used, child_path, coupled, lazy),
                 _cegb_penalty(cr, feat_used, child_path, coupled, lazy),
             ])
-        hist2 = jnp.stack([hist_left, hist_right])
+        hist2 = jnp.stack([hist_left, hist_right])     # RAW (stored)
+        hist2s = _scale_hist(hist2, scale3)            # scaled (split scan)
         g2 = jnp.stack([gl, gr])
         h2 = jnp.stack([hl, hr])
         c2 = jnp.stack([cl, cr])
@@ -477,8 +568,8 @@ def make_grower(cfg: GrowerConfig):
         )
         depth_ok = jnp.asarray(True) if cfg.max_depth <= 0 \
             else depth < cfg.max_depth
-        bs2 = _best_for_pair(hist2, g2, h2, c2, meta, feature_mask, penalty2,
-                             jnp.stack([out_l, out_r]), node_key,
+        bs2 = _best_for_pair(hist2s, g2, h2, c2, meta, feature_mask,
+                             penalty2, jnp.stack([out_l, out_r]), node_key,
                              path2, groups_mat, bounds2, depth2)
         gain2 = jnp.where(depth_ok, bs2.gain, _NEG_INF)
         return st._replace(
@@ -524,13 +615,15 @@ def make_grower(cfg: GrowerConfig):
             return perm, nl_phys
         return branch
 
-    def _root_best(state, meta, feature_mask, root_pen, groups_mat=None):
+    def _root_best(state, scale3, meta, feature_mask, root_pen,
+                   groups_mat=None):
         """Root split search (shared by both layouts)."""
         key = None
         if need_key:
             rng, key = jax.random.split(state.rng)
             state = state._replace(rng=rng)
-        bs = _best_for(state.leaf_hist[0], state.leaf_sum_grad[0],
+        bs = _best_for(_scale_hist(state.leaf_hist[0], scale3),
+                       state.leaf_sum_grad[0],
                        state.leaf_sum_hess[0], state.leaf_count[0], meta,
                        feature_mask, root_pen, state.leaf_out[0], key,
                        state.leaf_path[0], groups_mat,
@@ -540,9 +633,10 @@ def make_grower(cfg: GrowerConfig):
         return state, bs
 
     def _perm_setup(bins, vals, scale3, meta, feature_mask, cegb, key,
-                    groups_mat=None):
+                    groups_mat=None, axis=None):
         """Shared permutation-layout prologue: padded arrays, buckets, root
-        histogram/state/best-split."""
+        histogram/state/best-split.  ``axis`` = shard_map axis name for the
+        cross-shard histogram psum (None = single device)."""
         n, f = bins.shape
         bins_pad = jnp.concatenate([bins, jnp.zeros((1, f), bins.dtype)], 0)
         vals_pad = jnp.concatenate([vals, jnp.zeros((1, 3), vals.dtype)], 0)
@@ -551,10 +645,19 @@ def make_grower(cfg: GrowerConfig):
         buckets_arr = jnp.asarray(buckets, jnp.int32)
         perm0 = jnp.concatenate([jnp.arange(n, dtype=jnp.int32),
                                  jnp.full(max_bucket, n, jnp.int32)])
-        root_hist = _scale_hist(histogram_from_vals(
+        root_hist = histogram_from_vals(
             bins, vals, num_bins=B, impl=cfg.histogram_impl,
-            rows_block=cfg.rows_block), scale3)
-        root_tot = jnp.sum(root_hist[0], axis=0)
+            rows_block=cfg.rows_block)
+        voting = cfg.voting and axis is not None
+        if axis is not None and not voting:
+            # The reference's histogram reduce
+            # (data_parallel_tree_learner.cpp:284) — integer tensors under
+            # quantized training (bin.h:48-81).  Voting mode keeps leaf
+            # histograms LOCAL and reduces only vote winners.
+            root_hist = jax.lax.psum(root_hist, axis)
+        root_tot = jnp.sum(_scale_hist(root_hist[0:1], scale3)[0], axis=0)
+        if voting:
+            root_tot = jax.lax.psum(root_tot, axis)
         root_g, root_h, root_c = root_tot[0], root_tot[1], root_tot[2]
         state = _init_state(n, f, root_hist, root_g, root_h, root_c, key)
         state = state._replace(perm=perm0)
@@ -562,8 +665,15 @@ def make_grower(cfg: GrowerConfig):
         if cfg.split.use_cegb and cegb is not None:
             root_pen = _cegb_penalty(root_c, state.feat_used,
                                      state.leaf_path[0], *cegb)
-        state, root_bs = _root_best(state, meta, feature_mask, root_pen,
-                                    groups_mat)
+        if voting:
+            bs1 = _vote_best_batch(
+                state.leaf_hist[0:1], root_g[None], root_h[None],
+                root_c[None], state.leaf_out[0:1], scale3, meta,
+                feature_mask, None, None, axis)
+            root_bs = jax.tree.map(lambda a: a[0], bs1)
+        else:
+            state, root_bs = _root_best(state, scale3, meta, feature_mask,
+                                        root_pen, groups_mat)
         state = _store_best(state, jnp.asarray(0), root_bs, jnp.asarray(True))
         return state, bins_pad, vals_pad, buckets, buckets_arr, max_bucket
 
@@ -571,7 +681,11 @@ def make_grower(cfg: GrowerConfig):
         """row -> leaf assignment from the final grouped permutation:
         position i belongs to the leaf whose [start, start+rows) range
         contains i."""
-        starts = jnp.where(jnp.arange(L) < state.num_leaves,
+        # Zero-row leaves (possible per-shard under the sharded layout) share
+        # their start with a sibling; exclude them so the searchsorted tie
+        # cannot claim the sibling's rows.
+        starts = jnp.where((jnp.arange(L) < state.num_leaves)
+                           & (state.leaf_rows > 0),
                            state.leaf_start, n + max_bucket)
         order = jnp.argsort(starts)
         sorted_starts = starts[order]
@@ -582,18 +696,19 @@ def make_grower(cfg: GrowerConfig):
 
     # ------------------------------------------------------------------ perm path
     def _grow_perm(bins, vals, scale3, feature_mask, meta, cegb=None,
-                   key=None):
-        """Permutation-layout growth (single device)."""
+                   key=None, axis=None):
+        """Permutation-layout growth (single device, or per-shard under
+        ``shard_map`` when ``axis`` names the mesh data axis)."""
         n, f = bins.shape
         nan_bins = meta[1]
         groups_mat = _groups_matrix(f) if use_groups else None
         (state, bins_pad, vals_pad, buckets, buckets_arr,
          max_bucket) = _perm_setup(bins, vals, scale3, meta, feature_mask,
-                                   cegb, key, groups_mat)
+                                   cegb, key, groups_mat, axis)
 
         def _make_hist_branch(S):
-            """Histogram of a contiguous child range (the smaller sibling —
-            the larger one comes from parent-hist subtraction, the
+            """RAW histogram of a contiguous child range (the smaller
+            sibling — the larger one comes from parent-hist subtraction, the
             reference's FeatureHistogram::Subtract)."""
             def branch(perm, start, cnt):
                 seg = jax.lax.dynamic_slice(perm, (start,), (S,))
@@ -601,10 +716,10 @@ def make_grower(cfg: GrowerConfig):
                 seg = jnp.where(valid, seg, n)
                 bseg = bins_pad[seg]                       # (S, F)
                 vseg = vals_pad[seg]                       # (S, 3)
-                return _scale_hist(histogram_from_vals(
+                return histogram_from_vals(
                     bseg, vseg, num_bins=B,
                     impl=cfg.histogram_impl,
-                    rows_block=min(cfg.rows_block, S)), scale3)
+                    rows_block=min(cfg.rows_block, S))
             return branch
 
         part_branches = [_part_branch_for(bins_pad, nan_bins, S)
@@ -634,12 +749,21 @@ def make_grower(cfg: GrowerConfig):
             # Histogram ONLY the physically smaller child's contiguous range
             # (its own, usually much smaller, bucket) — the expensive op scales
             # with the smaller sibling, exactly like the reference's serial
-            # learner; the sibling comes from parent-hist subtraction.
-            small_left = nl_phys <= cnt - nl_phys
+            # learner; the sibling comes from parent-hist subtraction.  Under
+            # a mesh the small/large choice must be GLOBAL so every shard
+            # histograms the same side.
+            if axis is None:
+                small_left = nl_phys <= cnt - nl_phys
+            else:
+                nl_g = jax.lax.psum(nl_phys, axis)
+                cnt_g = jax.lax.psum(cnt, axis)
+                small_left = nl_g <= cnt_g - nl_g
             hs_start = jnp.where(small_left, start, start + nl_phys)
-            hs_cnt = jnp.minimum(nl_phys, cnt - nl_phys)
+            hs_cnt = jnp.where(small_left, nl_phys, cnt - nl_phys)
             hist_small = jax.lax.switch(
                 _bucket_of(hs_cnt), hist_branches, perm, hs_start, hs_cnt)
+            if axis is not None:
+                hist_small = jax.lax.psum(hist_small, axis)
 
             hist_parent = st.leaf_hist[leaf]
             hist_big = hist_parent - hist_small
@@ -656,7 +780,8 @@ def make_grower(cfg: GrowerConfig):
             )
             return _children_updates(st, leaf, new_leaf, hist_left,
                                      hist_right, gl, hl, cl, gr, hr, cr,
-                                     meta, feature_mask, cegb, groups_mat)
+                                     meta, feature_mask, cegb, groups_mat,
+                                     scale3)
 
         def cond(st: _GrowState):
             return (st.num_leaves < L) & (jnp.max(st.best_gain) > _NEG_INF)
@@ -666,46 +791,41 @@ def make_grower(cfg: GrowerConfig):
 
     # ------------------------------------------------------------------ wave path
     def _grow_wave(bins, vals, scale3, feature_mask, meta, cegb=None,
-                   key=None):
+                   key=None, axis=None):
         """Wave growth (permutation layout): split the top-W leaves per step.
 
-        Per wave: partition each chosen leaf's contiguous segment, compact
-        every SMALLER sibling's rows into one buffer, histogram all of them
-        in a single multi-sibling kernel (M = W x channels on the MXU), get
-        the larger siblings by subtraction, and run one vmapped split search
+        Per wave: partition each chosen leaf's contiguous segment, histogram
+        each SMALLER sibling's contiguous range with the flat kernel (it is
+        HBM-bandwidth-bound, so W sequential bandwidth-optimal calls beat
+        one M-packed multi-sibling kernel — measured ~100x on v5e), get the
+        larger siblings by subtraction, and run one vmapped split search
         over all 2W children.  Sequential depth per tree drops from
         num_leaves-1 steps to ~ceil((num_leaves-1)/W)."""
         n, f = bins.shape
         W = min(cfg.leaf_batch, max(L - 1, 1))
+        voting = cfg.voting and axis is not None
         nan_bins = meta[1]
         groups_mat = _groups_matrix(f) if use_groups else None
         (state, bins_pad, vals_pad, buckets, buckets_arr,
          max_bucket) = _perm_setup(bins, vals, scale3, meta, feature_mask,
-                                   cegb, key, groups_mat)
+                                   cegb, key, groups_mat, axis)
 
-        def _make_wave_hist_branch(S):
-            """Histogram ALL W smaller siblings from one compacted buffer."""
-            def branch(perm, small_start, small_cnt, offs):
-                pos = jnp.arange(S, dtype=jnp.int32)
-                s_id = jnp.clip(
-                    jnp.searchsorted(offs, pos, side="right") - 1, 0, W - 1
-                ).astype(jnp.int32)
-                within = pos - offs[s_id]
-                valid = within < small_cnt[s_id]
-                src = small_start[s_id] + jnp.where(valid, within, 0)
-                rows = jnp.where(valid, perm[src], n)
-                sib = jnp.where(valid, s_id, -1)
-                hist = histogram_sib_from_vals(
-                    bins_pad[rows], vals_pad[rows], sib,
-                    num_bins=B, num_sibs=W,
+        def _make_hist_branch(S):
+            """RAW histogram of one sibling's contiguous perm range (padded
+            rows hit the phantom zero row)."""
+            def branch(perm, start, cnt):
+                seg = jax.lax.dynamic_slice(perm, (start,), (S,))
+                valid = jnp.arange(S, dtype=jnp.int32) < cnt
+                seg = jnp.where(valid, seg, n)
+                return histogram_from_vals(
+                    bins_pad[seg], vals_pad[seg], num_bins=B,
                     impl=cfg.histogram_impl,
                     rows_block=min(cfg.rows_block, S))
-                return _scale_hist(hist, scale3)
             return branch
 
         part_branches = [_part_branch_for(bins_pad, nan_bins, S)
                          for S in buckets]
-        wave_hist_branches = [_make_wave_hist_branch(S) for S in buckets]
+        hist_branches = [_make_hist_branch(S) for S in buckets]
 
         def _bucket_of(cnt):
             return jnp.clip(jnp.searchsorted(buckets_arr, cnt, side="left"),
@@ -749,14 +869,34 @@ def make_grower(cfg: GrowerConfig):
             perm, nl_phys = jax.lax.fori_loop(
                 0, W, part_one, (st.perm, jnp.zeros(W, jnp.int32)))
 
-            small_left = nl_phys <= cnts - nl_phys
+            if axis is None:
+                small_left = nl_phys <= cnts - nl_phys
+            else:
+                # Global small/large choice so every shard histograms the
+                # same side (reference data-parallel smaller-leaf sync,
+                # data_parallel_tree_learner.cpp:224).
+                nl_g = jax.lax.psum(nl_phys, axis)
+                cnt_g = jax.lax.psum(cnts, axis)
+                small_left = nl_g <= cnt_g - nl_g
             small_start = jnp.where(small_left, starts, starts + nl_phys)
-            small_cnt = jnp.minimum(nl_phys, cnts - nl_phys)
-            offs = jnp.cumsum(small_cnt) - small_cnt
-            total_small = jnp.sum(small_cnt)
-            hist_small = jax.lax.switch(
-                _bucket_of(total_small), wave_hist_branches, perm,
-                small_start, small_cnt, offs)                 # (W, F, B, 3)
+            small_cnt = jnp.where(small_left, nl_phys, cnts - nl_phys)
+
+            raw_dtype = jnp.int32 if cfg.quantized else jnp.float32
+
+            def hist_one(j, hs):
+                h = jax.lax.switch(
+                    _bucket_of(small_cnt[j]), hist_branches, perm,
+                    small_start[j], small_cnt[j])
+                return hs.at[j].set(h)
+
+            hist_small = jax.lax.fori_loop(
+                0, W, hist_one,
+                jnp.zeros((W, f, B, 3), raw_dtype))           # (W, F, B, 3)
+            if axis is not None and not voting:
+                # ONE cross-shard reduce per wave — integer tensors under
+                # quantized training (bin.h:48-81).  Voting mode reduces only
+                # the vote winners' slices (see _vote_best_batch).
+                hist_small = jax.lax.psum(hist_small, axis)
 
             parent_hist = st.leaf_hist[top_l]
             hist_big = parent_hist - hist_small
@@ -885,12 +1025,18 @@ def make_grower(cfg: GrowerConfig):
             if need_key:
                 rng, node_key = jax.random.split(st.rng)
                 st = st._replace(rng=rng)
-            hist2 = cat2(hist_left, hist_right)
-            bs = _best_for_batch(hist2, cat2(gl, gr), cat2(hl, hr),
-                                 cat2(cl, cr), meta, feature_mask, penalty2,
-                                 cat2(out_l, out_r), node_key,
-                                 path2, groups_mat, bounds2,
-                                 cat2(depth, depth))
+            if voting:
+                bs = _vote_best_batch(
+                    cat2(hist_left, hist_right), cat2(gl, gr),
+                    cat2(hl, hr), cat2(cl, cr), cat2(out_l, out_r), scale3,
+                    meta, feature_mask, bounds2, cat2(depth, depth), axis)
+            else:
+                hist2s = _scale_hist(cat2(hist_left, hist_right), scale3)
+                bs = _best_for_batch(hist2s, cat2(gl, gr), cat2(hl, hr),
+                                     cat2(cl, cr), meta, feature_mask,
+                                     penalty2, cat2(out_l, out_r), node_key,
+                                     path2, groups_mat, bounds2,
+                                     cat2(depth, depth))
             if cfg.max_depth <= 0:
                 depth_ok = jnp.ones(2 * W, bool)
             else:
@@ -930,17 +1076,18 @@ def make_grower(cfg: GrowerConfig):
 
         def hist_for(mask):
             # vals already carries bagging weights + in-bag zeroing; the
-            # per-leaf predicate is the only extra mask needed.
+            # per-leaf predicate is the only extra mask needed.  RAW output;
+            # scaling happens at split-scan consumption.
             masked = jnp.where(mask[:, None], vals, jnp.zeros_like(vals))
-            return _scale_hist(histogram_from_vals(
+            return histogram_from_vals(
                 bins, masked, num_bins=B,
-                impl=cfg.histogram_impl, rows_block=cfg.rows_block), scale3)
+                impl=cfg.histogram_impl, rows_block=cfg.rows_block)
 
         nan_bins = meta[1]
-        root_hist = _scale_hist(histogram_from_vals(
+        root_hist = histogram_from_vals(
             bins, vals, num_bins=B, impl=cfg.histogram_impl,
-            rows_block=cfg.rows_block), scale3)
-        root_tot = jnp.sum(root_hist[0], axis=0)
+            rows_block=cfg.rows_block)
+        root_tot = jnp.sum(_scale_hist(root_hist[0:1], scale3)[0], axis=0)
         root_g, root_h, root_c = root_tot[0], root_tot[1], root_tot[2]
         state = _init_state(n, f, root_hist, root_g, root_h, root_c, key)
         row_leaf0 = jnp.zeros(n, jnp.int32)
@@ -948,8 +1095,8 @@ def make_grower(cfg: GrowerConfig):
         if cfg.split.use_cegb and cegb is not None:
             root_pen = _cegb_penalty(root_c, state.feat_used,
                                      state.leaf_path[0], *cegb)
-        state, root_bs = _root_best(state, meta, feature_mask, root_pen,
-                                    groups_mat)
+        state, root_bs = _root_best(state, scale3, meta, feature_mask,
+                                    root_pen, groups_mat)
         state = _store_best(state, jnp.asarray(0), root_bs, jnp.asarray(True))
 
         def body(carry):
@@ -992,7 +1139,8 @@ def make_grower(cfg: GrowerConfig):
             st = st._replace(tree=tree)
             st = _children_updates(st, leaf, new_leaf, hist_left,
                                    hist_right, gl, hl, cl, gr, hr, cr,
-                                   meta, feature_mask, cegb, groups_mat)
+                                   meta, feature_mask, cegb, groups_mat,
+                                   scale3)
             return st, row_leaf
 
         def cond(carry):
@@ -1001,6 +1149,55 @@ def make_grower(cfg: GrowerConfig):
 
         state, row_leaf = jax.lax.while_loop(cond, body, (state, row_leaf0))
         return _finish(state), row_leaf
+
+    # -------------------------------------------------------------- sharded path
+    def _grow_sharded(bins, vals, scale3, feature_mask, meta, cegb,
+                      split_key):
+        """Run the permutation/wave grower per-shard under ``shard_map``:
+        local partitions + local histograms, ONE psum per wave (the
+        reference's histogram reduce, ``data_parallel_tree_learner.cpp:284``).
+        All split decisions derive from the replicated psum'd histograms, so
+        the tree state is replicated and the while_loop stays in lockstep."""
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        grow_fn = (_grow_wave if (cfg.leaf_batch > 1 or cfg.voting)
+                   else _grow_perm)
+        have_scale = scale3 is not None
+        have_cegb = cegb is not None
+        have_key = split_key is not None
+        extras, especs = [], []
+        if have_scale:
+            extras.append(scale3)
+            especs.append(P())
+        if have_cegb:
+            extras.extend(cegb)
+            especs.extend([P(), P()])
+        if have_key:
+            extras.append(split_key)
+            especs.append(P())
+
+        def body(bins, vals, fmask, nbpf, nanb, iscat, mono, *extra):
+            i = 0
+            s3 = cg = sk = None
+            if have_scale:
+                s3 = extra[i]
+                i += 1
+            if have_cegb:
+                cg = (extra[i], extra[i + 1])
+                i += 2
+            if have_key:
+                sk = extra[i]
+            return grow_fn(bins, vals, s3, fmask, (nbpf, nanb, iscat, mono),
+                           cg, sk, axis=data_axis)
+
+        return shard_map(
+            body, mesh=mesh,
+            in_specs=(P(data_axis), P(data_axis), P(), P(), P(), P(), P())
+            + tuple(especs),
+            out_specs=(P(), P(data_axis)),
+            check_rep=False,
+        )(bins, vals, feature_mask, *meta, *extras)
 
     @functools.partial(jax.jit, donate_argnums=())
     def grow(
@@ -1049,13 +1246,31 @@ def make_grower(cfg: GrowerConfig):
             scale3 = None
         if need_key and split_key is None:
             split_key = jax.random.PRNGKey(0)
-        if cfg.gather_rows and bins.shape[0] > _MIN_BUCKET:
+        n = grad.shape[0]
+        dshards = 1 if mesh is None else int(mesh.shape[data_axis])
+        if mesh is not None and cfg.gather_rows:
+            # shard_map needs even row shards; zero-valued pad rows
+            # contribute nothing to any histogram.  Callers avoid the bins
+            # copy by pre-padding the bins array once.
+            pad = (-bins.shape[0]) % dshards
+            if pad:
+                bins = jnp.pad(bins, ((0, pad), (0, 0)))
+        if bins.shape[0] != vals.shape[0]:
+            vals = jnp.pad(vals, ((0, bins.shape[0] - vals.shape[0]), (0, 0)))
+        use_sharded = (mesh is not None and cfg.gather_rows
+                       and bins.shape[0] // dshards > _MIN_BUCKET)
+        if use_sharded:
+            tree, row_leaf = _grow_sharded(bins, vals, scale3, feature_mask,
+                                           meta, cegb, split_key)
+        elif (mesh is None and cfg.gather_rows
+                and bins.shape[0] > _MIN_BUCKET):
             grow_fn = _grow_wave if cfg.leaf_batch > 1 else _grow_perm
             tree, row_leaf = grow_fn(bins, vals, scale3, feature_mask,
                                      meta, cegb, split_key)
         else:
             tree, row_leaf = _grow_mask(bins, vals, scale3, feature_mask,
                                         meta, cegb, split_key)
+        row_leaf = row_leaf[:n]
         if cfg.quantized and cfg.quant_renew_leaf:
             # quant_train_renew_leaf: recompute leaf outputs from the TRUE
             # (unquantized) gradients (reference RenewIntGradTreeOutput).

@@ -12,10 +12,12 @@ Why this shape wins on the MXU:
 
 - The one-hot (the big streamed operand) never touches HBM: it is built in
   VMEM from the (blk, ft) uint8 bin tile, so HBM traffic is just bins + vals.
-- A whole feature TILE shares ONE dot per row-block (N = ft*B lanes),
+- A whole feature CHUNK shares ONE dot per row-block (N = ft*B lanes),
   instead of per-feature M=8 matmuls — fewer, larger matmuls with identical
-  streamed volume.  The grid tiles (row-blocks x feature-tiles) so the VMEM
-  one-hot stays bounded for arbitrarily wide datasets.
+  streamed volume.  The grid iterates row-blocks only; very wide datasets
+  are chunked at trace time into separate same-shaped calls so the VMEM
+  one-hot stays bounded (and every BlockSpec dim is Mosaic-legal: the
+  feature dim always equals the array dim, row blocks are 128-multiples).
 - The M dimension carries (sibling x channel).  Growing multiple leaves per
   wave packs M up to 128 (16 siblings x 8 channels), so the systolic array's
   row dimension is fully used while the streamed K x N volume stays
@@ -35,7 +37,10 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-C_PAD = 8  # channels (grad, hess, count) padded to one f32 sublane tile
+C_PAD = 4  # channels (grad, hess, count) padded; BlockSpec dim == array dim
+           # so sublane alignment is not required, and 4 halves the streamed
+           # valsT bytes vs a full 8-sublane tile.
+_VMEM_LIMIT = 64 * 1024 * 1024  # Mosaic scoped-vmem ceiling (v5e has 128MB)
 
 _DTYPES = {
     "f32": (jnp.float32, jnp.float32, 4),
@@ -46,29 +51,41 @@ _DTYPES = {
 
 def _pick_tiles(f: int, num_bins: int, itemsize: int, rows_block: int,
                 num_sibs: int = 1, acc_size: int = 4):
-    """(rows_block, features_per_tile) bounding the kernel's VMEM working
+    """(rows_block, features_per_chunk) bounding the kernel's VMEM working
     set (the in-VMEM one-hot PLUS the (num_sibs*C_PAD, ft*B) accumulator
     block) to ~12MB.
 
-    The row block is fixed first (1024 unless the caller asks for less) and
-    the feature tile is sized from the remaining budget — wide matmul N
-    (ft*B lanes) beats a deep K, and arbitrarily wide datasets tile along
-    the feature grid dimension instead of blowing VMEM."""
-    budget = 12 * 1024 * 1024
+    Mosaic requires each BlockSpec's last dim to be a multiple of 128 or
+    equal to the full array dim, so the kernel never tiles features inside
+    one ``pallas_call``: the bins block spans the WHOLE (chunk) feature
+    width, and wide datasets are chunked at trace time into separate
+    same-shaped calls.  Row blocks stay multiples of 128 (the sublane-
+    aligned choice for every dtype used here).
+
+    The 2x on the one-hot bytes models Mosaic's observed scoped-stack peak
+    (the (blk, ft, B) compare plus its (blk, ft*B) reshape copy coexist)."""
+    budget = 16 * 1024 * 1024
+
+    def bytes_for(blk, ft):
+        return ft * num_bins * (blk * 2 * itemsize
+                                + num_sibs * C_PAD * acc_size)
+
     # rows_block > 4096 means "tuned for the XLA einsum path" — auto-pick.
-    blk = 1024 if (rows_block <= 0 or rows_block > 4096) else rows_block
-    per_ft = num_bins * (blk * itemsize + num_sibs * C_PAD * acc_size)
-    ft = max(1, min(f, budget // per_ft))
-    while blk > 256 and ft * num_bins * (blk * itemsize
-                                         + num_sibs * C_PAD * acc_size) \
-            > budget:
+    blk = 1024 if (rows_block <= 0 or rows_block > 4096) \
+        else max(128, (rows_block // 128) * 128)
+    while blk > 128 and bytes_for(blk, f) > budget:
         blk //= 2
+    if bytes_for(blk, f) <= budget:
+        return blk, f
+    # Very wide data: fix the minimum row block and chunk the features.
+    ft = max(1, budget // (num_bins * (blk * itemsize
+                                       + num_sibs * C_PAD * acc_size)))
     return blk, ft
 
 
 def _prep(bins, vals, rows_block, ftile, sib=None):
-    """Pad rows to the block size, features to the tile size, channels to
-    C_PAD; returns (bins, valsT, sib2, nblocks, nftiles).
+    """Pad rows to the block size, features to a multiple of the chunk
+    width, channels to C_PAD; returns (bins, valsT, sib2, nblocks, nchunks).
 
     Phantom feature columns are filled with bin 0; their histogram blocks
     are sliced off by the caller, so the garbage never escapes.
@@ -90,8 +107,8 @@ def _prep(bins, vals, rows_block, ftile, sib=None):
 
 
 def _flat_kernel(bins_ref, valsT_ref, out_ref, *, num_bins, ftile,
-                 oh_dtype, acc_dtype):
-    rb = pl.program_id(1)  # row-block index (grid dim 1, iterates fastest)
+                 oh_dtype, acc_dtype, precision):
+    rb = pl.program_id(0)  # row-block index
 
     @pl.when(rb == 0)
     def _init():
@@ -106,12 +123,12 @@ def _flat_kernel(bins_ref, valsT_ref, out_ref, *, num_bins, ftile,
     out_ref[:, :] += jax.lax.dot_general(
         valsT.astype(oh_dtype) if oh_dtype != valsT.dtype else valsT,
         oh, dimension_numbers=(((1,), (0,)), ((), ())),
-        preferred_element_type=acc_dtype)
+        preferred_element_type=acc_dtype, precision=precision)
 
 
 def _flat_sib_kernel(bins_ref, valsT_ref, sib_ref, out_ref, *, num_bins,
-                     ftile, num_sibs, oh_dtype, acc_dtype):
-    rb = pl.program_id(1)  # row-block index (grid dim 1, iterates fastest)
+                     ftile, num_sibs, oh_dtype, acc_dtype, precision):
+    rb = pl.program_id(0)  # row-block index
 
     @pl.when(rb == 0)
     def _init():
@@ -132,7 +149,7 @@ def _flat_sib_kernel(bins_ref, valsT_ref, sib_ref, out_ref, *, num_bins,
     out_ref[:, :] += jax.lax.dot_general(
         A.astype(oh_dtype) if oh_dtype != A.dtype else A,
         oh, dimension_numbers=(((1,), (0,)), ((), ())),
-        preferred_element_type=acc_dtype)
+        preferred_element_type=acc_dtype, precision=precision)
 
 
 @functools.partial(
@@ -149,28 +166,37 @@ def histogram_flat(
     """Single-leaf flat-matmul histogram."""
     n, f = bins.shape
     oh_dtype, acc_dtype, isz = _DTYPES[dtype]
+    # f32 must accumulate exactly (reference hists are exact f32 sums);
+    # DEFAULT would run the MXU at bf16 and perturb every histogram entry.
+    precision = (jax.lax.Precision.HIGHEST if dtype == "f32"
+                 else jax.lax.Precision.DEFAULT)
     rows_block, ftile = _pick_tiles(f, num_bins, isz, rows_block)
-    bins, valsT, _, nblocks, nftiles = _prep(bins, vals, rows_block, ftile)
-    out = pl.pallas_call(
+    bins, valsT, _, nblocks, nchunks = _prep(bins, vals, rows_block, ftile)
+    call = pl.pallas_call(
         functools.partial(_flat_kernel, num_bins=num_bins, ftile=ftile,
-                          oh_dtype=oh_dtype, acc_dtype=acc_dtype),
-        grid=(nftiles, nblocks),
+                          oh_dtype=oh_dtype, acc_dtype=acc_dtype,
+                          precision=precision),
+        grid=(nblocks,),
         in_specs=[
-            pl.BlockSpec((rows_block, ftile), lambda j, i: (i, j),
+            pl.BlockSpec((rows_block, ftile), lambda i: (i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((C_PAD, rows_block), lambda j, i: (0, i),
+            pl.BlockSpec((C_PAD, rows_block), lambda i: (0, i),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec((C_PAD, ftile * num_bins),
-                               lambda j, i: (0, j), memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct(
-            (C_PAD, nftiles * ftile * num_bins), acc_dtype),
+                               lambda i: (0, 0), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((C_PAD, ftile * num_bins), acc_dtype),
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("arbitrary", "arbitrary")),
+            dimension_semantics=("arbitrary",),
+            vmem_limit_bytes=_VMEM_LIMIT),
         interpret=interpret,
-    )(bins, valsT)
+    )
+    chunks = [call(jax.lax.slice_in_dim(bins, c * ftile, (c + 1) * ftile,
+                                        axis=1), valsT)
+              for c in range(nchunks)]
+    out = chunks[0] if nchunks == 1 else jnp.concatenate(chunks, axis=1)
     # (C_PAD, Fpad*B) -> (F, B, 3), dropping phantom feature blocks
-    out = out.reshape(C_PAD, nftiles * ftile, num_bins)[:3, :f]
+    out = out.reshape(C_PAD, nchunks * ftile, num_bins)[:3, :f]
     return jnp.transpose(out, (1, 2, 0))
 
 
@@ -193,33 +219,40 @@ def histogram_flat_sib(
     channels (up to 128)."""
     n, f = bins.shape
     oh_dtype, acc_dtype, isz = _DTYPES[dtype]
+    precision = (jax.lax.Precision.HIGHEST if dtype == "f32"
+                 else jax.lax.Precision.DEFAULT)
     rows_block, ftile = _pick_tiles(f, num_bins, isz, rows_block,
                                     num_sibs=num_sibs)
-    bins, valsT, sib2, nblocks, nftiles = _prep(bins, vals, rows_block,
+    bins, valsT, sib2, nblocks, nchunks = _prep(bins, vals, rows_block,
                                                 ftile, sib)
-    out = pl.pallas_call(
+    call = pl.pallas_call(
         functools.partial(_flat_sib_kernel, num_bins=num_bins, ftile=ftile,
                           num_sibs=num_sibs, oh_dtype=oh_dtype,
-                          acc_dtype=acc_dtype),
-        grid=(nftiles, nblocks),
+                          acc_dtype=acc_dtype, precision=precision),
+        grid=(nblocks,),
         in_specs=[
-            pl.BlockSpec((rows_block, ftile), lambda j, i: (i, j),
+            pl.BlockSpec((rows_block, ftile), lambda i: (i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((C_PAD, rows_block), lambda j, i: (0, i),
+            pl.BlockSpec((C_PAD, rows_block), lambda i: (0, i),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, rows_block), lambda j, i: (0, i),
+            pl.BlockSpec((1, rows_block), lambda i: (0, i),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec((num_sibs * C_PAD, ftile * num_bins),
-                               lambda j, i: (0, j), memory_space=pltpu.VMEM),
+                               lambda i: (0, 0), memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct(
-            (num_sibs * C_PAD, nftiles * ftile * num_bins), acc_dtype),
+            (num_sibs * C_PAD, ftile * num_bins), acc_dtype),
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("arbitrary", "arbitrary")),
+            dimension_semantics=("arbitrary",),
+            vmem_limit_bytes=_VMEM_LIMIT),
         interpret=interpret,
-    )(bins, valsT, sib2)
+    )
+    chunks = [call(jax.lax.slice_in_dim(bins, c * ftile, (c + 1) * ftile,
+                                        axis=1), valsT, sib2)
+              for c in range(nchunks)]
+    out = chunks[0] if nchunks == 1 else jnp.concatenate(chunks, axis=1)
     # (W*C_PAD, Fpad*B) -> (W, F, B, 3), dropping phantom feature blocks
-    out = out.reshape(num_sibs, C_PAD, nftiles * ftile, num_bins)[:, :3, :f]
+    out = out.reshape(num_sibs, C_PAD, nchunks * ftile, num_bins)[:, :3, :f]
     return jnp.transpose(out, (0, 2, 3, 1))
 
 

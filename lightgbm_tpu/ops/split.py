@@ -240,8 +240,14 @@ def best_split(
     out_lo: jnp.ndarray | None = None,         # scalar monotone lower bound
     out_hi: jnp.ndarray | None = None,         # scalar monotone upper bound
     leaf_depth: jnp.ndarray | None = None,     # scalar (monotone_penalty)
+    with_feature_gains: bool = False,          # also return (F,) best gain per
+                                               # feature (voting-parallel)
 ) -> BestSplit:
-    """Evaluate every (feature, threshold, missing-direction) candidate and argmax."""
+    """Evaluate every (feature, threshold, missing-direction) candidate and argmax.
+
+    With ``with_feature_gains`` returns ``(best, per_feature_gain)`` — the
+    local vote input of the voting-parallel learner (reference
+    ``VotingParallelTreeLearner``, ``voting_parallel_tree_learner.cpp``)."""
     f, b, _ = hist.shape
     G, H, C = hist[..., 0], hist[..., 1], hist[..., 2]
     biota = jnp.arange(b, dtype=jnp.int32)[None, :]
@@ -402,6 +408,11 @@ def best_split(
             parent_output, parent_gain, in_feature, sorted_eligible,
             feature_mask, penalty_col, cfg, min_count,
             rand_bins if cfg.extra_trees else None)
+    if with_feature_gains:
+        fg = jnp.max(gain_fb, axis=1)
+        # NOTE: sorted-categorical gains are not folded into the vote — the
+        # vote only ranks features, and one-hot gains rank the same columns.
+        return best, fg
     return best
 
 

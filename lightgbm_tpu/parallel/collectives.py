@@ -147,6 +147,11 @@ def global_feature_vote(local_gains: jnp.ndarray, top_k: int, mesh: Mesh,
     top-k features by split gain; votes are summed globally and the top-2k
     features win.  Only the winners' histograms then cross the network.
 
+    Standalone shard_map primitive (unit-tested); the production voting
+    learner embeds the same vote inside the sharded grower's wave loop —
+    ``models/grower.py`` ``_vote_best_batch`` — where it composes with the
+    per-wave histogram reduce.
+
     ``local_gains``: (K, F) per-shard best gain per feature (sharded along
     ``axis``).  Returns a replicated (F,) bool mask of the selected features.
     """

@@ -16,9 +16,10 @@ single jit program; distribution is expressed by *sharding the inputs*:
 - ``tree_learner=feature`` -> ``bins`` sharded along the feature axis; each
   device scans its own features and the split argmax becomes a tiny cross-device
   reduction (the reference's ``SyncUpGlobalBestSplit``, 2 SplitInfos per rank).
-- ``tree_learner=voting``  -> communication-volume optimization of data-parallel;
-  with XLA the histogram reduce is already fused/overlapped, so it maps to the
-  data layout (kept as an accepted alias).
+- ``tree_learner=voting``  -> data layout + PV-Tree voting in the grower
+  (``models/grower.py`` ``_vote_best_batch``): leaf histograms stay LOCAL,
+  each shard votes its top-k features by local gain, and only the global
+  top-2k features' histogram slices are psum'd.
 
 Multi-host: the same shardings over a DCN-connected mesh via
 ``jax.distributed.initialize`` (reference: machine-list bootstrap,

@@ -93,3 +93,152 @@ def test_entry_entrypoint():
     out, num_leaves = jax.jit(fn)(*args)
     assert int(num_leaves) >= 2
     assert out.shape == args[0].shape[:1]
+
+
+def test_sharded_perm_grower_matches_serial_exactly():
+    """The sharded permutation layout must pick the SAME splits as the serial
+    grower: all decisions derive from psum'd histograms, so tree structure is
+    bitwise-identical and only leaf values see f32 reduce-order noise.
+
+    (Reference parity pattern: tests/python_package_test/test_dual.py:37 —
+    near-equal eval metrics across device types.)"""
+    import lightgbm_tpu.models.grower as G
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.dataset import TrainData
+    from lightgbm_tpu.models.gbdt import _split_config
+
+    n, f = 8 * 4096, 12   # > _MIN_BUCKET rows per shard on 8 shards
+    rng = np.random.RandomState(7)
+    X = rng.randn(n, f)
+    y = (X[:, 0] + 0.7 * X[:, 1] * X[:, 2] + 0.3 * rng.randn(n) > 0)
+    cfg = Config({"objective": "binary", "num_leaves": 31,
+                  "min_data_in_leaf": 20, "verbosity": -1})
+    td = TrainData.build(X, y.astype(np.float64), cfg)
+    meta = td.feature_meta_device()
+    bins = jnp.asarray(td.binned.bins)
+    p = 1.0 / (1.0 + np.exp(0.0))
+    grad = jnp.asarray((p - y).astype(np.float32))
+    hess = jnp.asarray(np.full(n, p * (1 - p), np.float32))
+    mask = jnp.ones(n, jnp.float32)
+    fmask = jnp.ones(f, bool)
+
+    for leaf_batch in (1, 4):
+        gcfg = G.GrowerConfig(num_leaves=31,
+                              num_bins=td.binned.max_num_bins,
+                              split=_split_config(cfg),
+                              leaf_batch=leaf_batch)
+        args = (bins, grad, hess, mask, fmask,
+                meta["num_bins_per_feature"], meta["nan_bins"],
+                meta["is_categorical"], meta["monotone"])
+        tree_s, rl_s = G.make_grower(gcfg)(*args)
+        mesh = make_mesh(8, 1)
+        tree_m, rl_m = G.make_grower(gcfg, mesh=mesh,
+                                     data_axis=DATA_AXIS)(*args)
+        # Identical structure: same split features/bins/children everywhere.
+        assert int(tree_s.num_leaves) == int(tree_m.num_leaves)
+        np.testing.assert_array_equal(np.asarray(tree_s.split_feature),
+                                      np.asarray(tree_m.split_feature))
+        np.testing.assert_array_equal(np.asarray(tree_s.split_bin),
+                                      np.asarray(tree_m.split_bin))
+        np.testing.assert_array_equal(np.asarray(tree_s.left_child),
+                                      np.asarray(tree_m.left_child))
+        np.testing.assert_array_equal(np.asarray(rl_s), np.asarray(rl_m))
+        np.testing.assert_allclose(np.asarray(tree_s.leaf_value),
+                                   np.asarray(tree_m.leaf_value),
+                                   rtol=1e-4, atol=1e-6)
+
+
+def test_sharded_training_metric_parity():
+    """End-to-end data-parallel training must match serial at METRIC level
+    (reference test_dual.py:37 asserts near-equal evals, not loose corr)."""
+    from lightgbm_tpu.metrics import _auc
+
+    n, f = 8 * 4096, 10
+    rng = np.random.RandomState(3)
+    X = rng.randn(n, f)
+    logits = X[:, 0] + 0.5 * X[:, 1] * X[:, 2]
+    y = (rng.rand(n) < 1 / (1 + np.exp(-logits))).astype(np.float64)
+    params = {"objective": "binary", "num_leaves": 31, "min_data_in_leaf": 20,
+              "verbosity": -1}
+    serial = lgb.train(dict(params, tree_learner="serial"),
+                       lgb.Dataset(X, label=y), 5)
+    sharded = lgb.train(dict(params, tree_learner="data"),
+                        lgb.Dataset(X, label=y), 5)
+    ps = serial.predict(X, raw_score=True)
+    pp = sharded.predict(X, raw_score=True)
+    auc_s = _auc(y, ps, None, None)
+    auc_p = _auc(y, pp, None, None)
+    assert abs(auc_s - auc_p) < 1e-3
+    np.testing.assert_allclose(ps, pp, rtol=1e-3, atol=1e-3)
+
+
+def _grower_all_reduce_bytes(gcfg, n=8 * 2304, f=64):
+    """Total all-reduce bytes in the compiled sharded grower HLO."""
+    import re
+    import lightgbm_tpu.models.grower as G
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.dataset import TrainData
+    from lightgbm_tpu.models.gbdt import _split_config
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(n, f)
+    y = (X[:, 0] > 0).astype(np.float64)
+    cfg = Config({"objective": "binary", "verbosity": -1})
+    td = TrainData.build(X, y, cfg)
+    mesh = make_mesh(8, 1)
+    grow = G.make_grower(gcfg, mesh=mesh, data_axis=DATA_AXIS)
+    meta = td.feature_meta_device()
+    args = (jnp.asarray(td.binned.bins),
+            jnp.zeros(n, jnp.float32), jnp.ones(n, jnp.float32),
+            jnp.ones(n, jnp.float32), jnp.ones(f, bool),
+            meta["num_bins_per_feature"], meta["nan_bins"],
+            meta["is_categorical"], meta["monotone"])
+    txt = grow.lower(*args).compile().as_text()
+    sizes = {"f32": 4, "s32": 4, "u32": 4, "f64": 8, "s8": 1, "pred": 1}
+    total = 0
+    for m in re.finditer(r"= (f32|s32|u32|f64|s8|pred)\[([0-9,]*)\][^=]*all-reduce",
+                         txt):
+        dims = [int(d) for d in m.group(2).split(",") if d]
+        total += sizes[m.group(1)] * int(np.prod(dims)) if dims else sizes[m.group(1)]
+    return total
+
+
+def test_voting_reduces_collective_bytes():
+    """HLO-level evidence that voting-parallel moves LESS than data-parallel
+    (reference PV-Tree claim, voting_parallel_tree_learner.cpp): the per-wave
+    psum shrinks from (2W, F, B, 3) to (2W, 2k, B, 3)."""
+    import lightgbm_tpu.models.grower as G
+    from lightgbm_tpu.models.gbdt import _split_config
+    from lightgbm_tpu.config import Config
+    cfg = Config({"objective": "binary", "verbosity": -1})
+    base = dict(num_leaves=15, num_bins=256, split=_split_config(cfg),
+                leaf_batch=4)
+    data_bytes = _grower_all_reduce_bytes(
+        G.GrowerConfig(**base))
+    vote_bytes = _grower_all_reduce_bytes(
+        G.GrowerConfig(voting=True, vote_top_k=4, **base))
+    # Voting syncs BOTH children of each split but only 2k features;
+    # data-parallel syncs W smaller siblings across all F features.  At
+    # F=64, k=4 the static HLO reduce volume should drop well below half.
+    assert vote_bytes < data_bytes * 0.6, (vote_bytes, data_bytes)
+
+
+def test_voting_training_quality():
+    """Voting-parallel training must track serial quality closely (it is an
+    approximation — reference docs call the quality loss negligible)."""
+    from lightgbm_tpu.metrics import _auc
+
+    n, f = 8 * 4096, 24
+    rng = np.random.RandomState(3)
+    X = rng.randn(n, f)
+    logits = X[:, 0] + 0.5 * X[:, 1] * X[:, 2] + 0.3 * X[:, 5]
+    y = (rng.rand(n) < 1 / (1 + np.exp(-logits))).astype(np.float64)
+    params = {"objective": "binary", "num_leaves": 31,
+              "min_data_in_leaf": 20, "verbosity": -1, "top_k": 5}
+    serial = lgb.train(dict(params, tree_learner="serial"),
+                       lgb.Dataset(X, label=y), 5)
+    voting = lgb.train(dict(params, tree_learner="voting"),
+                       lgb.Dataset(X, label=y), 5)
+    auc_s = _auc(y, serial.predict(X, raw_score=True), None, None)
+    auc_v = _auc(y, voting.predict(X, raw_score=True), None, None)
+    assert auc_v > auc_s - 2e-3, (auc_s, auc_v)
