@@ -1,0 +1,269 @@
+"""Python side of the C-ABI shim (``capi/csrc/capi.cpp``).
+
+The C library embeds (or joins) a CPython interpreter and calls these
+functions with primitive arguments — memoryviews for buffers, str/int/float
+scalars.  Everything returns plain Python values the C side can convert.
+
+Reference: ``src/c_api.cpp`` — the handle-based surface
+(``LGBM_DatasetCreateFromMat``, ``LGBM_BoosterCreate``,
+``LGBM_BoosterUpdateOneIter``, ``LGBM_BoosterPredictForMat``, ...).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+# C_API data type codes (reference include/LightGBM/c_api.h)
+C_API_DTYPE_FLOAT32 = 0
+C_API_DTYPE_FLOAT64 = 1
+C_API_DTYPE_INT32 = 2
+C_API_DTYPE_INT64 = 3
+
+# predict type codes
+C_API_PREDICT_NORMAL = 0
+C_API_PREDICT_RAW_SCORE = 1
+C_API_PREDICT_LEAF_INDEX = 2
+C_API_PREDICT_CONTRIB = 3
+
+_NP_DTYPES = {
+    C_API_DTYPE_FLOAT32: np.float32,
+    C_API_DTYPE_FLOAT64: np.float64,
+    C_API_DTYPE_INT32: np.int32,
+    C_API_DTYPE_INT64: np.int64,
+}
+
+
+def _parse_params(params: str) -> dict:
+    """``key=value`` space/comma/newline separated (reference
+    ``Config::Str2Map``)."""
+    out = {}
+    if not params:
+        return out
+    for tok in params.replace(",", " ").split():
+        if "=" in tok:
+            k, v = tok.split("=", 1)
+            out[k.strip()] = v.strip()
+    return out
+
+
+def _mat_from_memory(mv, dtype_code: int, nrow: int, ncol: int,
+                     is_row_major: int) -> np.ndarray:
+    arr = np.frombuffer(mv, dtype=_NP_DTYPES[dtype_code],
+                        count=nrow * ncol)
+    if is_row_major:
+        return arr.reshape(nrow, ncol).astype(np.float64)
+    return arr.reshape(ncol, nrow).T.astype(np.float64)
+
+
+# ------------------------------------------------------------------- Dataset
+class _CApiDataset:
+    def __init__(self, dataset):
+        self.dataset = dataset  # lightgbm_tpu.basic.Dataset
+
+
+def dataset_create_from_mat(mv, dtype_code, nrow, ncol, is_row_major,
+                            params, reference):
+    from ..basic import Dataset
+    X = _mat_from_memory(mv, dtype_code, nrow, ncol, is_row_major)
+    ref = reference.dataset if reference is not None else None
+    ds = Dataset(X, params=_parse_params(params), reference=ref)
+    return _CApiDataset(ds)
+
+
+def dataset_create_from_file(filename, params, reference):
+    from ..basic import Dataset
+    from ..io.parser import load_data_file
+
+    p = _parse_params(params)
+    X, y, weight, group = load_data_file(
+        filename, label_column=p.get("label_column", p.get("label", "")),
+        header=str(p.get("header", "false")).lower() in ("true", "1"))
+    ref = reference.dataset if reference is not None else None
+    ds = Dataset(X, label=y, weight=weight, group=group, params=p,
+                 reference=ref)
+    return _CApiDataset(ds)
+
+
+def dataset_set_field(handle, name, mv, dtype_code, num_element):
+    arr = np.frombuffer(mv, dtype=_NP_DTYPES[dtype_code],
+                        count=num_element).copy()
+    ds = handle.dataset
+    if name == "label":
+        ds.set_label(arr)
+    elif name == "weight":
+        ds.set_weight(arr)
+    elif name in ("group", "query"):
+        ds.set_group(arr)
+    elif name == "init_score":
+        ds.init_score = arr
+    else:
+        raise ValueError(f"unknown field {name!r}")
+
+
+def dataset_get_num_data(handle):
+    return int(handle.dataset.num_data())
+
+
+def dataset_get_num_feature(handle):
+    return int(handle.dataset.num_feature())
+
+
+def dataset_save_binary(handle, filename):
+    handle.dataset.save_binary(filename)
+
+
+# ------------------------------------------------------------------- Booster
+class _CApiBooster:
+    """Deferred-construction booster: the reference C API adds valid sets
+    AFTER BoosterCreate, but our Booster takes them at construction — so the
+    real Booster materializes on first use after the last AddValidData."""
+
+    def __init__(self, params: Optional[dict] = None, train=None,
+                 booster=None):
+        self.params = params or {}
+        self.train = train
+        self.valids: List = []
+        self._bst = booster
+
+    @property
+    def bst(self):
+        if self._bst is None:
+            from ..basic import Booster
+            self._bst = Booster(
+                self.params, self.train.dataset,
+                valid_sets=[(f"valid_{i}", d.dataset)
+                            for i, d in enumerate(self.valids)])
+        return self._bst
+
+
+def booster_create(train_handle, params):
+    return _CApiBooster(_parse_params(params), train_handle)
+
+
+def booster_create_from_modelfile(filename):
+    from ..basic import Booster
+    b = Booster(model_file=filename)
+    return _CApiBooster(booster=b), int(b.current_iteration)
+
+
+def booster_load_model_from_string(model_str):
+    from ..basic import Booster
+    b = Booster(model_str=model_str)
+    return _CApiBooster(booster=b), int(b.current_iteration)
+
+
+def booster_add_valid_data(handle, valid_handle):
+    if handle._bst is not None:
+        raise RuntimeError(
+            "AddValidData must be called before the first UpdateOneIter")
+    handle.valids.append(valid_handle)
+
+
+def booster_update_one_iter(handle):
+    return 1 if handle.bst.update() else 0
+
+
+def booster_rollback_one_iter(handle):
+    handle.bst.rollback_one_iter()
+
+
+def booster_get_current_iteration(handle):
+    return int(handle.bst.current_iteration)
+
+
+def booster_get_num_classes(handle):
+    return int(getattr(handle.bst._gbdt, "num_class", 1))
+
+
+def booster_get_num_feature(handle):
+    return int(handle.bst.num_feature())
+
+
+def booster_num_model_per_iteration(handle):
+    return int(handle.bst.num_model_per_iteration())
+
+
+def booster_get_eval_names(handle):
+    evals = handle.bst._evals()
+    names, seen = [], set()
+    for _data, metric, _v, _hb in evals:
+        if metric not in seen:
+            seen.add(metric)
+            names.append(metric)
+    return names
+
+
+def booster_get_eval_counts(handle):
+    return len(booster_get_eval_names(handle))
+
+
+def booster_get_eval(handle, data_idx):
+    """data_idx 0 = training, i+1 = i-th valid (reference semantics; the
+    training list is empty unless ``is_provide_training_metric``)."""
+    evals = handle.bst._evals()
+    want = "training" if data_idx == 0 else f"valid_{data_idx - 1}"
+    return [float(v) for d, _m, v, _hb in evals if d == want]
+
+
+def booster_predict_for_mat(handle, mv, dtype_code, nrow, ncol, is_row_major,
+                            predict_type, start_iteration, num_iteration,
+                            params):
+    X = _mat_from_memory(mv, dtype_code, nrow, ncol, is_row_major)
+    kw = dict(start_iteration=start_iteration,
+              num_iteration=None if num_iteration <= 0 else num_iteration)
+    kw.update({k: v for k, v in _parse_params(params).items()
+               if k in ("pred_early_stop", "pred_early_stop_freq",
+                        "pred_early_stop_margin")})
+    if predict_type == C_API_PREDICT_RAW_SCORE:
+        out = handle.bst.predict(X, raw_score=True, **kw)
+    elif predict_type == C_API_PREDICT_LEAF_INDEX:
+        out = handle.bst.predict(X, pred_leaf=True, **kw)
+    elif predict_type == C_API_PREDICT_CONTRIB:
+        out = handle.bst.predict(X, pred_contrib=True, **kw)
+    else:
+        out = handle.bst.predict(X, **kw)
+    out = np.ascontiguousarray(out, np.float64)
+    return out.tobytes(), out.size
+
+
+def booster_predict_for_file(handle, data_filename, data_has_header,
+                             predict_type, start_iteration, num_iteration,
+                             params, result_filename):
+    from ..io.parser import load_data_file
+
+    X, _y, _w, _g = load_data_file(data_filename,
+                                   header=bool(data_has_header))
+    raw, size = booster_predict_for_mat(
+        handle, memoryview(np.ascontiguousarray(X, np.float64)),
+        C_API_DTYPE_FLOAT64, X.shape[0], X.shape[1], 1, predict_type,
+        start_iteration, num_iteration, params)
+    arr = np.frombuffer(raw, np.float64).reshape(X.shape[0], -1)
+    np.savetxt(result_filename, arr, delimiter="\t", fmt="%.9g")
+
+
+def booster_save_model(handle, start_iteration, num_iteration, filename):
+    handle.bst.save_model(
+        filename,
+        num_iteration=None if num_iteration <= 0 else num_iteration,
+        start_iteration=start_iteration)
+
+
+def booster_save_model_to_string(handle, start_iteration, num_iteration):
+    return handle.bst.model_to_string(
+        num_iteration=None if num_iteration <= 0 else num_iteration,
+        start_iteration=start_iteration)
+
+
+def booster_dump_model(handle, start_iteration, num_iteration):
+    import json
+    return json.dumps(handle.bst.dump_model(
+        num_iteration=None if num_iteration <= 0 else num_iteration,
+        start_iteration=start_iteration))
+
+
+def booster_feature_importance(handle, num_iteration, importance_type):
+    itype = "gain" if importance_type == 1 else "split"
+    imp = handle.bst.feature_importance(importance_type=itype)
+    return np.ascontiguousarray(imp, np.float64).tobytes()

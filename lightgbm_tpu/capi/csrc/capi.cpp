@@ -1,0 +1,540 @@
+// C-ABI shim for lightgbm_tpu — the reference's LGBM_* handle surface
+// (src/c_api.cpp:163) re-implemented over an embedded (or joined) CPython
+// interpreter that runs the TPU framework.
+//
+// Threading/ownership model: every entry point takes the GIL via
+// PyGILState_Ensure, calls lightgbm_tpu.capi.bridge, converts results to C
+// types, and releases the GIL.  Handles are strong PyObject* references to
+// bridge wrapper objects; *Free drops the reference.  Errors are captured
+// per-thread and surfaced through LGBM_GetLastError (reference
+// LGBM_GetLastError, c_api.cpp).
+//
+// Works in two modes:
+//  - loaded into an existing Python process (tests, language bindings built
+//    on ctypes/cffi): joins the running interpreter;
+//  - loaded by a plain C/C++ program: initializes Python itself, appending
+//    the package root (baked in at build time or $LIGHTGBM_TPU_PKG_DIR) to
+//    sys.path.
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+
+#ifndef LTPU_PKG_DIR
+#define LTPU_PKG_DIR ""
+#endif
+
+namespace {
+
+thread_local std::string g_last_error = "everything is fine";
+std::once_flag g_init_once;
+bool g_we_initialized = false;
+PyObject* g_bridge = nullptr;  // borrowed forever after init
+
+void set_error_from_python() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  if (value != nullptr) {
+    PyObject* s = PyObject_Str(value);
+    if (s != nullptr) {
+      const char* c = PyUnicode_AsUTF8(s);
+      g_last_error = c != nullptr ? c : "unknown python error";
+      Py_DECREF(s);
+    }
+  } else {
+    g_last_error = "unknown python error";
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+}
+
+void init_python() {
+  std::call_once(g_init_once, [] {
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);
+      g_we_initialized = true;
+    }
+    PyGILState_STATE st = PyGILState_Ensure();
+    const char* pkg = getenv("LIGHTGBM_TPU_PKG_DIR");
+    std::string dir = pkg != nullptr ? pkg : LTPU_PKG_DIR;
+    if (!dir.empty()) {
+      PyObject* sys_path = PySys_GetObject("path");  // borrowed
+      if (sys_path != nullptr) {
+        PyObject* p = PyUnicode_FromString(dir.c_str());
+        if (p != nullptr) {
+          PyList_Append(sys_path, p);
+          Py_DECREF(p);
+        }
+      }
+    }
+    g_bridge = PyImport_ImportModule("lightgbm_tpu.capi.bridge");
+    if (g_bridge == nullptr) set_error_from_python();
+    PyGILState_Release(st);
+    if (g_we_initialized) {
+      // Drop the main-thread GIL so any thread can PyGILState_Ensure later.
+      PyEval_SaveThread();
+    }
+  });
+}
+
+// RAII GIL + bridge access.
+struct Gil {
+  PyGILState_STATE st;
+  bool ok;
+  Gil() {
+    init_python();
+    st = PyGILState_Ensure();
+    ok = g_bridge != nullptr;
+    if (!ok) g_last_error = "lightgbm_tpu bridge failed to import";
+  }
+  ~Gil() { PyGILState_Release(st); }
+};
+
+// Call bridge.<fn>(args...); returns new reference or nullptr (error set).
+PyObject* bridge_call(const char* fn, PyObject* args) {
+  PyObject* f = PyObject_GetAttrString(g_bridge, fn);
+  if (f == nullptr) {
+    set_error_from_python();
+    Py_XDECREF(args);
+    return nullptr;
+  }
+  PyObject* r = PyObject_CallObject(f, args);
+  Py_DECREF(f);
+  Py_XDECREF(args);
+  if (r == nullptr) set_error_from_python();
+  return r;
+}
+
+PyObject* mv_from(const void* data, Py_ssize_t bytes) {
+  return PyMemoryView_FromMemory(
+      reinterpret_cast<char*>(const_cast<void*>(data)), bytes, PyBUF_READ);
+}
+
+Py_ssize_t dtype_size(int dtype) {
+  switch (dtype) {
+    case 0: return 4;   // float32
+    case 1: return 8;   // float64
+    case 2: return 4;   // int32
+    case 3: return 8;   // int64
+    default: return 0;
+  }
+}
+
+int copy_str_out(PyObject* s, int64_t buffer_len, int64_t* out_len,
+                 char* out_str) {
+  Py_ssize_t n = 0;
+  const char* c = PyUnicode_AsUTF8AndSize(s, &n);
+  if (c == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  *out_len = static_cast<int64_t>(n) + 1;
+  if (out_str != nullptr && buffer_len > 0) {
+    int64_t cp = n + 1 <= buffer_len ? n + 1 : buffer_len;
+    std::memcpy(out_str, c, cp - 1);
+    out_str[cp - 1] = '\0';
+  }
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+typedef void* DatasetHandle;
+typedef void* BoosterHandle;
+
+const char* LGBM_GetLastError() { return g_last_error.c_str(); }
+
+// ------------------------------------------------------------------ Dataset
+int LGBM_DatasetCreateFromMat(const void* data, int data_type, int32_t nrow,
+                              int32_t ncol, int is_row_major,
+                              const char* parameters, DatasetHandle reference,
+                              DatasetHandle* out) {
+  Gil g;
+  if (!g.ok) return -1;
+  PyObject* ref = reference != nullptr
+                      ? reinterpret_cast<PyObject*>(reference)
+                      : Py_None;
+  Py_INCREF(ref);
+  PyObject* r = bridge_call(
+      "dataset_create_from_mat",
+      Py_BuildValue("(NiiiisN)",
+                    mv_from(data, static_cast<Py_ssize_t>(nrow) * ncol *
+                                      dtype_size(data_type)),
+                    data_type, nrow, ncol, is_row_major,
+                    parameters != nullptr ? parameters : "", ref));
+  if (r == nullptr) return -1;
+  *out = r;
+  return 0;
+}
+
+int LGBM_DatasetCreateFromFile(const char* filename, const char* parameters,
+                               DatasetHandle reference, DatasetHandle* out) {
+  Gil g;
+  if (!g.ok) return -1;
+  PyObject* ref = reference != nullptr
+                      ? reinterpret_cast<PyObject*>(reference)
+                      : Py_None;
+  Py_INCREF(ref);
+  PyObject* r = bridge_call(
+      "dataset_create_from_file",
+      Py_BuildValue("(ssN)", filename,
+                    parameters != nullptr ? parameters : "", ref));
+  if (r == nullptr) return -1;
+  *out = r;
+  return 0;
+}
+
+int LGBM_DatasetSetField(DatasetHandle handle, const char* field_name,
+                         const void* field_data, int num_element, int type) {
+  Gil g;
+  if (!g.ok) return -1;
+  PyObject* r = bridge_call(
+      "dataset_set_field",
+      Py_BuildValue("(OsNii)", reinterpret_cast<PyObject*>(handle),
+                    field_name,
+                    mv_from(field_data, static_cast<Py_ssize_t>(num_element) *
+                                            dtype_size(type)),
+                    type, num_element));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_DatasetGetNumData(DatasetHandle handle, int32_t* out) {
+  Gil g;
+  if (!g.ok) return -1;
+  PyObject* r = bridge_call(
+      "dataset_get_num_data",
+      Py_BuildValue("(O)", reinterpret_cast<PyObject*>(handle)));
+  if (r == nullptr) return -1;
+  *out = static_cast<int32_t>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_DatasetGetNumFeature(DatasetHandle handle, int32_t* out) {
+  Gil g;
+  if (!g.ok) return -1;
+  PyObject* r = bridge_call(
+      "dataset_get_num_feature",
+      Py_BuildValue("(O)", reinterpret_cast<PyObject*>(handle)));
+  if (r == nullptr) return -1;
+  *out = static_cast<int32_t>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_DatasetSaveBinary(DatasetHandle handle, const char* filename) {
+  Gil g;
+  if (!g.ok) return -1;
+  PyObject* r = bridge_call(
+      "dataset_save_binary",
+      Py_BuildValue("(Os)", reinterpret_cast<PyObject*>(handle), filename));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_DatasetFree(DatasetHandle handle) {
+  Gil g;
+  if (!g.ok) return -1;
+  Py_DECREF(reinterpret_cast<PyObject*>(handle));
+  return 0;
+}
+
+// ------------------------------------------------------------------ Booster
+int LGBM_BoosterCreate(DatasetHandle train_data, const char* parameters,
+                       BoosterHandle* out) {
+  Gil g;
+  if (!g.ok) return -1;
+  PyObject* r = bridge_call(
+      "booster_create",
+      Py_BuildValue("(Os)", reinterpret_cast<PyObject*>(train_data),
+                    parameters != nullptr ? parameters : ""));
+  if (r == nullptr) return -1;
+  *out = r;
+  return 0;
+}
+
+int LGBM_BoosterCreateFromModelfile(const char* filename,
+                                    int* out_num_iterations,
+                                    BoosterHandle* out) {
+  Gil g;
+  if (!g.ok) return -1;
+  PyObject* r = bridge_call("booster_create_from_modelfile",
+                            Py_BuildValue("(s)", filename));
+  if (r == nullptr) return -1;
+  PyObject* h = PyTuple_GetItem(r, 0);
+  *out_num_iterations =
+      static_cast<int>(PyLong_AsLong(PyTuple_GetItem(r, 1)));
+  Py_INCREF(h);
+  *out = h;
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_BoosterLoadModelFromString(const char* model_str,
+                                    int* out_num_iterations,
+                                    BoosterHandle* out) {
+  Gil g;
+  if (!g.ok) return -1;
+  PyObject* r = bridge_call("booster_load_model_from_string",
+                            Py_BuildValue("(s)", model_str));
+  if (r == nullptr) return -1;
+  PyObject* h = PyTuple_GetItem(r, 0);
+  *out_num_iterations =
+      static_cast<int>(PyLong_AsLong(PyTuple_GetItem(r, 1)));
+  Py_INCREF(h);
+  *out = h;
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_BoosterFree(BoosterHandle handle) {
+  Gil g;
+  if (!g.ok) return -1;
+  Py_DECREF(reinterpret_cast<PyObject*>(handle));
+  return 0;
+}
+
+int LGBM_BoosterAddValidData(BoosterHandle handle, DatasetHandle valid_data) {
+  Gil g;
+  if (!g.ok) return -1;
+  PyObject* r = bridge_call(
+      "booster_add_valid_data",
+      Py_BuildValue("(OO)", reinterpret_cast<PyObject*>(handle),
+                    reinterpret_cast<PyObject*>(valid_data)));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_BoosterUpdateOneIter(BoosterHandle handle, int* is_finished) {
+  Gil g;
+  if (!g.ok) return -1;
+  PyObject* r = bridge_call(
+      "booster_update_one_iter",
+      Py_BuildValue("(O)", reinterpret_cast<PyObject*>(handle)));
+  if (r == nullptr) return -1;
+  *is_finished = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_BoosterRollbackOneIter(BoosterHandle handle) {
+  Gil g;
+  if (!g.ok) return -1;
+  PyObject* r = bridge_call(
+      "booster_rollback_one_iter",
+      Py_BuildValue("(O)", reinterpret_cast<PyObject*>(handle)));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+static int int_getter(const char* fn, BoosterHandle handle, int* out) {
+  Gil g;
+  if (!g.ok) return -1;
+  PyObject* r =
+      bridge_call(fn, Py_BuildValue("(O)", reinterpret_cast<PyObject*>(handle)));
+  if (r == nullptr) return -1;
+  *out = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_BoosterGetCurrentIteration(BoosterHandle handle, int* out) {
+  return int_getter("booster_get_current_iteration", handle, out);
+}
+
+int LGBM_BoosterGetNumClasses(BoosterHandle handle, int* out) {
+  return int_getter("booster_get_num_classes", handle, out);
+}
+
+int LGBM_BoosterGetNumFeature(BoosterHandle handle, int* out) {
+  return int_getter("booster_get_num_feature", handle, out);
+}
+
+int LGBM_BoosterNumModelPerIteration(BoosterHandle handle, int* out) {
+  return int_getter("booster_num_model_per_iteration", handle, out);
+}
+
+int LGBM_BoosterGetEvalCounts(BoosterHandle handle, int* out) {
+  return int_getter("booster_get_eval_counts", handle, out);
+}
+
+int LGBM_BoosterGetEvalNames(BoosterHandle handle, const int len,
+                             int* out_len, const size_t buffer_len,
+                             size_t* out_buffer_len, char** out_strs) {
+  Gil g;
+  if (!g.ok) return -1;
+  PyObject* r = bridge_call(
+      "booster_get_eval_names",
+      Py_BuildValue("(O)", reinterpret_cast<PyObject*>(handle)));
+  if (r == nullptr) return -1;
+  Py_ssize_t n = PyList_Size(r);
+  *out_len = static_cast<int>(n);
+  size_t maxlen = 1;
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    Py_ssize_t sl = 0;
+    PyUnicode_AsUTF8AndSize(PyList_GetItem(r, i), &sl);
+    if (static_cast<size_t>(sl) + 1 > maxlen) maxlen = sl + 1;
+  }
+  *out_buffer_len = maxlen;
+  if (out_strs != nullptr) {
+    for (Py_ssize_t i = 0; i < n && i < len; ++i) {
+      Py_ssize_t sl = 0;
+      const char* c = PyUnicode_AsUTF8AndSize(PyList_GetItem(r, i), &sl);
+      size_t cp = static_cast<size_t>(sl) + 1 <= buffer_len
+                      ? static_cast<size_t>(sl) + 1
+                      : buffer_len;
+      if (cp > 0) {
+        std::memcpy(out_strs[i], c, cp - 1);
+        out_strs[i][cp - 1] = '\0';
+      }
+    }
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_BoosterGetEval(BoosterHandle handle, int data_idx, int* out_len,
+                        double* out_results) {
+  Gil g;
+  if (!g.ok) return -1;
+  PyObject* r = bridge_call(
+      "booster_get_eval",
+      Py_BuildValue("(Oi)", reinterpret_cast<PyObject*>(handle), data_idx));
+  if (r == nullptr) return -1;
+  Py_ssize_t n = PyList_Size(r);
+  *out_len = static_cast<int>(n);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    out_results[i] = PyFloat_AsDouble(PyList_GetItem(r, i));
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_BoosterPredictForMat(BoosterHandle handle, const void* data,
+                              int data_type, int32_t nrow, int32_t ncol,
+                              int is_row_major, int predict_type,
+                              int start_iteration, int num_iteration,
+                              const char* parameter, int64_t* out_len,
+                              double* out_result) {
+  Gil g;
+  if (!g.ok) return -1;
+  PyObject* r = bridge_call(
+      "booster_predict_for_mat",
+      Py_BuildValue("(ONiiiiiiis)", reinterpret_cast<PyObject*>(handle),
+                    mv_from(data, static_cast<Py_ssize_t>(nrow) * ncol *
+                                      dtype_size(data_type)),
+                    data_type, nrow, ncol, is_row_major, predict_type,
+                    start_iteration, num_iteration,
+                    parameter != nullptr ? parameter : ""));
+  if (r == nullptr) return -1;
+  PyObject* raw = PyTuple_GetItem(r, 0);
+  int64_t n = PyLong_AsLongLong(PyTuple_GetItem(r, 1));
+  *out_len = n;
+  char* buf = PyBytes_AsString(raw);
+  if (buf != nullptr && out_result != nullptr) {
+    std::memcpy(out_result, buf, static_cast<size_t>(n) * sizeof(double));
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_BoosterPredictForFile(BoosterHandle handle, const char* data_filename,
+                               int data_has_header, int predict_type,
+                               int start_iteration, int num_iteration,
+                               const char* parameter,
+                               const char* result_filename) {
+  Gil g;
+  if (!g.ok) return -1;
+  PyObject* r = bridge_call(
+      "booster_predict_for_file",
+      Py_BuildValue("(Osiiiiss)", reinterpret_cast<PyObject*>(handle),
+                    data_filename, data_has_header, predict_type,
+                    start_iteration, num_iteration,
+                    parameter != nullptr ? parameter : "", result_filename));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_BoosterSaveModel(BoosterHandle handle, int start_iteration,
+                          int num_iteration, int feature_importance_type,
+                          const char* filename) {
+  (void)feature_importance_type;
+  Gil g;
+  if (!g.ok) return -1;
+  PyObject* r = bridge_call(
+      "booster_save_model",
+      Py_BuildValue("(Oiis)", reinterpret_cast<PyObject*>(handle),
+                    start_iteration, num_iteration, filename));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_BoosterSaveModelToString(BoosterHandle handle, int start_iteration,
+                                  int num_iteration,
+                                  int feature_importance_type,
+                                  int64_t buffer_len, int64_t* out_len,
+                                  char* out_str) {
+  (void)feature_importance_type;
+  Gil g;
+  if (!g.ok) return -1;
+  PyObject* r = bridge_call(
+      "booster_save_model_to_string",
+      Py_BuildValue("(Oii)", reinterpret_cast<PyObject*>(handle),
+                    start_iteration, num_iteration));
+  if (r == nullptr) return -1;
+  int rc = copy_str_out(r, buffer_len, out_len, out_str);
+  Py_DECREF(r);
+  return rc;
+}
+
+int LGBM_BoosterDumpModel(BoosterHandle handle, int start_iteration,
+                          int num_iteration, int feature_importance_type,
+                          int64_t buffer_len, int64_t* out_len,
+                          char* out_str) {
+  (void)feature_importance_type;
+  Gil g;
+  if (!g.ok) return -1;
+  PyObject* r = bridge_call(
+      "booster_dump_model",
+      Py_BuildValue("(Oii)", reinterpret_cast<PyObject*>(handle),
+                    start_iteration, num_iteration));
+  if (r == nullptr) return -1;
+  int rc = copy_str_out(r, buffer_len, out_len, out_str);
+  Py_DECREF(r);
+  return rc;
+}
+
+int LGBM_BoosterFeatureImportance(BoosterHandle handle, int num_iteration,
+                                  int importance_type, double* out_results) {
+  Gil g;
+  if (!g.ok) return -1;
+  PyObject* r = bridge_call(
+      "booster_feature_importance",
+      Py_BuildValue("(Oii)", reinterpret_cast<PyObject*>(handle),
+                    num_iteration, importance_type));
+  if (r == nullptr) return -1;
+  char* buf = PyBytes_AsString(r);
+  Py_ssize_t nbytes = PyBytes_Size(r);
+  if (buf != nullptr) std::memcpy(out_results, buf, nbytes);
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_CAPIVersion() { return 1; }
+
+}  // extern "C"

@@ -1,0 +1,97 @@
+/* C API of lightgbm_tpu — the reference's LGBM_* handle surface
+ * (include/LightGBM/c_api.h) re-implemented over the TPU framework.
+ *
+ * All functions return 0 on success, -1 on error; LGBM_GetLastError()
+ * returns the error message for the calling thread. */
+#ifndef LIGHTGBM_TPU_C_API_H_
+#define LIGHTGBM_TPU_C_API_H_
+
+#include <stdint.h>
+#include <stddef.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef void* DatasetHandle;
+typedef void* BoosterHandle;
+
+#define C_API_DTYPE_FLOAT32 (0)
+#define C_API_DTYPE_FLOAT64 (1)
+#define C_API_DTYPE_INT32 (2)
+#define C_API_DTYPE_INT64 (3)
+
+#define C_API_PREDICT_NORMAL (0)
+#define C_API_PREDICT_RAW_SCORE (1)
+#define C_API_PREDICT_LEAF_INDEX (2)
+#define C_API_PREDICT_CONTRIB (3)
+
+const char* LGBM_GetLastError(void);
+int LGBM_CAPIVersion(void);
+
+int LGBM_DatasetCreateFromMat(const void* data, int data_type, int32_t nrow,
+                              int32_t ncol, int is_row_major,
+                              const char* parameters, DatasetHandle reference,
+                              DatasetHandle* out);
+int LGBM_DatasetCreateFromFile(const char* filename, const char* parameters,
+                               DatasetHandle reference, DatasetHandle* out);
+int LGBM_DatasetSetField(DatasetHandle handle, const char* field_name,
+                         const void* field_data, int num_element, int type);
+int LGBM_DatasetGetNumData(DatasetHandle handle, int32_t* out);
+int LGBM_DatasetGetNumFeature(DatasetHandle handle, int32_t* out);
+int LGBM_DatasetSaveBinary(DatasetHandle handle, const char* filename);
+int LGBM_DatasetFree(DatasetHandle handle);
+
+int LGBM_BoosterCreate(DatasetHandle train_data, const char* parameters,
+                       BoosterHandle* out);
+int LGBM_BoosterCreateFromModelfile(const char* filename,
+                                    int* out_num_iterations,
+                                    BoosterHandle* out);
+int LGBM_BoosterLoadModelFromString(const char* model_str,
+                                    int* out_num_iterations,
+                                    BoosterHandle* out);
+int LGBM_BoosterFree(BoosterHandle handle);
+int LGBM_BoosterAddValidData(BoosterHandle handle, DatasetHandle valid_data);
+int LGBM_BoosterUpdateOneIter(BoosterHandle handle, int* is_finished);
+int LGBM_BoosterRollbackOneIter(BoosterHandle handle);
+int LGBM_BoosterGetCurrentIteration(BoosterHandle handle, int* out);
+int LGBM_BoosterGetNumClasses(BoosterHandle handle, int* out);
+int LGBM_BoosterGetNumFeature(BoosterHandle handle, int* out);
+int LGBM_BoosterNumModelPerIteration(BoosterHandle handle, int* out);
+int LGBM_BoosterGetEvalCounts(BoosterHandle handle, int* out);
+int LGBM_BoosterGetEvalNames(BoosterHandle handle, const int len,
+                             int* out_len, const size_t buffer_len,
+                             size_t* out_buffer_len, char** out_strs);
+int LGBM_BoosterGetEval(BoosterHandle handle, int data_idx, int* out_len,
+                        double* out_results);
+int LGBM_BoosterPredictForMat(BoosterHandle handle, const void* data,
+                              int data_type, int32_t nrow, int32_t ncol,
+                              int is_row_major, int predict_type,
+                              int start_iteration, int num_iteration,
+                              const char* parameter, int64_t* out_len,
+                              double* out_result);
+int LGBM_BoosterPredictForFile(BoosterHandle handle, const char* data_filename,
+                               int data_has_header, int predict_type,
+                               int start_iteration, int num_iteration,
+                               const char* parameter,
+                               const char* result_filename);
+int LGBM_BoosterSaveModel(BoosterHandle handle, int start_iteration,
+                          int num_iteration, int feature_importance_type,
+                          const char* filename);
+int LGBM_BoosterSaveModelToString(BoosterHandle handle, int start_iteration,
+                                  int num_iteration,
+                                  int feature_importance_type,
+                                  int64_t buffer_len, int64_t* out_len,
+                                  char* out_str);
+int LGBM_BoosterDumpModel(BoosterHandle handle, int start_iteration,
+                          int num_iteration, int feature_importance_type,
+                          int64_t buffer_len, int64_t* out_len,
+                          char* out_str);
+int LGBM_BoosterFeatureImportance(BoosterHandle handle, int num_iteration,
+                                  int importance_type, double* out_results);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif  /* LIGHTGBM_TPU_C_API_H_ */

@@ -3,7 +3,10 @@
 Reference: ``Tree::PredictLeafIndex`` and ``Tree::PredictContrib`` (TreeSHAP,
 ``src/io/tree.cpp``; surfaced via ``GBDT::PredictContrib``, ``gbdt.cpp:640``).
 Branchy recursion — kept host-side exactly as the reference keeps it on CPU
-even in CUDA mode.
+even in CUDA mode.  Fast paths run in the native C++ module
+(``native/csrc/native.cpp`` ``ltpu_predict_leaf_index`` / ``ltpu_tree_shap``);
+the Python implementations below are the portable fallback and the oracle the
+native code is tested against.
 """
 
 from __future__ import annotations
@@ -31,7 +34,12 @@ def _decide_left(tree, node: int, bins_row: np.ndarray,
 
 def predict_leaf_index(gbdt, X: np.ndarray, start_iteration: int = 0,
                        num_iteration: Optional[int] = None) -> np.ndarray:
-    """(N, num_trees) leaf index matrix (reference ``predict_leaf_index``)."""
+    """(N, num_trees) leaf index matrix (reference ``predict_leaf_index``).
+
+    Native C++ traversal when available; vectorized numpy frontier walk
+    (``Tree.predict_leaf_bins``) otherwise."""
+    from . import native
+
     bins = gbdt.train_data.binned.apply(X)
     nan_bins = gbdt.train_data.binned.nan_bins
     all_trees = []
@@ -43,25 +51,20 @@ def predict_leaf_index(gbdt, X: np.ndarray, start_iteration: int = 0,
     n = bins.shape[0]
     t_per_class = max(len(t) for t in all_trees) if all_trees else 0
     out = np.zeros((n, t_per_class * gbdt.num_class), np.int32)
+    use_native = native.available()
+    if use_native:
+        # one widen+copy for the whole ensemble, not one per tree
+        bins = np.ascontiguousarray(bins, np.uint16)
     for ti in range(t_per_class):
         for k in range(gbdt.num_class):
             tree = all_trees[k][ti]
             col = ti * gbdt.num_class + k
             if tree.num_leaves <= 1:
                 continue
-            node = np.zeros(n, np.int32)
-            active = np.ones(n, bool)
-            while active.any():
-                idx = np.nonzero(active)[0]
-                for i in idx:
-                    nd = node[i]
-                    go_left = _decide_left(tree, nd, bins[i], nan_bins)
-                    nxt = tree.left_child[nd] if go_left else tree.right_child[nd]
-                    if nxt < 0:
-                        out[i, col] = ~nxt
-                        active[i] = False
-                    else:
-                        node[i] = nxt
+            li = (native.predict_leaf_index(bins, nan_bins, tree)
+                  if use_native else None)
+            out[:, col] = (li if li is not None
+                           else tree.predict_leaf_bins(bins, nan_bins))
     return out
 
 
@@ -182,23 +185,33 @@ def predict_contrib(gbdt, X: np.ndarray, start_iteration: int = 0,
     n = bins.shape[0]
     nf = gbdt.train_data.num_features
     k = gbdt.num_class
+    from . import native
+
     out = np.zeros((n, (nf + 1) * k))
+    use_native = native.available()
     for kk in range(k):
         trees = gbdt.models[kk]
         end = len(trees) if num_iteration is None else min(
             len(trees), start_iteration + num_iteration)
+        window = trees[start_iteration:end]
         base = gbdt.init_scores[kk]
         col0 = kk * (nf + 1)
-        for tree in trees[start_iteration:end]:
-            ev = _tree_expected_value(tree)
-            base += ev
-            if tree.num_leaves <= 1:
-                continue
-            for i in range(n):
-                phi = np.zeros(nf + 1)
-                _tree_shap_recurse(tree, bins[i], nan_bins, phi,
-                                   # root is node 0 (as internal), encode >=0
-                                   0, [], 1.0, 1.0, -1, 0.0)
-                out[i, col0: col0 + nf] += phi[:nf]
+        contrib = native.tree_shap(bins, nan_bins, window) \
+            if use_native else None
+        if contrib is not None:
+            out[:, col0: col0 + nf] += contrib[:, :nf]
+            for tree in window:
+                base += _tree_expected_value(tree)
+        else:
+            for tree in window:
+                base += _tree_expected_value(tree)
+                if tree.num_leaves <= 1:
+                    continue
+                for i in range(n):
+                    phi = np.zeros(nf + 1)
+                    _tree_shap_recurse(tree, bins[i], nan_bins, phi,
+                                       # root is node 0 (internal), >= 0
+                                       0, [], 1.0, 1.0, -1, 0.0)
+                    out[i, col0: col0 + nf] += phi[:nf]
         out[:, col0 + nf] = base
     return out

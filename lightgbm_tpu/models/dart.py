@@ -23,6 +23,13 @@ class DART(GBDT):
         self.drop_rng = np.random.RandomState(cfg.drop_seed)
 
     def _tree_pred_idx(self, k: int, idx: int, bins):
+        pred = self._tree_pred_idx_raw(k, idx, bins)
+        # bins_dev may carry shard-padding rows (data meshes); scores do not.
+        if bins is self.bins_dev:
+            return pred[:self.scores.shape[0]]
+        return pred
+
+    def _tree_pred_idx_raw(self, k: int, idx: int, bins):
         return predict_tree_bins_device(
             _tree_dict(self.dev_models[k][idx]), bins,
             self.meta_dev["nan_bins"])

@@ -713,6 +713,9 @@ class GBDT:
             dev_tree = _tree_dict(arrays)
             pred = predict_tree_bins_device(
                 dev_tree, self.bins_dev, self.meta_dev["nan_bins"])
+            # bins_dev may carry shard-padding rows (data meshes); scores
+            # do not.
+            pred = pred[:self.scores.shape[0]]
             if self._shape_k:
                 self.scores = self.scores.at[:, k].add(-pred)
             else:

@@ -373,7 +373,12 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
             jnp.arange(k_child)[:, None], top_idx].add(1)
         votes = jax.lax.psum(votes, axis)
         gsum = jax.lax.psum(jnp.where(jnp.isfinite(fg), fg, 0.0), axis)
-        score = votes.astype(jnp.float32) * 1e6 + gsum
+        # Rank by votes with gain strictly as tie-break (reference
+        # GlobalVoting orders by vote count): normalize gains into [0, 1)
+        # so they can never outweigh one vote.
+        gmax = jnp.max(gsum, axis=-1, keepdims=True)
+        tie = gsum / jnp.maximum(gmax * (1.0 + 1e-6), 1e-30)
+        score = votes.astype(jnp.float32) + tie
         _, sel = jax.lax.top_k(score, sel_k)           # (k, 2k) replicated
         hist_sel = jnp.take_along_axis(
             hist_loc, sel[:, :, None, None], axis=1)   # (k, 2k, B, 3) local
@@ -615,6 +620,21 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
             return perm, nl_phys
         return branch
 
+    def _hist_branch_for(bins_pad, vals_pad, n, S):
+        """RAW histogram of a contiguous perm range of static size S (the
+        smaller sibling — the larger one comes from parent-hist subtraction,
+        the reference's FeatureHistogram::Subtract).  Padded slots hit the
+        phantom zero row.  Shared by the perm and wave layouts."""
+        def branch(perm, start, cnt):
+            seg = jax.lax.dynamic_slice(perm, (start,), (S,))
+            valid = jnp.arange(S, dtype=jnp.int32) < cnt
+            seg = jnp.where(valid, seg, n)
+            return histogram_from_vals(
+                bins_pad[seg], vals_pad[seg], num_bins=B,
+                impl=cfg.histogram_impl,
+                rows_block=min(cfg.rows_block, S))
+        return branch
+
     def _root_best(state, scale3, meta, feature_mask, root_pen,
                    groups_mat=None):
         """Root split search (shared by both layouts)."""
@@ -706,25 +726,10 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
          max_bucket) = _perm_setup(bins, vals, scale3, meta, feature_mask,
                                    cegb, key, groups_mat, axis)
 
-        def _make_hist_branch(S):
-            """RAW histogram of a contiguous child range (the smaller
-            sibling — the larger one comes from parent-hist subtraction, the
-            reference's FeatureHistogram::Subtract)."""
-            def branch(perm, start, cnt):
-                seg = jax.lax.dynamic_slice(perm, (start,), (S,))
-                valid = jnp.arange(S, dtype=jnp.int32) < cnt
-                seg = jnp.where(valid, seg, n)
-                bseg = bins_pad[seg]                       # (S, F)
-                vseg = vals_pad[seg]                       # (S, 3)
-                return histogram_from_vals(
-                    bseg, vseg, num_bins=B,
-                    impl=cfg.histogram_impl,
-                    rows_block=min(cfg.rows_block, S))
-            return branch
-
         part_branches = [_part_branch_for(bins_pad, nan_bins, S)
                          for S in buckets]
-        hist_branches = [_make_hist_branch(S) for S in buckets]
+        hist_branches = [_hist_branch_for(bins_pad, vals_pad, n, S)
+                         for S in buckets]
 
         def _bucket_of(cnt):
             return jnp.clip(jnp.searchsorted(buckets_arr, cnt, side="left"),
@@ -810,22 +815,10 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
          max_bucket) = _perm_setup(bins, vals, scale3, meta, feature_mask,
                                    cegb, key, groups_mat, axis)
 
-        def _make_hist_branch(S):
-            """RAW histogram of one sibling's contiguous perm range (padded
-            rows hit the phantom zero row)."""
-            def branch(perm, start, cnt):
-                seg = jax.lax.dynamic_slice(perm, (start,), (S,))
-                valid = jnp.arange(S, dtype=jnp.int32) < cnt
-                seg = jnp.where(valid, seg, n)
-                return histogram_from_vals(
-                    bins_pad[seg], vals_pad[seg], num_bins=B,
-                    impl=cfg.histogram_impl,
-                    rows_block=min(cfg.rows_block, S))
-            return branch
-
         part_branches = [_part_branch_for(bins_pad, nan_bins, S)
                          for S in buckets]
-        hist_branches = [_make_hist_branch(S) for S in buckets]
+        hist_branches = [_hist_branch_for(bins_pad, vals_pad, n, S)
+                         for S in buckets]
 
         def _bucket_of(cnt):
             return jnp.clip(jnp.searchsorted(buckets_arr, cnt, side="left"),
@@ -1073,6 +1066,14 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
         """Mask-layout growth (sharding-friendly; full-N pass per split)."""
         n, f = bins.shape
         groups_mat = _groups_matrix(f) if use_groups else None
+        # Under a mesh this path runs on GSPMD-sharded operands OUTSIDE
+        # shard_map; the pallas kernel is per-device-only, so route 'auto'
+        # to the partitionable einsum/scatter impls.
+        mask_impl = cfg.histogram_impl
+        if mesh is not None and mask_impl in ("auto", "pallas", "flat",
+                                              "flat_bf16"):
+            mask_impl = ("onehot" if jax.default_backend() == "tpu"
+                         else "segment")
 
         def hist_for(mask):
             # vals already carries bagging weights + in-bag zeroing; the
@@ -1081,11 +1082,11 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
             masked = jnp.where(mask[:, None], vals, jnp.zeros_like(vals))
             return histogram_from_vals(
                 bins, masked, num_bins=B,
-                impl=cfg.histogram_impl, rows_block=cfg.rows_block)
+                impl=mask_impl, rows_block=cfg.rows_block)
 
         nan_bins = meta[1]
         root_hist = histogram_from_vals(
-            bins, vals, num_bins=B, impl=cfg.histogram_impl,
+            bins, vals, num_bins=B, impl=mask_impl,
             rows_block=cfg.rows_block)
         root_tot = jnp.sum(_scale_hist(root_hist[0:1], scale3)[0], axis=0)
         root_g, root_h, root_c = root_tot[0], root_tot[1], root_tot[2]
@@ -1158,8 +1159,13 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
         reference's histogram reduce, ``data_parallel_tree_learner.cpp:284``).
         All split decisions derive from the replicated psum'd histograms, so
         the tree state is replicated and the while_loop stays in lockstep."""
-        from jax.experimental.shard_map import shard_map
         from jax.sharding import PartitionSpec as P
+        try:
+            from jax import shard_map          # jax >= 0.8
+            smap_kw = {"check_vma": False}
+        except ImportError:                    # pragma: no cover
+            from jax.experimental.shard_map import shard_map
+            smap_kw = {"check_rep": False}
 
         grow_fn = (_grow_wave if (cfg.leaf_batch > 1 or cfg.voting)
                    else _grow_perm)
@@ -1196,7 +1202,7 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
             in_specs=(P(data_axis), P(data_axis), P(), P(), P(), P(), P())
             + tuple(especs),
             out_specs=(P(), P(data_axis)),
-            check_rep=False,
+            **smap_kw,
         )(bins, vals, feature_mask, *meta, *extras)
 
     @functools.partial(jax.jit, donate_argnums=())

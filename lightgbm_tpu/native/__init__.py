@@ -69,7 +69,7 @@ def _load():
         f64p = np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")
 
         lib.ltpu_version.restype = ctypes.c_int
-        if lib.ltpu_version() != 1:
+        if lib.ltpu_version() != 2:
             return None
         lib.ltpu_parse_file.restype = ctypes.c_void_p
         lib.ltpu_parse_file.argtypes = [
@@ -93,6 +93,10 @@ def _load():
         lib.ltpu_predict_leaf_index.argtypes = [
             u16p, _i64, _i64, i32p, _i64, i32p, i32p, u8p, u8p, u32p,
             ctypes.c_int, i32p, i32p, i32p]
+        lib.ltpu_tree_shap.argtypes = [
+            u16p, _i64, _i64, i32p, ctypes.c_int, i64p, i64p, i32p, i32p,
+            u8p, u8p, u32p, ctypes.c_int, i32p, i32p, f64p, f64p, f64p,
+            f64p]
         _lib = lib
         return _lib
 
@@ -205,6 +209,62 @@ def pack_cat_masks(cat_mask: np.ndarray) -> np.ndarray:
     return (bits.astype(np.uint64) * weights).sum(axis=2).astype(np.uint32)
 
 
+def _flatten_trees(trees, with_counts=False):
+    """Concatenated-tree layout shared by ltpu_predict_bins/ltpu_tree_shap:
+    node_offsets/leaf_offsets delimit each tree's node/leaf ranges; cat masks
+    are packed to a common word width.  ``with_counts`` adds the
+    leaf_count/internal_count arrays only TreeSHAP needs."""
+    node_off, leaf_off = [0], [0]
+    sf, sb, dl, ic, lc, rc, lv, lcnt, icnt, masks = \
+        [], [], [], [], [], [], [], [], [], []
+    max_b = 1
+    for t in trees:
+        max_b = max(max_b, t.cat_mask.shape[1] if t.cat_mask.size else 1)
+    words = max((max_b + 31) // 32, 1)
+    for t in trees:
+        m = t.num_splits()
+        node_off.append(node_off[-1] + m)
+        nl = max(t.num_leaves, 1)
+        leaf_off.append(leaf_off[-1] + nl)
+        sf.append(t.split_feature[:m])
+        sb.append(t.split_bin[:m])
+        dl.append(t.default_left[:m])
+        ic.append(t.is_cat[:m])
+        lc.append(t.left_child[:m])
+        rc.append(t.right_child[:m])
+        lv.append(t.leaf_value[:nl] if len(t.leaf_value) else np.zeros(1))
+        if with_counts:
+            lcnt.append(t.leaf_count[:nl] if len(t.leaf_count)
+                        else np.zeros(1))
+            icnt.append(t.internal_count[:m])
+        if m:
+            cm = np.zeros((m, max_b), bool)
+            cm[:, :t.cat_mask.shape[1]] = t.cat_mask[:m]
+            masks.append(pack_cat_masks(cm))
+        else:
+            masks.append(np.zeros((0, words), np.uint32))
+    cat = (np.concatenate(masks, axis=0) if masks
+           else np.zeros((0, words), np.uint32))
+
+    def _f64cat(parts):
+        return np.ascontiguousarray(
+            np.concatenate(parts) if parts else np.zeros(1), np.float64)
+
+    out = {
+        "node_off": np.asarray(node_off, np.int64),
+        "leaf_off": np.asarray(leaf_off, np.int64),
+        "sf": _cat_i32(sf), "sb": _cat_i32(sb),
+        "dl": _cat_u8(dl), "ic": _cat_u8(ic),
+        "cat": np.ascontiguousarray(cat), "words": words,
+        "lc": _cat_i32(lc), "rc": _cat_i32(rc),
+        "lv": _f64cat(lv),
+    }
+    if with_counts:
+        out["lcnt"] = _f64cat(lcnt)
+        out["icnt"] = _f64cat(icnt)
+    return out
+
+
 def predict_bins(bins: np.ndarray, nan_bins: np.ndarray, trees,
                  out: Optional[np.ndarray] = None) -> Optional[np.ndarray]:
     """Sum of tree outputs over binned rows. ``trees``: list of Tree
@@ -214,42 +274,34 @@ def predict_bins(bins: np.ndarray, nan_bins: np.ndarray, trees,
         return None
     bins = np.ascontiguousarray(bins, np.uint16)
     n, f = bins.shape
-    node_off = [0]
-    leaf_off = [0]
-    sf, sb, dl, ic, lc, rc, lv, masks = [], [], [], [], [], [], [], []
-    max_b = 1
-    for t in trees:
-        max_b = max(max_b, t.cat_mask.shape[1] if t.cat_mask.size else 1)
-    words = max((max_b + 31) // 32, 1)
-    for t in trees:
-        m = t.num_splits()
-        node_off.append(node_off[-1] + m)
-        leaf_off.append(leaf_off[-1] + max(t.num_leaves, 1))
-        sf.append(t.split_feature[:m])
-        sb.append(t.split_bin[:m])
-        dl.append(t.default_left[:m])
-        ic.append(t.is_cat[:m])
-        lc.append(t.left_child[:m])
-        rc.append(t.right_child[:m])
-        lv.append(t.leaf_value[:max(t.num_leaves, 1)]
-                  if len(t.leaf_value) else np.zeros(1))
-        if m:
-            cm = np.zeros((m, max_b), bool)
-            cm[:, :t.cat_mask.shape[1]] = t.cat_mask[:m]
-            masks.append(pack_cat_masks(cm))
-        else:
-            masks.append(np.zeros((0, words), np.uint32))
+    t = _flatten_trees(trees)
     if out is None:
         out = np.zeros(n, np.float64)
-    cat = (np.concatenate(masks, axis=0) if masks
-           else np.zeros((0, words), np.uint32))
     lib.ltpu_predict_bins(
         bins, n, f, np.ascontiguousarray(nan_bins, np.int32), len(trees),
-        np.asarray(node_off, np.int64), np.asarray(leaf_off, np.int64),
-        _cat_i32(sf), _cat_i32(sb), _cat_u8(dl), _cat_u8(ic),
-        np.ascontiguousarray(cat), words, _cat_i32(lc), _cat_i32(rc),
-        np.ascontiguousarray(np.concatenate(lv) if lv else np.zeros(1),
-                             np.float64), out)
+        t["node_off"], t["leaf_off"], t["sf"], t["sb"], t["dl"], t["ic"],
+        t["cat"], t["words"], t["lc"], t["rc"], t["lv"], out)
+    return out
+
+
+def tree_shap(bins: np.ndarray, nan_bins: np.ndarray,
+              trees) -> Optional[np.ndarray]:
+    """Path-dependent TreeSHAP over binned rows for a tree list; returns
+    (n, f+1) f64 contributions (expected-value column left zero — the caller
+    adds per-tree expected values).  Reference ``Tree::PredictContrib``
+    (``src/io/tree.cpp``)."""
+    lib = _load()
+    if lib is None:
+        return None
+    bins = np.ascontiguousarray(bins, np.uint16)
+    n, f = bins.shape
+    t = _flatten_trees(trees, with_counts=True)
+    out = np.zeros((n, f + 1), np.float64)
+    lib.ltpu_tree_shap(
+        bins, n, f, np.ascontiguousarray(nan_bins, np.int32), len(trees),
+        t["node_off"], t["leaf_off"], t["sf"], t["sb"], t["dl"], t["ic"],
+        t["cat"], t["words"], t["lc"], t["rc"], t["lv"], t["lcnt"],
+        t["icnt"], out)
     return out
 
 

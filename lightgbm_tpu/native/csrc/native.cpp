@@ -440,6 +440,160 @@ void ltpu_predict_leaf_index(const uint16_t* bins, int64_t n, int64_t f,
   }
 }
 
-int ltpu_version() { return 1; }
+// ---------------------------------------------------------------- TreeSHAP
+// Reference Tree::PredictContrib / TreeSHAP (src/io/tree.cpp; Lundberg &
+// Lee's path-dependent algorithm).  Same concatenated-tree layout as
+// ltpu_predict_bins; internal_count is per-node, leaf_value/leaf_count per
+// leaf.  out is (n, f+1) f64, ACCUMULATED (caller zeros; column f unused
+// here — the expected-value column is filled by the Python wrapper).
+struct LtpuShapPath {
+  int fidx;
+  double zero, one, pw;
+};
+
+static bool ltpu_shap_go_left(const uint16_t* row, const int32_t* nan_bins,
+                              const int32_t* sf, const int32_t* sb,
+                              const uint8_t* dl, const uint8_t* ic,
+                              const uint32_t* cm, int cw, int node) {
+  int fi = sf[node];
+  int col = row[fi];
+  if (ic[node]) {
+    return (col < cw * 32) &&
+           ((cm[static_cast<int64_t>(node) * cw + (col >> 5)] >> (col & 31)) &
+            1u);
+  }
+  if (col == nan_bins[fi]) return dl[node] != 0;
+  return col <= sb[node];
+}
+
+// Path-dependent TreeSHAP with the standard single contiguous path buffer
+// (one allocation per tree, reused across rows): each recursion level copies
+// the parent's live entries to its own slice of `buf` — no per-call heap
+// allocation.  ``unique_depth`` = number of live entries BEFORE this level's
+// extend; after extend, entries are 0..unique_depth.
+static void ltpu_shap_recurse(
+    const uint16_t* row, const int32_t* nan_bins, const int32_t* sf,
+    const int32_t* sb, const uint8_t* dl, const uint8_t* ic,
+    const uint32_t* cm, int cw, const int32_t* lc, const int32_t* rc,
+    const double* lv, const double* lcnt, const double* icnt, double* phi,
+    int node, LtpuShapPath* parent_path, int unique_depth, double pz,
+    double po, int pf, double cover) {
+  LtpuShapPath* path = parent_path + unique_depth + 1;
+  for (int i = 0; i < unique_depth; ++i) path[i] = parent_path[i];
+  // extend
+  path[unique_depth] = {pf, pz, po, unique_depth == 0 ? 1.0 : 0.0};
+  int m = unique_depth;
+  for (int i = m - 1; i >= 0; --i) {
+    path[i + 1].pw += po * path[i].pw * (i + 1) / double(m + 1);
+    path[i].pw = pz * path[i].pw * (m - i) / double(m + 1);
+  }
+  if (node < 0) {
+    int leaf = ~node;
+    for (int i = 1; i <= m; ++i) {
+      double one = path[i].one, zero = path[i].zero;
+      double total = 0.0, nw = path[m].pw;
+      for (int j = m - 1; j >= 0; --j) {
+        if (one != 0.0) {
+          double t = nw * (m + 1) / ((j + 1) * one);
+          total += t;
+          nw = path[j].pw - t * zero * (m - j) / double(m + 1);
+        } else {
+          total += path[j].pw / (zero * (m - j) / double(m + 1));
+        }
+      }
+      phi[path[i].fidx] += total * (path[i].one - path[i].zero) * lv[leaf];
+    }
+    return;
+  }
+  int fi = sf[node];
+  bool go_left = ltpu_shap_go_left(row, nan_bins, sf, sb, dl, ic, cm, cw, node);
+  int hot = go_left ? lc[node] : rc[node];
+  int cold = go_left ? rc[node] : lc[node];
+  double hotc = hot < 0 ? lcnt[~hot] : icnt[hot];
+  double coldc = cold < 0 ? lcnt[~cold] : icnt[cold];
+  double nodec = cover > 0 ? cover : hotc + coldc;
+  if (nodec < 1e-30) nodec = 1e-30;
+  double iz = 1.0, io = 1.0;
+  int pidx = -1;
+  for (int i = 1; i <= m; ++i) {
+    if (path[i].fidx == fi) {
+      pidx = i;
+      break;
+    }
+  }
+  int entries = m + 1;
+  if (pidx >= 0) {
+    iz = path[pidx].zero;
+    io = path[pidx].one;
+    // unwind pidx out of the path
+    double one = path[pidx].one, zero = path[pidx].zero, nw = path[m].pw;
+    for (int j = m - 1; j >= 0; --j) {
+      if (one != 0.0) {
+        double t = path[j].pw;
+        path[j].pw = nw * (m + 1) / ((j + 1) * one);
+        nw = t - path[j].pw * zero * (m - j) / double(m + 1);
+      } else {
+        path[j].pw = path[j].pw * (m + 1) / (zero * (m - j));
+      }
+    }
+    for (int j = pidx; j < m; ++j) {
+      path[j].fidx = path[j + 1].fidx;
+      path[j].zero = path[j + 1].zero;
+      path[j].one = path[j + 1].one;
+    }
+    entries = m;
+  }
+  ltpu_shap_recurse(row, nan_bins, sf, sb, dl, ic, cm, cw, lc, rc, lv, lcnt,
+                    icnt, phi, hot, path, entries, iz * hotc / nodec, io, fi,
+                    hotc);
+  ltpu_shap_recurse(row, nan_bins, sf, sb, dl, ic, cm, cw, lc, rc, lv, lcnt,
+                    icnt, phi, cold, path, entries, iz * coldc / nodec, 0.0,
+                    fi, coldc);
+}
+
+void ltpu_tree_shap(const uint16_t* bins, int64_t n, int64_t f,
+                    const int32_t* nan_bins, int num_trees,
+                    const int64_t* node_offsets, const int64_t* leaf_offsets,
+                    const int32_t* split_feature, const int32_t* split_bin,
+                    const uint8_t* default_left, const uint8_t* is_cat,
+                    const uint32_t* cat_mask, int cat_words,
+                    const int32_t* left_child, const int32_t* right_child,
+                    const double* leaf_value, const double* leaf_count,
+                    const double* internal_count, double* out) {
+  for (int t = 0; t < num_trees; ++t) {
+    int64_t nb = node_offsets[t];
+    int64_t nn = node_offsets[t + 1] - nb;
+    if (nn == 0) continue;
+    const int32_t* lc = left_child + nb;
+    const int32_t* rc = right_child + nb;
+    // Exact max depth: children are always allocated after their parent in
+    // the tree builder, so one forward pass suffices.
+    std::vector<int> dep(nn, 1);
+    int maxd = 1;
+    for (int64_t i = 0; i < nn; ++i) {
+      const int32_t ch[2] = {lc[i], rc[i]};
+      for (int32_t c : ch) {
+        if (c >= 0 && c < nn) {
+          dep[c] = dep[i] + 1;
+          if (dep[c] > maxd) maxd = dep[c];
+        }
+      }
+    }
+    maxd += 1;  // leaves sit one level below the deepest internal node
+    std::vector<LtpuShapPath> buf(
+        static_cast<size_t>(maxd + 3) * (maxd + 4) / 2);
+    for (int64_t i = 0; i < n; ++i) {
+      ltpu_shap_recurse(bins + i * f, nan_bins, split_feature + nb,
+                        split_bin + nb, default_left + nb, is_cat + nb,
+                        cat_mask + nb * cat_words, cat_words, lc, rc,
+                        leaf_value + leaf_offsets[t],
+                        leaf_count + leaf_offsets[t], internal_count + nb,
+                        out + i * (f + 1), 0, buf.data(), 0, 1.0, 1.0, -1,
+                        0.0);
+    }
+  }
+}
+
+int ltpu_version() { return 2; }
 
 }  // extern "C"

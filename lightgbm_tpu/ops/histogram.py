@@ -120,43 +120,6 @@ def histogram_from_vals(
     raise ValueError(f"unknown histogram impl: {impl}")
 
 
-def histogram_sib_from_vals(
-    bins: jnp.ndarray,   # (S, F) gathered rows
-    vals: jnp.ndarray,   # (S, 3)
-    sib: jnp.ndarray,    # (S,) i32 sibling slot in [0, num_sibs); -1 = pad
-    *,
-    num_bins: int,
-    num_sibs: int,
-    impl: str = "auto",
-    rows_block: int = 0,
-) -> jnp.ndarray:        # (num_sibs, F, num_bins, 3)
-    """Multi-sibling histogram for wave growth: every sibling's histogram in
-    one pass (the per-wave analog of the reference's per-leaf
-    ``ConstructHistogramForLeaf``, ``cuda_histogram_constructor.cu:18``)."""
-    if impl == "auto":
-        impl = "pallas" if jax.default_backend() == "tpu" else "segment"
-    if impl in ("pallas", "flat", "flat_bf16"):
-        from .pallas_histogram import histogram_flat_sib
-        if jnp.issubdtype(vals.dtype, jnp.integer):
-            return histogram_flat_sib(bins, vals, sib, num_bins=num_bins,
-                                      num_sibs=num_sibs,
-                                      rows_block=rows_block, dtype="int8")
-        return histogram_flat_sib(
-            bins, vals, sib, num_bins=num_bins, num_sibs=num_sibs,
-            rows_block=rows_block,
-            dtype="bf16" if impl == "flat_bf16" else "f32")
-    # Scatter fallback (CPU): one extra slot absorbs padding rows.
-    s, f = bins.shape
-    integer = jnp.issubdtype(vals.dtype, jnp.integer)
-    acc_dtype = jnp.int32 if integer else vals.dtype
-    sibc = jnp.where((sib >= 0) & (sib < num_sibs), sib, num_sibs)
-    flat_ids = ((sibc[:, None] * f + jnp.arange(f, dtype=jnp.int32)[None, :])
-                * num_bins + bins.astype(jnp.int32))
-    hist = jnp.zeros(((num_sibs + 1) * f * num_bins, 3), dtype=acc_dtype)
-    hist = hist.at[flat_ids].add(vals.astype(acc_dtype)[:, None, :])
-    return hist.reshape(num_sibs + 1, f, num_bins, 3)[:num_sibs]
-
-
 def build_histogram(
     bins: jnp.ndarray,
     grad: jnp.ndarray,

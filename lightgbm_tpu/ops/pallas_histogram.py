@@ -6,7 +6,7 @@ shared-mem scatter-add + atomics).  TPUs have no atomics and scatters
 serialize, so the kernel computes the histogram as a **matmul against a
 flattened one-hot**, generated inside VMEM:
 
-    out[(l, c), f*B + b] = sum_n  vals[n, c] * (sib[n] == l) * (bins[n, f] == b)
+    out[c, f*B + b] = sum_n  vals[n, c] * (bins[n, f] == b)
 
 Why this shape wins on the MXU:
 
@@ -18,11 +18,12 @@ Why this shape wins on the MXU:
   are chunked at trace time into separate same-shaped calls so the VMEM
   one-hot stays bounded (and every BlockSpec dim is Mosaic-legal: the
   feature dim always equals the array dim, row blocks are 128-multiples).
-- The M dimension carries (sibling x channel).  Growing multiple leaves per
-  wave packs M up to 128 (16 siblings x 8 channels), so the systolic array's
-  row dimension is fully used while the streamed K x N volume stays
-  proportional to the rows actually histogrammed (the reference's
-  smaller-sibling trick, ``serial_tree_learner.cpp:369``).
+- The kernel is HBM-bandwidth-bound (bins + vals streams), so the wave
+  grower issues one bandwidth-optimal call per smaller sibling instead of
+  packing siblings into the matmul M dimension (measured ~100x faster on
+  v5e than an M-packed multi-sibling kernel); the streamed volume stays
+  proportional to the rows actually histogrammed — the reference's
+  smaller-sibling trick (``serial_tree_learner.cpp:369``).
 - int8 variant: s8 vals x s8 one-hot -> s32 accumulation — the reference's
   quantized-training histograms (``Int32HistogramSumReducer``, ``bin.h:48``)
   on the MXU's double-rate int8 path.
@@ -50,10 +51,9 @@ _DTYPES = {
 
 
 def _pick_tiles(f: int, num_bins: int, itemsize: int, rows_block: int,
-                num_sibs: int = 1, acc_size: int = 4):
+                acc_size: int = 4):
     """(rows_block, features_per_chunk) bounding the kernel's VMEM working
-    set (the in-VMEM one-hot PLUS the (num_sibs*C_PAD, ft*B) accumulator
-    block) to ~12MB.
+    set (the in-VMEM one-hot PLUS the (C_PAD, ft*B) accumulator block).
 
     Mosaic requires each BlockSpec's last dim to be a multiple of 128 or
     equal to the full array dim, so the kernel never tiles features inside
@@ -67,25 +67,28 @@ def _pick_tiles(f: int, num_bins: int, itemsize: int, rows_block: int,
     budget = 16 * 1024 * 1024
 
     def bytes_for(blk, ft):
-        return ft * num_bins * (blk * 2 * itemsize
-                                + num_sibs * C_PAD * acc_size)
+        return ft * num_bins * (blk * 2 * itemsize + C_PAD * acc_size)
 
     # rows_block > 4096 means "tuned for the XLA einsum path" — auto-pick.
-    blk = 1024 if (rows_block <= 0 or rows_block > 4096) \
-        else max(128, (rows_block // 128) * 128)
+    # Powers of two >= 128 keep every halving on the 128-multiple lattice
+    # Mosaic requires for the valsT block's last dim.
+    if rows_block <= 0 or rows_block > 4096:
+        blk = 1024
+    else:
+        blk = max(128, 1 << (int(rows_block).bit_length() - 1))
     while blk > 128 and bytes_for(blk, f) > budget:
         blk //= 2
     if bytes_for(blk, f) <= budget:
         return blk, f
     # Very wide data: fix the minimum row block and chunk the features.
-    ft = max(1, budget // (num_bins * (blk * itemsize
-                                       + num_sibs * C_PAD * acc_size)))
+    ft = max(1, budget // (num_bins * (blk * 2 * itemsize
+                                       + C_PAD * acc_size)))
     return blk, ft
 
 
-def _prep(bins, vals, rows_block, ftile, sib=None):
+def _prep(bins, vals, rows_block, ftile):
     """Pad rows to the block size, features to a multiple of the chunk
-    width, channels to C_PAD; returns (bins, valsT, sib2, nblocks, nchunks).
+    width, channels to C_PAD; returns (bins, valsT, nblocks, nchunks).
 
     Phantom feature columns are filled with bin 0; their histogram blocks
     are sliced off by the caller, so the garbage never escapes.
@@ -97,13 +100,10 @@ def _prep(bins, vals, rows_block, ftile, sib=None):
         bins = jnp.pad(bins, ((0, pad), (0, fpad)))
     if pad:
         vals = jnp.pad(vals, ((0, pad), (0, 0)))
-        if sib is not None:
-            sib = jnp.pad(sib, (0, pad), constant_values=-1)
     c = vals.shape[1]
     valsT = jnp.pad(vals, ((0, 0), (0, C_PAD - c))).T  # (C_PAD, ntot)
     ntot = n + pad
-    sib2 = None if sib is None else sib.reshape(1, ntot)
-    return bins, valsT, sib2, ntot // rows_block, (f + fpad) // ftile
+    return bins, valsT, ntot // rows_block, (f + fpad) // ftile
 
 
 def _flat_kernel(bins_ref, valsT_ref, out_ref, *, num_bins, ftile,
@@ -122,32 +122,6 @@ def _flat_kernel(bins_ref, valsT_ref, out_ref, *, num_bins, ftile,
     oh = oh.reshape(blk, ftile * num_bins)              # (blk, ft*B)
     out_ref[:, :] += jax.lax.dot_general(
         valsT.astype(oh_dtype) if oh_dtype != valsT.dtype else valsT,
-        oh, dimension_numbers=(((1,), (0,)), ((), ())),
-        preferred_element_type=acc_dtype, precision=precision)
-
-
-def _flat_sib_kernel(bins_ref, valsT_ref, sib_ref, out_ref, *, num_bins,
-                     ftile, num_sibs, oh_dtype, acc_dtype, precision):
-    rb = pl.program_id(0)  # row-block index
-
-    @pl.when(rb == 0)
-    def _init():
-        out_ref[:] = jnp.zeros_like(out_ref)
-
-    bins_blk = bins_ref[:].astype(jnp.int32)            # (blk, ft)
-    valsT = valsT_ref[:]                                # (C_PAD, blk)
-    sib = sib_ref[:].astype(jnp.int32)                  # (1, blk)
-    blk = bins_blk.shape[0]
-    iota_b = jax.lax.broadcasted_iota(jnp.int32, (blk, ftile, num_bins), 2)
-    oh = (bins_blk[:, :, None] == iota_b).astype(oh_dtype)
-    oh = oh.reshape(blk, ftile * num_bins)
-    iota_s = jax.lax.broadcasted_iota(jnp.int32, (num_sibs, blk), 0)
-    sib_oh = (iota_s == sib).astype(valsT.dtype)        # (W, blk)
-    # A[(l, c), r] = vals[c, r] * (sib[r] == l)  -> (W*C_PAD, blk)
-    A = (sib_oh[:, None, :] * valsT[None, :, :]).reshape(
-        num_sibs * C_PAD, blk)
-    out_ref[:, :] += jax.lax.dot_general(
-        A.astype(oh_dtype) if oh_dtype != A.dtype else A,
         oh, dimension_numbers=(((1,), (0,)), ((), ())),
         preferred_element_type=acc_dtype, precision=precision)
 
@@ -171,7 +145,7 @@ def histogram_flat(
     precision = (jax.lax.Precision.HIGHEST if dtype == "f32"
                  else jax.lax.Precision.DEFAULT)
     rows_block, ftile = _pick_tiles(f, num_bins, isz, rows_block)
-    bins, valsT, _, nblocks, nchunks = _prep(bins, vals, rows_block, ftile)
+    bins, valsT, nblocks, nchunks = _prep(bins, vals, rows_block, ftile)
     call = pl.pallas_call(
         functools.partial(_flat_kernel, num_bins=num_bins, ftile=ftile,
                           oh_dtype=oh_dtype, acc_dtype=acc_dtype,
@@ -198,62 +172,6 @@ def histogram_flat(
     # (C_PAD, Fpad*B) -> (F, B, 3), dropping phantom feature blocks
     out = out.reshape(C_PAD, nchunks * ftile, num_bins)[:3, :f]
     return jnp.transpose(out, (1, 2, 0))
-
-
-@functools.partial(
-    jax.jit,
-    static_argnames=("num_bins", "num_sibs", "rows_block", "dtype",
-                     "interpret"))
-def histogram_flat_sib(
-    bins: jnp.ndarray,   # (S, F) gathered rows (padded; pad rows sib=-1)
-    vals: jnp.ndarray,   # (S, 3)
-    sib: jnp.ndarray,    # (S,) i32 sibling slot in [0, num_sibs); -1 = pad
-    *,
-    num_bins: int,
-    num_sibs: int,
-    rows_block: int = 0,
-    dtype: str = "f32",
-    interpret: bool = False,
-) -> jnp.ndarray:        # (num_sibs, F, num_bins, 3)
-    """Multi-leaf wave histogram: all siblings in ONE kernel, M = sibs x
-    channels (up to 128)."""
-    n, f = bins.shape
-    oh_dtype, acc_dtype, isz = _DTYPES[dtype]
-    precision = (jax.lax.Precision.HIGHEST if dtype == "f32"
-                 else jax.lax.Precision.DEFAULT)
-    rows_block, ftile = _pick_tiles(f, num_bins, isz, rows_block,
-                                    num_sibs=num_sibs)
-    bins, valsT, sib2, nblocks, nchunks = _prep(bins, vals, rows_block,
-                                                ftile, sib)
-    call = pl.pallas_call(
-        functools.partial(_flat_sib_kernel, num_bins=num_bins, ftile=ftile,
-                          num_sibs=num_sibs, oh_dtype=oh_dtype,
-                          acc_dtype=acc_dtype, precision=precision),
-        grid=(nblocks,),
-        in_specs=[
-            pl.BlockSpec((rows_block, ftile), lambda i: (i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((C_PAD, rows_block), lambda i: (0, i),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, rows_block), lambda i: (0, i),
-                         memory_space=pltpu.VMEM),
-        ],
-        out_specs=pl.BlockSpec((num_sibs * C_PAD, ftile * num_bins),
-                               lambda i: (0, 0), memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct(
-            (num_sibs * C_PAD, ftile * num_bins), acc_dtype),
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("arbitrary",),
-            vmem_limit_bytes=_VMEM_LIMIT),
-        interpret=interpret,
-    )
-    chunks = [call(jax.lax.slice_in_dim(bins, c * ftile, (c + 1) * ftile,
-                                        axis=1), valsT, sib2)
-              for c in range(nchunks)]
-    out = chunks[0] if nchunks == 1 else jnp.concatenate(chunks, axis=1)
-    # (W*C_PAD, Fpad*B) -> (W, F, B, 3), dropping phantom feature blocks
-    out = out.reshape(num_sibs, C_PAD, nchunks * ftile, num_bins)[:, :3, :f]
-    return jnp.transpose(out, (0, 2, 3, 1))
 
 
 # Backwards-compatible name: the per-feature-loop kernel is superseded by the

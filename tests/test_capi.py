@@ -1,0 +1,240 @@
+"""C API shim tests — the reference's ctypes-driven pattern
+(``tests/c_api_test/test_.py``): load the C-ABI library, run the full
+Dataset -> Booster -> train -> eval -> predict -> save/load workflow through
+the C surface, and check parity with the Python API.
+"""
+
+import ctypes
+
+import numpy as np
+import pytest
+from sklearn.datasets import make_classification
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import capi
+
+_LIB = capi.lib_path()
+pytestmark = pytest.mark.skipif(_LIB is None,
+                                reason="C API shim failed to build")
+
+
+def _load():
+    lib = ctypes.CDLL(_LIB)
+    lib.LGBM_GetLastError.restype = ctypes.c_char_p
+    return lib
+
+
+def _check(lib, rc):
+    assert rc == 0, lib.LGBM_GetLastError().decode()
+
+
+def _dataset_from_mat(lib, X, y=None, params=b"", reference=None):
+    X32 = np.ascontiguousarray(X, np.float32)
+    handle = ctypes.c_void_p()
+    _check(lib, lib.LGBM_DatasetCreateFromMat(
+        X32.ctypes.data_as(ctypes.c_void_p), 0,  # C_API_DTYPE_FLOAT32
+        ctypes.c_int32(X32.shape[0]), ctypes.c_int32(X32.shape[1]),
+        ctypes.c_int(1), params, reference or ctypes.c_void_p(),
+        ctypes.byref(handle)))
+    if y is not None:
+        y32 = np.ascontiguousarray(y, np.float32)
+        _check(lib, lib.LGBM_DatasetSetField(
+            handle, b"label", y32.ctypes.data_as(ctypes.c_void_p),
+            ctypes.c_int(len(y32)), 0))
+    return handle
+
+
+def test_capi_full_workflow(tmp_path):
+    lib = _load()
+    assert lib.LGBM_CAPIVersion() == 1
+
+    X, y = make_classification(n_samples=800, n_features=6, n_informative=4,
+                               random_state=0)
+    train = _dataset_from_mat(lib, X[:600], y[:600])
+    valid = _dataset_from_mat(lib, X[600:], y[600:])
+
+    nd, nf = ctypes.c_int32(), ctypes.c_int32()
+    _check(lib, lib.LGBM_DatasetGetNumData(train, ctypes.byref(nd)))
+    _check(lib, lib.LGBM_DatasetGetNumFeature(train, ctypes.byref(nf)))
+    assert (nd.value, nf.value) == (600, 6)
+
+    params = b"objective=binary metric=auc num_leaves=15 verbosity=-1"
+    bst = ctypes.c_void_p()
+    _check(lib, lib.LGBM_BoosterCreate(train, params, ctypes.byref(bst)))
+    _check(lib, lib.LGBM_BoosterAddValidData(bst, valid))
+
+    finished = ctypes.c_int()
+    for _ in range(10):
+        _check(lib, lib.LGBM_BoosterUpdateOneIter(bst, ctypes.byref(finished)))
+
+    it = ctypes.c_int()
+    _check(lib, lib.LGBM_BoosterGetCurrentIteration(bst, ctypes.byref(it)))
+    assert it.value == 10
+    nc = ctypes.c_int()
+    _check(lib, lib.LGBM_BoosterGetNumClasses(bst, ctypes.byref(nc)))
+    assert nc.value == 1
+
+    # eval on the valid set: AUC should be sane
+    n_eval = ctypes.c_int()
+    _check(lib, lib.LGBM_BoosterGetEvalCounts(bst, ctypes.byref(n_eval)))
+    assert n_eval.value >= 1
+    res = (ctypes.c_double * 8)()
+    out_len = ctypes.c_int()
+    _check(lib, lib.LGBM_BoosterGetEval(bst, 1, ctypes.byref(out_len), res))
+    assert out_len.value >= 1
+    assert 0.7 < res[0] <= 1.0
+
+    # predict through the C API and compare with the Python API
+    Xp = np.ascontiguousarray(X[600:], np.float64)
+    out = (ctypes.c_double * Xp.shape[0])()
+    out_n = ctypes.c_int64()
+    _check(lib, lib.LGBM_BoosterPredictForMat(
+        bst, Xp.ctypes.data_as(ctypes.c_void_p), 1,
+        ctypes.c_int32(Xp.shape[0]), ctypes.c_int32(Xp.shape[1]),
+        ctypes.c_int(1), ctypes.c_int(1),  # RAW_SCORE
+        ctypes.c_int(0), ctypes.c_int(-1), b"", ctypes.byref(out_n), out))
+    assert out_n.value == Xp.shape[0]
+    c_pred = np.array(out[:])
+
+    # save -> reload via string round trip
+    buf_len = ctypes.c_int64(1 << 22)
+    buf = ctypes.create_string_buffer(buf_len.value)
+    str_len = ctypes.c_int64()
+    _check(lib, lib.LGBM_BoosterSaveModelToString(
+        bst, ctypes.c_int(0), ctypes.c_int(-1), ctypes.c_int(0), buf_len,
+        ctypes.byref(str_len), buf))
+    model_str = buf.value.decode()
+    assert "tree" in model_str
+
+    bst2 = ctypes.c_void_p()
+    out_it = ctypes.c_int()
+    _check(lib, lib.LGBM_BoosterLoadModelFromString(
+        buf.value, ctypes.byref(out_it), ctypes.byref(bst2)))
+    assert out_it.value == 10
+    out2 = (ctypes.c_double * Xp.shape[0])()
+    _check(lib, lib.LGBM_BoosterPredictForMat(
+        bst2, Xp.ctypes.data_as(ctypes.c_void_p), 1,
+        ctypes.c_int32(Xp.shape[0]), ctypes.c_int32(Xp.shape[1]),
+        ctypes.c_int(1), ctypes.c_int(1), ctypes.c_int(0), ctypes.c_int(-1),
+        b"", ctypes.byref(out_n), out2))
+    np.testing.assert_allclose(np.array(out2[:]), c_pred, rtol=1e-6,
+                               atol=1e-6)
+
+    # parity with the Python surface (same params, same data)
+    py = lgb.train({"objective": "binary", "metric": "auc", "num_leaves": 15,
+                    "verbosity": -1},
+                   lgb.Dataset(X[:600], label=y[:600]), 10)
+    py_pred = py.predict(X[600:], raw_score=True)
+    np.testing.assert_allclose(c_pred, py_pred, rtol=1e-4, atol=1e-4)
+
+    # model file save + load
+    path = str(tmp_path / "capi_model.txt")
+    _check(lib, lib.LGBM_BoosterSaveModel(
+        bst, ctypes.c_int(0), ctypes.c_int(-1), ctypes.c_int(0),
+        path.encode()))
+    bst3 = ctypes.c_void_p()
+    _check(lib, lib.LGBM_BoosterCreateFromModelfile(
+        path.encode(), ctypes.byref(out_it), ctypes.byref(bst3)))
+    assert out_it.value == 10
+
+    # feature importance
+    imp = (ctypes.c_double * 6)()
+    _check(lib, lib.LGBM_BoosterFeatureImportance(
+        bst, ctypes.c_int(-1), ctypes.c_int(0), imp))
+    assert sum(imp[:]) > 0
+
+    for h in (bst, bst2, bst3):
+        _check(lib, lib.LGBM_BoosterFree(h))
+    for h in (train, valid):
+        _check(lib, lib.LGBM_DatasetFree(h))
+
+
+def test_capi_error_reporting():
+    lib = _load()
+    bad = ctypes.c_void_p()
+    rc = lib.LGBM_DatasetCreateFromFile(b"/nonexistent/file.csv", b"",
+                                        ctypes.c_void_p(), ctypes.byref(bad))
+    assert rc == -1
+    msg = lib.LGBM_GetLastError().decode()
+    assert "nonexistent" in msg or "No such file" in msg
+
+
+def test_capi_rollback_and_dump():
+    lib = _load()
+    X, y = make_classification(n_samples=400, n_features=5, random_state=1)
+    train = _dataset_from_mat(lib, X, y)
+    bst = ctypes.c_void_p()
+    _check(lib, lib.LGBM_BoosterCreate(
+        train, b"objective=binary num_leaves=7 verbosity=-1",
+        ctypes.byref(bst)))
+    fin = ctypes.c_int()
+    for _ in range(3):
+        _check(lib, lib.LGBM_BoosterUpdateOneIter(bst, ctypes.byref(fin)))
+    _check(lib, lib.LGBM_BoosterRollbackOneIter(bst))
+    it = ctypes.c_int()
+    _check(lib, lib.LGBM_BoosterGetCurrentIteration(bst, ctypes.byref(it)))
+    assert it.value == 2
+
+    buf_len = ctypes.c_int64(1 << 22)
+    buf = ctypes.create_string_buffer(buf_len.value)
+    out_len = ctypes.c_int64()
+    _check(lib, lib.LGBM_BoosterDumpModel(
+        bst, ctypes.c_int(0), ctypes.c_int(-1), ctypes.c_int(0), buf_len,
+        ctypes.byref(out_len), buf))
+    import json
+    model = json.loads(buf.value.decode())
+    assert model["num_tree_per_iteration"] >= 1
+    _check(lib, lib.LGBM_BoosterFree(bst))
+    _check(lib, lib.LGBM_DatasetFree(train))
+
+
+def test_capi_standalone_c_program(tmp_path):
+    """Compile a plain C program against the shim and run it OUTSIDE any
+    Python process — proves the embedded-interpreter mode (the reference's
+    c_api is likewise consumable from bare C)."""
+    import os
+    import shutil
+    import subprocess
+    import sys
+
+    if shutil.which("gcc") is None:
+        pytest.skip("no C compiler")
+    import lightgbm_tpu
+    pkg_root = os.path.dirname(os.path.dirname(
+        os.path.abspath(lightgbm_tpu.__file__)))
+    src = tmp_path / "demo.c"
+    src.write_text(r'''
+#include <stdio.h>
+#include "lightgbm_tpu_c_api.h"
+int main(void) {
+  float X[200 * 3]; float y[200];
+  for (int i = 0; i < 200; ++i) {
+    for (int j = 0; j < 3; ++j) X[i*3+j] = (float)((i*37+j*11) % 100) / 100.0f - 0.5f;
+    y[i] = X[i*3] > 0 ? 1.0f : 0.0f;
+  }
+  DatasetHandle ds; BoosterHandle bst; int fin;
+  if (LGBM_DatasetCreateFromMat(X, C_API_DTYPE_FLOAT32, 200, 3, 1, "", NULL, &ds)) { fprintf(stderr, "%s\n", LGBM_GetLastError()); return 1; }
+  if (LGBM_DatasetSetField(ds, "label", y, 200, C_API_DTYPE_FLOAT32)) return 1;
+  if (LGBM_BoosterCreate(ds, "objective=binary num_leaves=7 min_data_in_leaf=5 verbosity=-1", &bst)) { fprintf(stderr, "%s\n", LGBM_GetLastError()); return 1; }
+  for (int i = 0; i < 3; ++i) if (LGBM_BoosterUpdateOneIter(bst, &fin)) { fprintf(stderr, "%s\n", LGBM_GetLastError()); return 1; }
+  int it; LGBM_BoosterGetCurrentIteration(bst, &it);
+  printf("iters=%d\n", it);
+  return it == 3 ? 0 : 1;
+}
+''')
+    exe = tmp_path / "demo"
+    subprocess.run(
+        ["gcc", "-O1", str(src),
+         f"-I{os.path.join(pkg_root, 'lightgbm_tpu', 'capi', 'include')}",
+         _LIB, "-o", str(exe),
+         f"-Wl,-rpath,{os.path.dirname(_LIB)}"],
+        check=True, capture_output=True)
+    env = dict(os.environ,
+               LIGHTGBM_TPU_PLATFORM="cpu",
+               LIGHTGBM_TPU_PKG_DIR=pkg_root,
+               PYTHONPATH=pkg_root + os.pathsep
+               + os.environ.get("PYTHONPATH", ""))
+    res = subprocess.run([str(exe)], env=env, capture_output=True,
+                         text=True, timeout=240)
+    assert res.returncode == 0, (res.stdout, res.stderr)
+    assert "iters=3" in res.stdout

@@ -1,0 +1,158 @@
+"""Explanation-path tests: golden TreeSHAP values, native/Python parity,
+sum-to-prediction, and leaf-index correctness.
+
+Reference: ``Tree::PredictContrib`` (``src/io/tree.cpp``) and the
+``predict_contrib`` behaviour tests in
+``tests/python_package_test/test_engine.py``.
+"""
+
+import itertools
+
+import numpy as np
+import pytest
+from sklearn.datasets import make_classification
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import native
+
+
+def _train(n=600, f=6, num_leaves=8, rounds=5, seed=0, **extra):
+    X, y = make_classification(n_samples=n, n_features=f, n_informative=4,
+                               random_state=seed)
+    params = {"objective": "binary", "num_leaves": num_leaves,
+              "min_data_in_leaf": 10, "verbosity": -1}
+    params.update(extra)
+    bst = lgb.train(params, lgb.Dataset(X, label=y), rounds)
+    return bst, X, y
+
+
+def _brute_force_shap(tree_fn, cover_fn, x, nf):
+    """Exact Shapley values of a tree via subset enumeration.
+
+    ``tree_fn(S)``: expected tree output when only the features in S take
+    x's values and the rest are marginalized by the tree's cover weights
+    (the conditional-expectation semantics TreeSHAP implements)."""
+    phi = np.zeros(nf)
+    feats = list(range(nf))
+    import math
+    for i in feats:
+        others = [f for f in feats if f != i]
+        for r in range(len(others) + 1):
+            for S in itertools.combinations(others, r):
+                w = (math.factorial(len(S))
+                     * math.factorial(nf - len(S) - 1) / math.factorial(nf))
+                phi[i] += w * (tree_fn(set(S) | {i}) - tree_fn(set(S)))
+    return phi
+
+
+def test_golden_shap_hand_tree():
+    """Exact SHAP values on a hand-built 3-leaf tree, verified against
+    brute-force Shapley enumeration of the tree's conditional expectation."""
+    # Build via training on deterministic data that forces the shape:
+    #   root: split f0; left child: split f1.
+    rng = np.random.RandomState(0)
+    n = 800
+    f0 = (rng.rand(n) < 0.5).astype(float)
+    f1 = (rng.rand(n) < 0.5).astype(float)
+    y = np.where(f0 < 0.5, np.where(f1 < 0.5, 0.0, 1.0), 0.5) \
+        + 0.01 * rng.randn(n)
+    X = np.stack([f0, f1], axis=1)
+    bst = lgb.train({"objective": "regression", "num_leaves": 3,
+                     "min_data_in_leaf": 1, "min_sum_hessian_in_leaf": 1e-3,
+                     "learning_rate": 1.0, "verbosity": -1},
+                    lgb.Dataset(X, label=y), 1)
+    tree = bst._gbdt.models[0][0]
+    assert tree.num_leaves == 3
+
+    contrib = bst.predict(X[:4], pred_contrib=True)
+    pred = bst.predict(X[:4], raw_score=True)
+
+    # The tree's conditional expectation for a feature subset S: walk the
+    # tree; at a split on a known feature follow x, otherwise average the
+    # children weighted by cover.
+    def tree_expect(x_row, S):
+        def rec(node):
+            if node < 0:
+                return float(tree.leaf_value[~node])
+            f = int(tree.split_feature[node])
+            lc, rc = int(tree.left_child[node]), int(tree.right_child[node])
+
+            def cover(c):
+                return float(tree.leaf_count[~c] if c < 0
+                             else tree.internal_count[c])
+            if f in S:
+                bins = bst._gbdt.train_data.binned.apply(x_row[None, :])[0]
+                go_left = bins[f] <= tree.split_bin[node]
+                return rec(lc if go_left else rc)
+            tot = cover(lc) + cover(rc)
+            return (cover(lc) * rec(lc) + cover(rc) * rec(rc)) / tot
+        return rec(0)
+
+    base = contrib[:, -1]
+    for i in range(4):
+        golden = _brute_force_shap(
+            lambda S: tree_expect(X[i], S), None, X[i], 2)
+        np.testing.assert_allclose(contrib[i, :2], golden, rtol=1e-5,
+                                   atol=1e-7)
+        # sum-to-prediction (local accuracy)
+        np.testing.assert_allclose(contrib[i, :2].sum() + base[i], pred[i],
+                                   rtol=1e-5, atol=1e-7)
+
+
+def test_contrib_sums_to_prediction_ensemble():
+    bst, X, y = _train(rounds=8)
+    contrib = bst.predict(X[:50], pred_contrib=True)
+    pred = bst.predict(X[:50], raw_score=True)
+    np.testing.assert_allclose(contrib.sum(axis=1), pred, rtol=1e-5,
+                               atol=1e-6)
+
+
+@pytest.mark.skipif(not native.available(), reason="native module unavailable")
+def test_native_shap_matches_python_oracle():
+    """The C++ TreeSHAP must match the recursive Python implementation
+    exactly (same algorithm, same arithmetic)."""
+    from lightgbm_tpu.explain import _tree_shap_recurse
+
+    bst, X, y = _train(rounds=4, num_leaves=12)
+    g = bst._gbdt
+    bins = g.train_data.binned.apply(X[:30])
+    nan_bins = g.train_data.binned.nan_bins
+    trees = g.models[0][:4]
+    got = native.tree_shap(bins, nan_bins, trees)
+    assert got is not None
+    nf = g.train_data.num_features
+    want = np.zeros((30, nf + 1))
+    for tree in trees:
+        if tree.num_leaves <= 1:
+            continue
+        for i in range(30):
+            phi = np.zeros(nf + 1)
+            _tree_shap_recurse(tree, bins[i], nan_bins, phi, 0, [],
+                               1.0, 1.0, -1, 0.0)
+            want[i] += phi
+    np.testing.assert_allclose(got[:, :nf], want[:, :nf], rtol=1e-9,
+                               atol=1e-12)
+
+
+@pytest.mark.skipif(not native.available(), reason="native module unavailable")
+def test_native_leaf_index_matches_vectorized_walk():
+    bst, X, y = _train(rounds=3, num_leaves=10)
+    g = bst._gbdt
+    bins = g.train_data.binned.apply(X)
+    nan_bins = g.train_data.binned.nan_bins
+    for tree in g.models[0]:
+        got = native.predict_leaf_index(bins, nan_bins, tree)
+        want = tree.predict_leaf_bins(bins, nan_bins)
+        np.testing.assert_array_equal(got, want)
+
+
+def test_leaf_index_routes_to_predicted_leaf():
+    bst, X, y = _train(rounds=4)
+    li = bst.predict(X[:100], pred_leaf=True)
+    g = bst._gbdt
+    pred = bst.predict(X[:100], raw_score=True)
+    # reconstruct predictions from leaf indices
+    acc = np.full(100, g.init_scores[0])
+    for t, tree in enumerate(g.models[0]):
+        acc += tree.leaf_value[li[:, t]]
+    np.testing.assert_allclose(acc, pred, rtol=1e-5, atol=1e-6)

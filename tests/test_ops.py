@@ -210,26 +210,3 @@ def test_flat_histogram_dtypes_match_oracle(rng):
     np.testing.assert_array_equal(np.asarray(got8, np.int64), ref8)
 
 
-def test_flat_sib_histogram_matches_oracle(rng):
-    """Multi-sibling wave kernel vs per-sibling scatter oracle."""
-    from lightgbm_tpu.ops.pallas_histogram import histogram_flat_sib
-
-    n, f, B, W = 900, 4, 16, 6
-    bins = rng.randint(0, B, size=(n, f)).astype(np.uint8)
-    sib = rng.randint(-1, W, size=n).astype(np.int32)  # -1 = padding row
-    vals = pack_values(jnp.asarray(rng.randn(n), dtype=jnp.float32),
-                       jnp.asarray(rng.rand(n), dtype=jnp.float32),
-                       None)
-    got = histogram_flat_sib(jnp.asarray(bins), vals, jnp.asarray(sib),
-                             num_bins=B, num_sibs=W, rows_block=256,
-                             interpret=True)
-    assert got.shape == (W, f, B, 3)
-    v = np.asarray(vals)
-    for l in range(W):
-        m = sib == l
-        ref = np.zeros((f, B, 3))
-        for j in range(f):
-            for r in np.nonzero(m)[0]:
-                ref[j, bins[r, j]] += v[r]
-        np.testing.assert_allclose(np.asarray(got[l]), ref, rtol=1e-4,
-                                   atol=1e-4)
