@@ -16,7 +16,7 @@ if _os.environ.get("LIGHTGBM_TPU_PLATFORM"):
 
     _jax.config.update("jax_platforms", _os.environ["LIGHTGBM_TPU_PLATFORM"])
 
-from .basic import Booster, Dataset
+from .basic import Booster, Dataset, Sequence
 from .callback import EarlyStopException, early_stopping, log_evaluation, \
     record_evaluation, reset_parameter
 from .config import Config
@@ -31,6 +31,7 @@ __all__ = [
     "LGBMModel", "LGBMClassifier", "LGBMRegressor", "LGBMRanker",
     "plot_importance", "plot_metric", "plot_split_value_histogram",
     "plot_tree", "create_tree_digraph",
+    "Sequence",
 ]
 
 _PLOT_FNS = ("plot_importance", "plot_metric", "plot_split_value_histogram",

@@ -9,7 +9,8 @@ programs.
 from __future__ import annotations
 
 import copy
-from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+from typing import Any, Dict, List, Optional, Tuple, Union
+from typing import Sequence as TypingSequence
 
 import numpy as np
 
@@ -20,11 +21,130 @@ from .models.dart import DART
 from .models.rf import RandomForest
 
 
+class Sequence:
+    """Generic data access interface for two-pass/chunked loading
+    (reference ``lightgbm.Sequence``): subclasses implement ``__len__`` and
+    ``__getitem__`` (row or slice).  A list of Sequences/arrays passed as
+    ``Dataset(data=...)`` is concatenated row-wise."""
+
+    batch_size = 4096
+
+    def __len__(self) -> int:
+        raise NotImplementedError
+
+    def __getitem__(self, idx):
+        raise NotImplementedError
+
+    def _materialize(self) -> np.ndarray:
+        out = []
+        for start in range(0, len(self), self.batch_size):
+            out.append(np.asarray(
+                self[slice(start, min(start + self.batch_size, len(self)))],
+                np.float64))
+        return np.concatenate(out, axis=0) if out else np.zeros((0, 0))
+
+
 def _as_2d(data) -> np.ndarray:
+    """Accept ndarray / list / pandas DataFrame / scipy sparse / pyarrow
+    Table / Sequence(s) (reference ``basic.py`` ``_data_from_pandas``,
+    CSR/CSC and Arrow ingestion, ``include/LightGBM/arrow.h``).  Sparse
+    inputs densify: the TPU build stores one dense (N, F) bin matrix and EFB
+    (enable_bundle) recovers the sparse-column win after binning."""
+    df = _pandas_df(data)
+    if df is not None:
+        return _pandas_to_mat(df)
+    if _is_scipy_sparse(data):
+        return np.asarray(data.todense(), dtype=np.float64)
+    arrow = _arrow_to_mat(data)
+    if arrow is not None:
+        return arrow
+    if isinstance(data, Sequence):
+        return _as_2d(data._materialize())
+    if (isinstance(data, (list, tuple)) and data
+            and all(isinstance(c, Sequence)
+                    or (isinstance(c, np.ndarray) and c.ndim == 2)
+                    or _pandas_df(c) is not None for c in data)):
+        # chunked push: list of 2-D row blocks (reference
+        # LGBM_DatasetPushRows / Sequence lists).  Lists of 1-D rows keep
+        # the plain "matrix from list of rows" meaning.
+        return np.concatenate([_as_2d(c) for c in data], axis=0)
     arr = np.asarray(data, dtype=np.float64)
     if arr.ndim == 1:
         arr = arr.reshape(-1, 1)
     return arr
+
+
+def _arrow_to_mat(data):
+    """pyarrow Table / RecordBatch -> (N, F) f64; dictionary columns ->
+    category codes (reference Arrow ingestion, include/LightGBM/arrow.h)."""
+    try:
+        import pyarrow as pa
+    except ImportError:
+        return None
+    if isinstance(data, pa.RecordBatch):
+        data = pa.Table.from_batches([data])
+    if not isinstance(data, pa.Table):
+        return None
+    cols = []
+    for name in data.column_names:
+        col = data.column(name)
+        if pa.types.is_dictionary(col.type):
+            codes = col.combine_chunks().indices.to_numpy(
+                zero_copy_only=False).astype(np.float64)
+            cols.append(codes)
+        else:
+            cols.append(col.to_numpy(zero_copy_only=False).astype(
+                np.float64))
+    return np.column_stack(cols) if cols else np.zeros((len(data), 0))
+
+
+def _pandas_df(data):
+    try:
+        import pandas as pd
+    except ImportError:
+        return None
+    if isinstance(data, pd.DataFrame):
+        return data
+    if isinstance(data, pd.Series):
+        return data.to_frame()
+    return None
+
+
+def _is_scipy_sparse(data) -> bool:
+    return hasattr(data, "tocsr") and hasattr(data, "todense")
+
+
+def _pandas_to_mat(df) -> np.ndarray:
+    """Categorical columns -> their integer codes (NaN for missing), object
+    columns rejected (reference ``_data_from_pandas`` semantics)."""
+    import pandas as pd
+
+    cols = []
+    for c in df.columns:
+        col = df[c]
+        if isinstance(col.dtype, pd.CategoricalDtype):
+            codes = col.cat.codes.to_numpy(np.float64)
+            cols.append(np.where(codes < 0, np.nan, codes))
+        elif not (pd.api.types.is_numeric_dtype(col)
+                  or pd.api.types.is_bool_dtype(col)):
+            raise ValueError(
+                f"DataFrame column {c!r} has object dtype; convert it to "
+                "numeric or categorical first (reference basic.py "
+                "bad_indices error)")
+        else:
+            cols.append(col.to_numpy(np.float64))
+    return np.column_stack(cols) if cols else np.zeros((len(df), 0))
+
+
+def _pandas_meta(data):
+    """(feature_names, categorical_columns) from a DataFrame, for the
+    'auto' resolution path."""
+    import pandas as pd
+
+    names = [str(c) for c in data.columns]
+    cats = [i for i, c in enumerate(data.columns)
+            if isinstance(data[c].dtype, pd.CategoricalDtype)]
+    return names, cats
 
 
 class Dataset:
@@ -37,6 +157,7 @@ class Dataset:
         reference: Optional["Dataset"] = None,
         weight=None,
         group=None,
+        position=None,
         init_score=None,
         feature_name: Union[str, List[str]] = "auto",
         categorical_feature: Union[str, List[int], List[str]] = "auto",
@@ -53,11 +174,34 @@ class Dataset:
                                  "dataset file (see Dataset.save_binary)")
             self._binary_path = data
             data = np.zeros((0, 0))
+        df = _pandas_df(data)
+        if df is not None:
+            # reference _data_from_pandas: auto feature names + auto
+            # categorical columns from pandas category dtypes
+            names, pd_cats = _pandas_meta(df)
+            if feature_name == "auto":
+                feature_name = names
+            if categorical_feature == "auto" and pd_cats:
+                categorical_feature = pd_cats
+        else:
+            try:
+                import pyarrow as pa
+                if isinstance(data, (pa.Table, pa.RecordBatch)):
+                    if feature_name == "auto":
+                        feature_name = list(data.schema.names)
+                    if categorical_feature == "auto":
+                        cats = [i for i, t in enumerate(data.schema.types)
+                                if pa.types.is_dictionary(t)]
+                        if cats:
+                            categorical_feature = cats
+            except ImportError:
+                pass
         self.data = _as_2d(data)
         self.label = None if label is None else np.asarray(label)
         self.reference = reference
         self.weight = None if weight is None else np.asarray(weight, np.float64)
         self.group = None if group is None else np.asarray(group, np.int64)
+        self.position = None if position is None else np.asarray(position)
         self.init_score = None if init_score is None else np.asarray(init_score)
         self.params = dict(params or {})
         self.feature_name = feature_name
@@ -81,10 +225,12 @@ class Dataset:
                 if key in merged:
                     cat_param = merged.pop(key)
             cfg = Config(merged)
-            cats: Sequence[int] = ()
+            cats: TypingSequence[int] = ()
             cat_spec = (self.categorical_feature
                         if isinstance(self.categorical_feature, (list, tuple))
                         else cat_param)
+            if cat_spec == "auto":
+                cat_spec = None
             if isinstance(cat_spec, str) and cat_spec:
                 cat_spec = cat_spec.split(",")
             if isinstance(cat_spec, (list, tuple)):
@@ -99,6 +245,7 @@ class Dataset:
                 self.data, self.label if self.label is not None
                 else np.zeros(len(self.data)), cfg,
                 weight=self.weight, group=self.group,
+                position=self.position,
                 init_score=self.init_score,
                 categorical_features=cats,
                 feature_names=self._feature_names(),
@@ -136,6 +283,13 @@ class Dataset:
         self._train_data = None
         return self
 
+    def set_position(self, position):
+        """Per-row positions for unbiased LTR (reference
+        ``Dataset.set_position`` / Metadata positions)."""
+        self.position = None if position is None else np.asarray(position)
+        self._train_data = None
+        return self
+
     def set_group(self, group):
         self.group = None if group is None else np.asarray(group, np.int64)
         self._train_data = None
@@ -163,7 +317,7 @@ class Booster:
         train_set: Optional[Dataset] = None,
         model_file: Optional[str] = None,
         model_str: Optional[str] = None,
-        valid_sets: Sequence[Tuple[str, Dataset]] = (),
+        valid_sets: TypingSequence[Tuple[str, Dataset]] = (),
         base_model=None,
     ):
         self.params = dict(params or {})

@@ -247,6 +247,42 @@ def bin_dataset(
     return BinnedData.from_mappers(X, mappers)
 
 
+def _bin_full_matrix(X: np.ndarray, mappers: List["BinMapper"],
+                     dtype) -> np.ndarray:
+    """Bin every column in one threaded native pass (numerical features);
+    categorical columns fall back to the per-feature LUT path."""
+    n, f = X.shape
+    any_num = any(not m.is_categorical for m in mappers)
+    out = None
+    if any_num:
+        max_b = max((len(m.upper_bounds) for m in mappers
+                     if m.upper_bounds is not None), default=1)
+        ubm = np.full((f, max_b), np.inf, np.float64)
+        nvb = np.ones(f, np.int32)
+        nnb = np.full(f, -1, np.int32)
+        zam = np.zeros(f, np.uint8)
+        for j, m in enumerate(mappers):
+            if m.is_categorical or m.upper_bounds is None:
+                continue
+            k = len(m.upper_bounds)
+            ubm[j, :k] = m.upper_bounds
+            nvb[j] = m.num_bins - (1 if m.has_nan_bin else 0) + 1
+            nnb[j] = m.nan_bin if m.has_nan_bin else -1
+            zam[j] = 1 if m.missing_type == MISSING_ZERO else 0
+        nb = native.bin_matrix(X, ubm, nvb, nnb, zam)
+        if nb is not None:
+            out = nb.astype(dtype, copy=False)
+    if out is None:
+        out = np.empty((n, f), dtype=dtype)
+        for j, m in enumerate(mappers):
+            out[:, j] = m.value_to_bin(X[:, j]).astype(dtype)
+        return out
+    for j, m in enumerate(mappers):
+        if m.is_categorical:
+            out[:, j] = m.value_to_bin(X[:, j]).astype(dtype)
+    return out
+
+
 @dataclasses.dataclass
 class BinnedData:
     """Dense binned matrix + per-feature metadata, ready for device upload."""
@@ -264,13 +300,12 @@ class BinnedData:
         n, f = X.shape
         max_b = max(max(m.num_bins for m in mappers), 2)
         dtype = np.uint8 if max_b <= 256 else np.uint16
-        bins = np.empty((n, f), dtype=dtype)
         ub = np.full((f, max_b), np.inf, dtype=np.float32)
         nan_bins = np.full(f, max_b, dtype=np.int32)
         nbpf = np.empty(f, dtype=np.int32)
         is_cat = np.zeros(f, dtype=bool)
+        bins = _bin_full_matrix(X, mappers, dtype)
         for j, m in enumerate(mappers):
-            bins[:, j] = m.value_to_bin(X[:, j]).astype(dtype)
             nbpf[j] = m.num_bins
             is_cat[j] = m.is_categorical
             if m.is_categorical:
@@ -297,11 +332,8 @@ class BinnedData:
     def apply(self, X: np.ndarray) -> np.ndarray:
         """Bin new data (e.g. a validation set) with the training mappers —
         reference ``LoadFromFileAlignWithOtherDataset`` (``dataset_loader.cpp:299``)."""
-        X = np.asarray(X)
-        out = np.empty((X.shape[0], self.num_features), dtype=self.bins.dtype)
-        for j, m in enumerate(self.mappers):
-            out[:, j] = m.value_to_bin(X[:, j]).astype(self.bins.dtype)
-        return out
+        return _bin_full_matrix(np.asarray(X), self.mappers,
+                                self.bins.dtype)
 
 
 # ---------------------------------------------------------------- binary cache
@@ -358,3 +390,136 @@ def mappers_from_arrays(d: dict) -> List[BinMapper]:
             default_bin=int(d["mapper_default_bin"][j]),
         ))
     return out
+
+
+# ------------------------------------------------------------------------ EFB
+@dataclasses.dataclass
+class FeatureBundles:
+    """Exclusive feature bundling (reference EFB: ``DatasetLoader::FindGroups``
+    / ``FeatureGroup``, ``src/io/dataset_loader.cpp`` + ``feature_group.h:26``).
+
+    Mutually (near-)exclusive sparse features share ONE histogram column:
+    bundle bin 0 means "every member at its default"; member ``f``'s
+    non-default bins ``1..nb_f-1`` occupy ``[offset_f, offset_f + nb_f - 2]``.
+    Dense/categorical/non-zero-default features ride along as singleton
+    groups with identity bin mapping (``feat_offset == -1``).
+
+    The grower consumes the bundled (N, G) matrix for histograms and row
+    partitions, then reconstructs per-ORIGINAL-feature histogram views at
+    split-scan time — trees, serialization, and prediction stay entirely in
+    original feature space.
+    """
+
+    feat_group: np.ndarray    # (F,) int32 — bundle column of each feature
+    feat_offset: np.ndarray   # (F,) int32 — non-default-bin offset; -1 = identity
+    group_bins: np.ndarray    # (G,) int32 — bins per bundle column
+    bins: np.ndarray          # (N, G) bundled matrix
+
+    @property
+    def num_groups(self) -> int:
+        return len(self.group_bins)
+
+    @property
+    def max_group_bins(self) -> int:
+        return int(self.group_bins.max()) if len(self.group_bins) else 1
+
+    def bundle_row_matrix(self, bins: np.ndarray) -> np.ndarray:
+        """Re-bundle an (N, F) original-bin matrix (e.g. after binary-cache
+        reload)."""
+        n = bins.shape[0]
+        out = np.zeros((n, self.num_groups), dtype=self.bins.dtype)
+        for f in range(len(self.feat_group)):
+            g, off = int(self.feat_group[f]), int(self.feat_offset[f])
+            col = bins[:, f]
+            if off < 0:
+                out[:, g] = col
+            else:
+                nz = col > 0
+                out[nz, g] = (off + col[nz].astype(np.int32) - 1).astype(
+                    out.dtype)
+        return out
+
+
+def build_bundles(binned: "BinnedData", *, max_conflict_rate: float = 0.0,
+                  sample_cnt: int = 20000, max_bundle_bins: int = 4096,
+                  min_gain_cols: float = 0.75,
+                  random_state: int = 3) -> Optional[FeatureBundles]:
+    """Greedy conflict-bounded bundling (the EFB paper's Greedy Bundling,
+    reference ``FindGroups``).  Returns None when bundling would not shrink
+    the column count below ``min_gain_cols * F`` (dense data)."""
+    bins = binned.bins
+    n, f = bins.shape
+    if f < 8:
+        return None
+    mappers = binned.mappers
+    eligible = np.array(
+        [(not m.is_categorical) and m.default_bin == 0 and m.num_bins >= 2
+         and m.num_bins - 1 <= max_bundle_bins - 1
+         for m in mappers])
+    if n > sample_cnt:
+        rng = np.random.RandomState(random_state)
+        sample = bins[rng.choice(n, size=sample_cnt, replace=False)]
+    else:
+        sample = bins
+    s = sample.shape[0]
+    nz = sample != 0                                   # (S, F)
+    nz_cnt = nz.sum(axis=0)
+    budget = int(max_conflict_rate * s)
+    nbpf = binned.num_bins_per_feature
+
+    # Greedy: sparsest-first so dense features don't eat bundle capacity.
+    order = [int(j) for j in np.argsort(nz_cnt) if eligible[j]]
+    bundles: List[List[int]] = []
+    bundle_nz: List[np.ndarray] = []
+    bundle_bins: List[int] = []
+    for j in order:
+        extra = int(nbpf[j]) - 1
+        placed = False
+        for bi in range(len(bundles)):
+            if bundle_bins[bi] + extra > max_bundle_bins:
+                continue
+            conflict = int(np.count_nonzero(bundle_nz[bi] & nz[:, j]))
+            if conflict <= budget:
+                bundles[bi].append(j)
+                bundle_nz[bi] |= nz[:, j]
+                bundle_bins[bi] += extra
+                placed = True
+                break
+        if not placed:
+            bundles.append([j])
+            bundle_nz.append(nz[:, j].copy())
+            bundle_bins.append(1 + extra)
+
+    n_single = f - sum(len(b) for b in bundles)
+    n_groups = len(bundles) + n_single
+    if n_groups > min_gain_cols * f:
+        return None
+
+    feat_group = np.empty(f, np.int32)
+    feat_offset = np.full(f, -1, np.int32)
+    group_bins = []
+    for bi, members in enumerate(bundles):
+        off = 1
+        for j in members:
+            feat_group[j] = bi
+            feat_offset[j] = off
+            off += int(nbpf[j]) - 1
+        group_bins.append(off)
+    g = len(bundles)
+    for j in range(f):
+        if eligible[j]:
+            continue
+        feat_group[j] = g
+        group_bins.append(int(nbpf[j]))
+        g += 1
+
+    dtype = np.uint8 if max(group_bins) <= 256 else np.uint16
+    assert max(group_bins) <= 65535
+    fb = FeatureBundles(
+        feat_group=feat_group, feat_offset=feat_offset,
+        group_bins=np.asarray(group_bins, np.int32),
+        bins=np.zeros((0, len(group_bins)), dtype))
+    # conflicts outside the sample resolve last-writer-wins (the reference
+    # likewise tolerates bounded conflicts)
+    fb.bins = fb.bundle_row_matrix(bins)
+    return fb

@@ -98,6 +98,9 @@ def dataset_set_field(handle, name, mv, dtype_code, num_element):
         ds.set_group(arr)
     elif name == "init_score":
         ds.init_score = arr
+        ds._train_data = None  # invalidate like the other setters
+    elif name == "position":
+        ds.set_position(arr)
     else:
         raise ValueError(f"unknown field {name!r}")
 

@@ -114,6 +114,10 @@ _PARAMS: List[Tuple[str, Any, Any, Tuple[str, ...], Optional[Tuple[Any, Any]]]] 
     ("data_random_seed", int, 1, ("data_seed",), None),
     ("is_enable_sparse", bool, True, ("is_sparse", "enable_sparse", "sparse"), None),
     ("enable_bundle", bool, True, ("is_enable_bundle", "bundle"), None),
+    # EFB conflict budget (the reference hard-codes 0 in FindGroups; the EFB
+    # paper's gamma) — fraction of sampled rows where bundle members may
+    # both be non-default.
+    ("max_conflict_rate", float, 0.0, (), (0.0, 1.0)),
     ("use_missing", bool, True, (), None),
     ("zero_as_missing", bool, False, (), None),
     ("feature_pre_filter", bool, True, (), None),

@@ -30,12 +30,19 @@ class TrainData:
     label: np.ndarray
     weight: Optional[np.ndarray] = None
     group: Optional[np.ndarray] = None          # query sizes (ranking)
+    position: Optional[np.ndarray] = None       # per-row position ids
+                                                # (unbiased LTR)
     init_score: Optional[np.ndarray] = None
     feature_names: Optional[List[str]] = None
     monotone_constraints: Optional[np.ndarray] = None
     raw: Optional[np.ndarray] = None     # raw values (kept for linear trees)
+    # EFB (reference FeatureGroup/FindGroups): bundled column matrix used by
+    # the grower's histogram/partition hot path; built lazily on demand.
+    bundles: Optional[object] = None
+    _bundles_tried: bool = False
     # device arrays (lazily uploaded)
     _bins_dev: Optional[jnp.ndarray] = None
+    _bundled_bins_dev: Optional[jnp.ndarray] = None
     _meta_dev: Optional[dict] = None
 
     @classmethod
@@ -47,6 +54,7 @@ class TrainData:
         *,
         weight: Optional[np.ndarray] = None,
         group: Optional[np.ndarray] = None,
+        position: Optional[np.ndarray] = None,
         init_score: Optional[np.ndarray] = None,
         categorical_features: Sequence[int] = (),
         feature_names: Optional[List[str]] = None,
@@ -77,6 +85,7 @@ class TrainData:
             label=np.asarray(label),
             weight=None if weight is None else np.asarray(weight, np.float32),
             group=None if group is None else np.asarray(group, np.int64),
+            position=None if position is None else np.asarray(position),
             init_score=None if init_score is None else np.asarray(init_score),
             feature_names=feature_names,
             monotone_constraints=mono,
@@ -99,6 +108,22 @@ class TrainData:
                 arr = jax.device_put(arr, sharding)
             self._bins_dev = arr
         return self._bins_dev
+
+    def build_bundles(self, cfg: Config):
+        """EFB bundling (reference FindGroups); None when data is dense or
+        bundling is disabled.  Cached per TrainData."""
+        if not self._bundles_tried:
+            self._bundles_tried = True
+            if cfg.enable_bundle:
+                from .binning import build_bundles
+                self.bundles = build_bundles(
+                    self.binned, max_conflict_rate=cfg.max_conflict_rate)
+        return self.bundles
+
+    def bundled_bins_device(self) -> jnp.ndarray:
+        if self._bundled_bins_dev is None:
+            self._bundled_bins_dev = jnp.asarray(self.bundles.bins)
+        return self._bundled_bins_dev
 
     def feature_meta_device(self) -> dict:
         if self._meta_dev is None:

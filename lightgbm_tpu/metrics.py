@@ -194,6 +194,37 @@ def _multi_error(top_k):
     return fn
 
 
+def _auc_mu(num_class, weights_list=None):
+    """AUC-mu (Kleiman & Page; reference ``AucMuMetric``,
+    ``multiclass_metric.hpp:183``): mean over class pairs (i < j) of the AUC
+    separating the two classes along the partition-matrix direction
+    v = W[i] - W[j], with ranking value t1 * (score . v)."""
+    K = num_class
+    if weights_list:
+        W = np.asarray(weights_list, np.float64).reshape(K, K)
+    else:
+        W = np.ones((K, K)) - np.eye(K)   # config.cpp:222-224 default
+
+    def fn(label, score, weight, group):
+        score = np.asarray(score, np.float64).reshape(-1, K)
+        y = np.asarray(label, np.int64)
+        total, pairs = 0.0, 0
+        for i in range(K):
+            for j in range(i + 1, K):
+                v = W[i] - W[j]
+                t1 = v[i] - v[j]
+                idx = np.where((y == i) | (y == j))[0]
+                pos = y[idx] == i
+                if not pos.any() or pos.all():
+                    continue
+                d = t1 * (score[idx] @ v)
+                w = None if weight is None else np.asarray(weight)[idx]
+                total += _auc(pos.astype(np.float64), d, w, None)
+                pairs += 1
+        return total / max(pairs, 1)
+    return fn
+
+
 # ---------------------------------------------------------------------- ranking
 def _group_bounds(group: np.ndarray) -> np.ndarray:
     return np.concatenate([[0], np.cumsum(np.asarray(group, np.int64))])
@@ -312,6 +343,8 @@ def create_metric(name: str, cfg: Config) -> List[Metric]:
         "multi_logloss": Metric("multi_logloss", False, _multi_logloss),
         "multi_error": Metric("multi_error", False,
                               _multi_error(cfg.multi_error_top_k)),
+        "auc_mu": Metric("auc_mu", True,
+                         _auc_mu(cfg.num_class, cfg.auc_mu_weights)),
         "cross_entropy": Metric("cross_entropy", False, _xentropy),
         "cross_entropy_lambda": Metric("cross_entropy_lambda", False,
                                        _xentlambda),

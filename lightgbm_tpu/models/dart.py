@@ -24,8 +24,9 @@ class DART(GBDT):
 
     def _tree_pred_idx(self, k: int, idx: int, bins):
         pred = self._tree_pred_idx_raw(k, idx, bins)
-        # bins_dev may carry shard-padding rows (data meshes); scores do not.
-        if bins is self.bins_dev:
+        # train bins may carry shard-padding rows (data meshes); scores do
+        # not.
+        if bins is self.score_bins_dev:
             return pred[:self.scores.shape[0]]
         return pred
 
@@ -54,7 +55,7 @@ class DART(GBDT):
     def _scale_new_tree(self, k: int, idx: int, factor: float) -> None:
         """Scale the freshly-trained tree and fix up all score arrays."""
         delta = factor - 1.0
-        self._add_scores(k, self._tree_pred_idx(k, idx, self.bins_dev) * delta)
+        self._add_scores(k, self._tree_pred_idx(k, idx, self.score_bins_dev) * delta)
         for i, vbins in enumerate(self.valid_bins):
             self._add_valid(i, k, self._tree_pred_idx(k, idx, vbins) * delta)
         self._scale_stored_tree(k, idx, factor)
@@ -78,7 +79,7 @@ class DART(GBDT):
         drop_preds: dict = {}
         for k in range(self.num_class):
             for idx in drop_idx:
-                pred = self._tree_pred_idx(k, idx, self.bins_dev)
+                pred = self._tree_pred_idx(k, idx, self.score_bins_dev)
                 drop_preds[(k, idx)] = pred
                 self._add_scores(k, -pred)
         stop = super().train_one_iter(grad, hess)

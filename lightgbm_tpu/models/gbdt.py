@@ -115,7 +115,8 @@ class GBDT:
         self.base_model = base_model
         self.objective: Optional[ObjectiveFunction] = create_objective(cfg)
         if self.objective is not None:
-            self.objective.init(train.label, train.weight, train.group, cfg)
+            self.objective.init(train.label, train.weight, train.group,
+                                cfg, position=train.position)
         self.metrics = self._create_metrics()
         # Device-resident ensemble: dev_models holds TreeArrays in HBM (the
         # reference's CUDATree); host Tree mirrors are materialized lazily in
@@ -139,17 +140,17 @@ class GBDT:
                 f"monotone_constraints_method="
                 f"{cfg.monotone_constraints_method} is not supported; only "
                 f"'basic' (with monotone_penalty) is implemented")
-        # Storage-layout knobs with no TPU analog: the dense (N, F) uint8 HBM
-        # layout has no sparse bins, no EFB bundles and no two-pass text
-        # loading, so these parse but cannot change behavior — say so loudly
-        # instead of silently ignoring them.
+        # Storage-layout knobs with no TPU analog: two-pass text loading has
+        # no dense-HBM equivalent, and is_enable_sparse is subsumed by EFB
+        # (enable_bundle), which covers the sparse-column win here — say so
+        # loudly instead of silently ignoring them.
         from ..utils.log import Log
-        for pname in ("is_enable_sparse", "enable_bundle", "two_round"):
+        for pname in ("is_enable_sparse", "two_round"):
             if pname in cfg.raw_params:
                 Log.warning(
                     f"{pname} has no effect on the TPU build: bins are "
-                    "stored as one dense (rows, features) device array "
-                    "(see binning.py)")
+                    "stored as one dense (rows, features) device array and "
+                    "sparse columns are handled by EFB (enable_bundle)")
         from ..parallel.mesh import DATA_AXIS, FEATURE_AXIS
         # Data-only meshes use the sharded permutation layout (shard_map:
         # per-shard pallas histograms + one psum per wave).  Feature-sharded
@@ -172,15 +173,60 @@ class GBDT:
                 "feature_fraction_bynode/interaction_constraints/CEGB; "
                 "falling back to data-parallel")
             voting = False
+        if voting and forced is not None:
+            Log.warning("tree_learner=voting does not compose with forced "
+                        "splits; falling back to data-parallel")
+            voting = False
+        # EFB (reference FindGroups/FeatureGroup): histogram/partition run
+        # on the bundled column matrix; split scans see reconstructed
+        # per-feature views (models/grower.py _expand_hist).
+        self.bundles = train.build_bundles(cfg)
+        # Forced splits (reference ForceSplits JSON,
+        # serial_tree_learner.cpp:620): BFS-flatten the nested
+        # {feature, threshold, left, right} tree, thresholds -> bins.
+        forced = None
+        leaf_batch = cfg.tpu_leaf_batch
+        if cfg.forcedsplits_filename:
+            import json as _json
+            with open(cfg.forcedsplits_filename) as fh:
+                root_spec = _json.load(fh)
+            nodes = []
+            queue = [(root_spec, -1, True)]
+            while queue:
+                spec, parent, is_left = queue.pop(0)
+                fi = int(spec["feature"])
+                thr = float(spec["threshold"])
+                sbin = int(train.binned.mappers[fi].value_to_bin(
+                    np.asarray([thr]))[0])
+                idx = len(nodes)
+                nodes.append([fi, sbin, -1, -1])
+                if parent >= 0:
+                    nodes[parent][2 if is_left else 3] = idx
+                if "left" in spec and spec["left"]:
+                    queue.append((spec["left"], idx, True))
+                if "right" in spec and spec["right"]:
+                    queue.append((spec["right"], idx, False))
+            forced = tuple(tuple(nd) for nd in nodes)
+            if leaf_batch > 1:
+                Log.warning("forced splits require sequential leaf-wise "
+                            "growth; disabling wave batching "
+                            "(tpu_leaf_batch=1)")
+                leaf_batch = 1
+        if self.bundles is not None:
+            Log.info(f"EFB: bundled {train.num_features} features into "
+                     f"{self.bundles.num_groups} columns")
         self.grower_cfg = GrowerConfig(
             num_leaves=cfg.num_leaves,
             max_depth=cfg.max_depth,
             num_bins=train.binned.max_num_bins,
+            hist_bins=(self.bundles.max_group_bins
+                       if self.bundles is not None else 0),
             split=_split_config(cfg, train),
             histogram_impl=hist_impl,
             rows_block=cfg.tpu_rows_block,
             gather_rows=self.mesh is None or data_only_mesh,
-            leaf_batch=cfg.tpu_leaf_batch,
+            leaf_batch=leaf_batch,
+            forced_splits=forced,
             feature_fraction_bynode=cfg.feature_fraction_bynode,
             interaction_groups=self.feature_sampler.interaction_groups,
             quantized=cfg.use_quantized_grad,
@@ -189,6 +235,7 @@ class GBDT:
             quant_renew_leaf=cfg.quant_train_renew_leaf,
             voting=voting,
             vote_top_k=cfg.top_k,
+            bundled=self.bundles is not None,
         )
         self._quant_key = (jax.random.PRNGKey(cfg.seed)
                            if cfg.use_quantized_grad else None)
@@ -200,7 +247,13 @@ class GBDT:
                 cfg.extra_seed * 92821 + cfg.feature_fraction_seed)
         self.grow = make_grower(self.grower_cfg, mesh=self.mesh,
                                 data_axis=DATA_AXIS)
-        self.bins_dev = train.bins_device()
+        if self.bundles is not None:
+            self.bins_dev = train.bundled_bins_device()
+            self._fg_dev = jnp.asarray(self.bundles.feat_group, jnp.int32)
+            self._fo_dev = jnp.asarray(self.bundles.feat_offset, jnp.int32)
+        else:
+            self.bins_dev = train.bins_device()
+            self._fg_dev = self._fo_dev = None
         self.meta_dev = train.feature_meta_device()
         if self.mesh is not None:
             if data_only_mesh:
@@ -278,7 +331,8 @@ class GBDT:
                 self.bins_dev, grad_k, hess_k, mask, fmask,
                 meta["num_bins_per_feature"], meta["nan_bins"],
                 meta["is_categorical"], meta["monotone"],
-                cegb_coupled, cegb_lazy, quant_key, split_key)
+                cegb_coupled, cegb_lazy, quant_key, split_key,
+                self._fg_dev, self._fo_dev)
             grew = arrays.num_leaves > 1
             lv = jnp.where(grew, arrays.leaf_value * shrink, 0.0)
             arrays = arrays._replace(
@@ -458,13 +512,31 @@ class GBDT:
         self._linear_nls = []
         return all(int(x) <= 1 for x in nls)
 
+    @property
+    def score_bins_dev(self):
+        """ORIGINAL-feature-space train bins for on-device tree prediction
+        (rollback, DART drop/renorm).  Equals ``bins_dev`` unless EFB is
+        active, in which case the original (N, F) matrix is ALSO kept on
+        device — an F/G x memory overhead paid only when a consumer (DART,
+        rollback) actually needs it."""
+        if self.bundles is None:
+            return self.bins_dev
+        if self.train_data._bins_dev is None:
+            from ..utils.log import Log
+            Log.warning(
+                "EFB + DART/rollback keeps both the bundled and the "
+                "original bin matrices on device; set enable_bundle=false "
+                "if HBM is tight")
+        return self.train_data.bins_device()
+
     def _raw_grow(self, gk, hk, mask_dev, fmask, quant_key=None,
                   split_key=None):
         return self.grow(
             self.bins_dev, gk, hk, mask_dev, fmask,
             self.meta_dev["num_bins_per_feature"], self.meta_dev["nan_bins"],
             self.meta_dev["is_categorical"], self.meta_dev["monotone"],
-            None, None, quant_key, split_key)
+            None, None, quant_key, split_key,
+            self._fg_dev, self._fo_dev)
 
     def _renew_and_shrink(self, arrays: TreeArrays, row_leaf, scores_k,
                           shrink: float) -> TreeArrays:
@@ -712,9 +784,8 @@ class GBDT:
                 continue
             dev_tree = _tree_dict(arrays)
             pred = predict_tree_bins_device(
-                dev_tree, self.bins_dev, self.meta_dev["nan_bins"])
-            # bins_dev may carry shard-padding rows (data meshes); scores
-            # do not.
+                dev_tree, self.score_bins_dev, self.meta_dev["nan_bins"])
+            # bins may carry shard-padding rows (data meshes); scores do not.
             pred = pred[:self.scores.shape[0]]
             if self._shape_k:
                 self.scores = self.scores.at[:, k].add(-pred)

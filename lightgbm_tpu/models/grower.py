@@ -46,8 +46,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops.histogram import histogram_from_vals
-from ..ops.split import (BestSplit, SplitConfig, best_split, leaf_output,
-                         smoothed_output)
+from ..ops.split import (BestSplit, SplitConfig, best_split, leaf_gain,
+                         leaf_output, smoothed_output)
 
 _NEG_INF = -jnp.inf
 _MIN_BUCKET = 2048
@@ -97,6 +97,19 @@ class GrowerConfig:
     # volume drops from F*B to 2k*B per child.
     voting: bool = False
     vote_top_k: int = 20
+    # EFB (reference FeatureGroup/FindGroups, feature_group.h:26): the bins
+    # matrix holds G bundled columns; histograms/partitions run in bundle
+    # space and per-ORIGINAL-feature views are reconstructed at split-scan
+    # time (binning.FeatureBundles).  meta gains (feat_group, feat_offset).
+    # ``hist_bins`` is the bundle-space bin axis (max_group_bins, can exceed
+    # the scan axis ``num_bins``); 0 means equal to ``num_bins``.
+    bundled: bool = False
+    hist_bins: int = 0
+    # Forced splits (reference ForceSplits, serial_tree_learner.cpp:620):
+    # BFS-ordered tuples (feature, bin, left_child_idx, right_child_idx)
+    # applied before gain-driven growth; indices refer into this tuple,
+    # -1 = no forced child.
+    forced_splits: Optional[Tuple[Tuple[int, int, int, int], ...]] = None
 
 
 class TreeArrays(NamedTuple):
@@ -153,6 +166,7 @@ class _GrowState(NamedTuple):
     feat_used: jnp.ndarray       # (F,) bool — features split on so far (CEGB)
     leaf_path: jnp.ndarray       # (L, F) bool — features on each leaf's path
     rng: jnp.ndarray             # (2,) u32 PRNG key (extra_trees / bynode)
+    forced_leaf: jnp.ndarray     # (K,) i32 leaf of each pending forced split
     tree: TreeArrays
 
 
@@ -192,6 +206,15 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
     ``psum`` per wave (see module docstring)."""
 
     L, B = cfg.num_leaves, cfg.num_bins
+    HB = cfg.hist_bins or cfg.num_bins   # histogram-storage bin axis
+    forced = cfg.forced_splits or ()
+    n_forced = min(len(forced), max(L - 1, 0))
+    if n_forced:
+        _fs = np.asarray(forced[:n_forced], np.int32)
+        F_FEAT = jnp.asarray(_fs[:, 0])
+        F_BIN = jnp.asarray(_fs[:, 1])
+        F_LC = jnp.asarray(_fs[:, 2])
+        F_RC = jnp.asarray(_fs[:, 3])
     M = max(L - 1, 1)
     use_rand = cfg.split.extra_trees
     use_bynode = cfg.feature_fraction_bynode < 1.0
@@ -236,7 +259,7 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
     def _best_for(hist, pg, ph, pc, meta, feature_mask, penalty=None,
                   parent_out=None, key=None, path=None, groups_mat=None,
                   out_lo=None, out_hi=None, leaf_depth=None):
-        nbpf, nan_bins, is_cat, monotone = meta
+        nbpf, nan_bins, is_cat, monotone = meta[:4]
         rand_bins = None
         if need_key and key is not None:
             feature_mask, rand_bins = _node_inputs(key, feature_mask, nbpf)
@@ -276,7 +299,7 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
                         depthk=None):
         """All k children's split searches in one vmapped program — one
         kernel set per wave instead of per child."""
-        nbpf, nan_bins, is_cat, monotone = meta
+        nbpf, nan_bins, is_cat, monotone = meta[:4]
         k = histk.shape[0]
         if parent_outk is None:
             parent_outk = jnp.zeros(k, jnp.float32)
@@ -328,6 +351,10 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
 
     _best_for_pair = _best_for_batch
 
+    if n_forced and (cfg.leaf_batch > 1 or cfg.voting):
+        raise ValueError(
+            "forced splits require leaf_batch=1 and are not supported with "
+            "voting-parallel (the wave scheduler would reorder them)")
     if cfg.voting and (use_rand or use_bynode or use_groups
                        or cfg.split.use_cegb):
         raise ValueError(
@@ -343,12 +370,16 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
         top-k features by LOCAL split gain; only the global top-2k features'
         histogram slices are psum'd, then the real split search runs on the
         compact global slices."""
-        nbpf, nan_bins, is_cat, monotone = meta
-        k_child, f = hist_loc.shape[0], hist_loc.shape[1]
+        nbpf, nan_bins, is_cat, monotone = meta[:4]
+        k_child, f = hist_loc.shape[0], meta[0].shape[0]
         kk = min(cfg.vote_top_k, f)
         sel_k = min(2 * kk, f)
         hist_loc_s = _scale_hist(hist_loc, scale3)
         loc_tot = jnp.sum(hist_loc_s[:, 0], axis=1)            # (k, 3)
+        # EFB: expansion is linear in the histogram, so psum of expanded
+        # slices equals expansion of psum'd slices — F-space throughout.
+        hist_loc_s = _expand_hist_batch(hist_loc_s, meta, loc_tot[:, 0],
+                                        loc_tot[:, 1], loc_tot[:, 2])
         if depthk is None:
             depthk = jnp.zeros(k_child, jnp.int32)
         if boundsk is None:
@@ -377,13 +408,13 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
         # GlobalVoting orders by vote count): normalize gains into [0, 1)
         # so they can never outweigh one vote.
         gmax = jnp.max(gsum, axis=-1, keepdims=True)
-        tie = gsum / jnp.maximum(gmax * (1.0 + 1e-6), 1e-30)
+        tie = jnp.where(gmax > 0.0,
+                        gsum / jnp.maximum(gmax * (1.0 + 1e-6), 1e-30), 0.0)
         score = votes.astype(jnp.float32) + tie
         _, sel = jax.lax.top_k(score, sel_k)           # (k, 2k) replicated
         hist_sel = jnp.take_along_axis(
-            hist_loc, sel[:, :, None, None], axis=1)   # (k, 2k, B, 3) local
+            hist_loc_s, sel[:, :, None, None], axis=1)  # (k, 2k, B, 3) local
         hist_sel = jax.lax.psum(hist_sel, axis)        # ONLY winners cross
-        hist_sel = _scale_hist(hist_sel, scale3)
 
         def one(h, pg, ph, pc, po, selj, lo, hi, dep):
             bs = best_split(
@@ -414,7 +445,8 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
         pen = pen + t * lazy * count * (~path_used)
         return pen
 
-    def _init_state(n, f, root_hist, root_g, root_h, root_c, key=None):
+    def _init_state(n, f, gcols, root_hist, root_g, root_h, root_c,
+                    key=None):
         tree = TreeArrays(
             split_feature=jnp.zeros(M, jnp.int32),
             split_bin=jnp.zeros(M, jnp.int32),
@@ -436,7 +468,7 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
             perm=jnp.zeros(0, jnp.int32),  # set by caller when used
             leaf_start=jnp.zeros(L, jnp.int32),
             leaf_rows=jnp.zeros(L, jnp.int32).at[0].set(n),
-            leaf_hist=jnp.zeros((L, f, B, 3),
+            leaf_hist=jnp.zeros((L, gcols, HB, 3),
                                 root_hist.dtype).at[0].set(root_hist),
             leaf_sum_grad=jnp.zeros(L, jnp.float32).at[0].set(root_g),
             leaf_sum_hess=jnp.zeros(L, jnp.float32).at[0].set(root_h),
@@ -461,6 +493,7 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
             leaf_path=jnp.zeros((L, f), bool),
             rng=(key if key is not None
                  else jnp.zeros(2, jnp.uint32)),
+            forced_leaf=jnp.zeros(max(n_forced, 1), jnp.int32),
             tree=tree,
         )
 
@@ -555,10 +588,11 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
                 _cegb_penalty(cr, feat_used, child_path, coupled, lazy),
             ])
         hist2 = jnp.stack([hist_left, hist_right])     # RAW (stored)
-        hist2s = _scale_hist(hist2, scale3)            # scaled (split scan)
         g2 = jnp.stack([gl, gr])
         h2 = jnp.stack([hl, hr])
         c2 = jnp.stack([cl, cr])
+        hist2s = _expand_hist_batch(_scale_hist(hist2, scale3), meta,
+                                    g2, h2, c2)        # scaled (split scan)
         st = st._replace(
             num_leaves=st.num_leaves + 1,
             leaf_hist=st.leaf_hist.at[pair].set(hist2),
@@ -597,13 +631,16 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
             return hist
         return hist.astype(jnp.float32) * scale3
 
-    def _part_branch_for(bins_pad, nan_bins, S):
+    def _part_branch_for(bins_pad, nan_bins, S, meta=None):
         """Partition one leaf's contiguous perm slice of static size S
-        (cheap S-ops; no histogram).  Shared by the perm and wave layouts."""
+        (cheap S-ops; no histogram).  Shared by the perm and wave layouts.
+        Under EFB the split feature's column is decoded from its bundle."""
         def branch(perm, start, cnt, feat, sbin, dleft, scat, cmask):
             seg = jax.lax.dynamic_slice(perm, (start,), (S,))
             valid = jnp.arange(S, dtype=jnp.int32) < cnt
-            col = bins_pad[seg, feat].astype(jnp.int32)
+            gcol = meta[4][feat] if cfg.bundled else feat
+            col = _decode_col(bins_pad[seg, gcol].astype(jnp.int32), feat,
+                              meta)
             is_nan = col == nan_bins[feat]
             go_left = jnp.where(scat, cmask[col], col <= sbin)
             go_left = jnp.where(is_nan & ~scat, dleft, go_left)
@@ -620,6 +657,44 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
             return perm, nl_phys
         return branch
 
+    def _expand_hist(bh, meta, tg, th, tc):
+        """(G, B, 3) bundle histogram -> (F, B, 3) per-original-feature view
+        (reference: per-feature offsets into group histograms,
+        feature_histogram.hpp).  Bundled features' default bin 0 is
+        reconstructed as leaf_total - sum(non-default bins)."""
+        if not cfg.bundled:
+            return bh
+        nbpf, fg, fo = meta[0], meta[4], meta[5]
+        b_iota = jnp.arange(B)
+        ident = fo < 0
+        src_bin = jnp.where(ident[:, None], b_iota[None, :],
+                            fo[:, None] + b_iota[None, :] - 1)
+        valid = ident[:, None] | ((b_iota[None, :] >= 1)
+                                  & (b_iota[None, :] < nbpf[:, None]))
+        src_bin = jnp.clip(src_bin, 0, bh.shape[-2] - 1)
+        hf = bh[fg[:, None], src_bin, :] * valid[..., None]  # (F, B, 3)
+        tot = jnp.stack([tg, th, tc])
+        h0 = jnp.where(ident[:, None], hf[:, 0, :],
+                       tot[None, :] - jnp.sum(hf, axis=1))
+        return hf.at[:, 0, :].set(h0)
+
+    def _expand_hist_batch(bhk, meta, gk, hk, ck):
+        if not cfg.bundled:
+            return bhk
+        return jax.vmap(lambda b, g, h, c: _expand_hist(b, meta, g, h, c))(
+            bhk, gk, hk, ck)
+
+    def _decode_col(raw, feat, meta):
+        """Bundle-space bin -> original-feature bin for row partitioning."""
+        if not cfg.bundled:
+            return raw
+        nbpf, fo = meta[0], meta[5]
+        off = fo[feat]
+        nb = nbpf[feat]
+        return jnp.where(
+            off < 0, raw,
+            jnp.where((raw >= off) & (raw < off + nb - 1), raw - off + 1, 0))
+
     def _hist_branch_for(bins_pad, vals_pad, n, S):
         """RAW histogram of a contiguous perm range of static size S (the
         smaller sibling — the larger one comes from parent-hist subtraction,
@@ -630,10 +705,59 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
             valid = jnp.arange(S, dtype=jnp.int32) < cnt
             seg = jnp.where(valid, seg, n)
             return histogram_from_vals(
-                bins_pad[seg], vals_pad[seg], num_bins=B,
+                bins_pad[seg], vals_pad[seg], num_bins=HB,
                 impl=cfg.histogram_impl,
                 rows_block=min(cfg.rows_block, S))
         return branch
+
+    def _apply_forced(st, scale3, meta):
+        """When the current step has a pending forced split (reference
+        ForceSplits, serial_tree_learner.cpp:620), overwrite that leaf's
+        stored best split with the forced (feature, bin) and its histogram-
+        derived child stats; growth then proceeds through the normal split
+        machinery.  Returns (state, forced_active, forced_index)."""
+        step = st.num_leaves - 1
+        use = step < n_forced
+        si = jnp.clip(step, 0, n_forced - 1)
+        fleaf = st.forced_leaf[si]
+        feat = F_FEAT[si]
+        sbin = F_BIN[si]
+        hist = _expand_hist(
+            _scale_hist(st.leaf_hist[fleaf], scale3), meta,
+            st.leaf_sum_grad[fleaf], st.leaf_sum_hess[fleaf],
+            st.leaf_count[fleaf])
+        cum = jnp.cumsum(hist[feat], axis=0)          # (B, 3) missing-right
+        gl, hl, cl = cum[sbin, 0], cum[sbin, 1], cum[sbin, 2]
+        pg, ph = st.leaf_sum_grad[fleaf], st.leaf_sum_hess[fleaf]
+        fgain = (leaf_gain(gl, hl, cfg.split)
+                 + leaf_gain(pg - gl, ph - hl, cfg.split)
+                 - leaf_gain(pg, ph, cfg.split))
+        tgt = jnp.where(use, fleaf, L + M)            # OOB drop when unused
+        st = st._replace(
+            best_gain=st.best_gain.at[tgt].set(fgain, mode="drop"),
+            best_feature=st.best_feature.at[tgt].set(feat, mode="drop"),
+            best_bin=st.best_bin.at[tgt].set(sbin, mode="drop"),
+            best_default_left=st.best_default_left.at[tgt].set(
+                False, mode="drop"),
+            best_is_cat=st.best_is_cat.at[tgt].set(False, mode="drop"),
+            best_cat_mask=st.best_cat_mask.at[tgt].set(
+                jnp.zeros(B, bool), mode="drop"),
+            best_gl=st.best_gl.at[tgt].set(gl, mode="drop"),
+            best_hl=st.best_hl.at[tgt].set(hl, mode="drop"),
+            best_cl=st.best_cl.at[tgt].set(cl, mode="drop"),
+        )
+        return st, use, si
+
+    def _record_forced_children(st, use, si, leaf, new_leaf):
+        """Map the executed forced node's forced children onto the two
+        result leaves."""
+        lc = jnp.where(use & (F_LC[si] >= 0),
+                       jnp.clip(F_LC[si], 0, n_forced - 1), n_forced)
+        rc = jnp.where(use & (F_RC[si] >= 0),
+                       jnp.clip(F_RC[si], 0, n_forced - 1), n_forced)
+        return st._replace(
+            forced_leaf=st.forced_leaf.at[lc].set(leaf, mode="drop")
+                                      .at[rc].set(new_leaf, mode="drop"))
 
     def _root_best(state, scale3, meta, feature_mask, root_pen,
                    groups_mat=None):
@@ -642,7 +766,11 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
         if need_key:
             rng, key = jax.random.split(state.rng)
             state = state._replace(rng=rng)
-        bs = _best_for(_scale_hist(state.leaf_hist[0], scale3),
+        root_hist_s = _expand_hist(
+            _scale_hist(state.leaf_hist[0], scale3), meta,
+            state.leaf_sum_grad[0], state.leaf_sum_hess[0],
+            state.leaf_count[0])
+        bs = _best_for(root_hist_s,
                        state.leaf_sum_grad[0],
                        state.leaf_sum_hess[0], state.leaf_count[0], meta,
                        feature_mask, root_pen, state.leaf_out[0], key,
@@ -657,8 +785,10 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
         """Shared permutation-layout prologue: padded arrays, buckets, root
         histogram/state/best-split.  ``axis`` = shard_map axis name for the
         cross-shard histogram psum (None = single device)."""
-        n, f = bins.shape
-        bins_pad = jnp.concatenate([bins, jnp.zeros((1, f), bins.dtype)], 0)
+        n, gcols = bins.shape
+        nfeat = meta[0].shape[0]
+        bins_pad = jnp.concatenate([bins, jnp.zeros((1, gcols), bins.dtype)],
+                                   0)
         vals_pad = jnp.concatenate([vals, jnp.zeros((1, 3), vals.dtype)], 0)
         buckets = _split_buckets(n)
         max_bucket = buckets[-1]
@@ -666,7 +796,7 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
         perm0 = jnp.concatenate([jnp.arange(n, dtype=jnp.int32),
                                  jnp.full(max_bucket, n, jnp.int32)])
         root_hist = histogram_from_vals(
-            bins, vals, num_bins=B, impl=cfg.histogram_impl,
+            bins, vals, num_bins=HB, impl=cfg.histogram_impl,
             rows_block=cfg.rows_block)
         voting = cfg.voting and axis is not None
         if axis is not None and not voting:
@@ -679,7 +809,8 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
         if voting:
             root_tot = jax.lax.psum(root_tot, axis)
         root_g, root_h, root_c = root_tot[0], root_tot[1], root_tot[2]
-        state = _init_state(n, f, root_hist, root_g, root_h, root_c, key)
+        state = _init_state(n, nfeat, gcols, root_hist, root_g, root_h,
+                            root_c, key)
         state = state._replace(perm=perm0)
         root_pen = None
         if cfg.split.use_cegb and cegb is not None:
@@ -719,14 +850,15 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
                    key=None, axis=None):
         """Permutation-layout growth (single device, or per-shard under
         ``shard_map`` when ``axis`` names the mesh data axis)."""
-        n, f = bins.shape
+        n = bins.shape[0]
+        f = meta[0].shape[0]
         nan_bins = meta[1]
         groups_mat = _groups_matrix(f) if use_groups else None
         (state, bins_pad, vals_pad, buckets, buckets_arr,
          max_bucket) = _perm_setup(bins, vals, scale3, meta, feature_mask,
                                    cegb, key, groups_mat, axis)
 
-        part_branches = [_part_branch_for(bins_pad, nan_bins, S)
+        part_branches = [_part_branch_for(bins_pad, nan_bins, S, meta)
                          for S in buckets]
         hist_branches = [_hist_branch_for(bins_pad, vals_pad, n, S)
                          for S in buckets]
@@ -736,7 +868,14 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
                             0, len(buckets) - 1).astype(jnp.int32)
 
         def body(st: _GrowState) -> _GrowState:
-            leaf = jnp.argmax(st.best_gain).astype(jnp.int32)
+            use_f = jnp.asarray(False)
+            si = jnp.asarray(0)
+            if n_forced:
+                st, use_f, si = _apply_forced(st, scale3, meta)
+                leaf = jnp.where(use_f, st.forced_leaf[si],
+                                 jnp.argmax(st.best_gain)).astype(jnp.int32)
+            else:
+                leaf = jnp.argmax(st.best_gain).astype(jnp.int32)
             node = st.num_leaves - 1
             new_leaf = st.num_leaves
             start = st.leaf_start[leaf]
@@ -783,13 +922,19 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
                 leaf_rows=st.leaf_rows.at[leaf].set(nl_phys)
                                       .at[new_leaf].set(cnt - nl_phys),
             )
-            return _children_updates(st, leaf, new_leaf, hist_left,
-                                     hist_right, gl, hl, cl, gr, hr, cr,
-                                     meta, feature_mask, cegb, groups_mat,
-                                     scale3)
+            st = _children_updates(st, leaf, new_leaf, hist_left,
+                                    hist_right, gl, hl, cl, gr, hr, cr,
+                                    meta, feature_mask, cegb, groups_mat,
+                                    scale3)
+            if n_forced:
+                st = _record_forced_children(st, use_f, si, leaf, new_leaf)
+            return st
 
         def cond(st: _GrowState):
-            return (st.num_leaves < L) & (jnp.max(st.best_gain) > _NEG_INF)
+            more = jnp.max(st.best_gain) > _NEG_INF
+            if n_forced:
+                more = more | (st.num_leaves - 1 < n_forced)
+            return (st.num_leaves < L) & more
 
         state = jax.lax.while_loop(cond, body, state)
         return _finish(state), _row_leaf_from_perm(state, n, max_bucket)
@@ -806,7 +951,8 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
         larger siblings by subtraction, and run one vmapped split search
         over all 2W children.  Sequential depth per tree drops from
         num_leaves-1 steps to ~ceil((num_leaves-1)/W)."""
-        n, f = bins.shape
+        n, gcols = bins.shape
+        f = meta[0].shape[0]
         W = min(cfg.leaf_batch, max(L - 1, 1))
         voting = cfg.voting and axis is not None
         nan_bins = meta[1]
@@ -815,7 +961,7 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
          max_bucket) = _perm_setup(bins, vals, scale3, meta, feature_mask,
                                    cegb, key, groups_mat, axis)
 
-        part_branches = [_part_branch_for(bins_pad, nan_bins, S)
+        part_branches = [_part_branch_for(bins_pad, nan_bins, S, meta)
                          for S in buckets]
         hist_branches = [_hist_branch_for(bins_pad, vals_pad, n, S)
                          for S in buckets]
@@ -884,7 +1030,7 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
 
             hist_small = jax.lax.fori_loop(
                 0, W, hist_one,
-                jnp.zeros((W, f, B, 3), raw_dtype))           # (W, F, B, 3)
+                jnp.zeros((W, gcols, HB, 3), raw_dtype))      # (W, G, B, 3)
             if axis is not None and not voting:
                 # ONE cross-shard reduce per wave — integer tensors under
                 # quantized training (bin.h:48-81).  Voting mode reduces only
@@ -1024,7 +1170,9 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
                     cat2(hl, hr), cat2(cl, cr), cat2(out_l, out_r), scale3,
                     meta, feature_mask, bounds2, cat2(depth, depth), axis)
             else:
-                hist2s = _scale_hist(cat2(hist_left, hist_right), scale3)
+                hist2s = _expand_hist_batch(
+                    _scale_hist(cat2(hist_left, hist_right), scale3), meta,
+                    cat2(gl, gr), cat2(hl, hr), cat2(cl, cr))
                 bs = _best_for_batch(hist2s, cat2(gl, gr), cat2(hl, hr),
                                      cat2(cl, cr), meta, feature_mask,
                                      penalty2, cat2(out_l, out_r), node_key,
@@ -1064,7 +1212,8 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
     def _grow_mask(bins, vals, scale3, feature_mask, meta, cegb=None,
                    key=None):
         """Mask-layout growth (sharding-friendly; full-N pass per split)."""
-        n, f = bins.shape
+        n, gcols = bins.shape
+        f = meta[0].shape[0]
         groups_mat = _groups_matrix(f) if use_groups else None
         # Under a mesh this path runs on GSPMD-sharded operands OUTSIDE
         # shard_map; the pallas kernel is per-device-only, so route 'auto'
@@ -1081,16 +1230,17 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
             # scaling happens at split-scan consumption.
             masked = jnp.where(mask[:, None], vals, jnp.zeros_like(vals))
             return histogram_from_vals(
-                bins, masked, num_bins=B,
+                bins, masked, num_bins=HB,
                 impl=mask_impl, rows_block=cfg.rows_block)
 
         nan_bins = meta[1]
         root_hist = histogram_from_vals(
-            bins, vals, num_bins=B, impl=mask_impl,
+            bins, vals, num_bins=HB, impl=mask_impl,
             rows_block=cfg.rows_block)
         root_tot = jnp.sum(_scale_hist(root_hist[0:1], scale3)[0], axis=0)
         root_g, root_h, root_c = root_tot[0], root_tot[1], root_tot[2]
-        state = _init_state(n, f, root_hist, root_g, root_h, root_c, key)
+        state = _init_state(n, f, gcols, root_hist, root_g, root_h, root_c,
+                            key)
         row_leaf0 = jnp.zeros(n, jnp.int32)
         root_pen = None
         if cfg.split.use_cegb and cegb is not None:
@@ -1102,7 +1252,14 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
 
         def body(carry):
             st, row_leaf = carry
-            leaf = jnp.argmax(st.best_gain).astype(jnp.int32)
+            use_f = jnp.asarray(False)
+            si = jnp.asarray(0)
+            if n_forced:
+                st, use_f, si = _apply_forced(st, scale3, meta)
+                leaf = jnp.where(use_f, st.forced_leaf[si],
+                                 jnp.argmax(st.best_gain)).astype(jnp.int32)
+            else:
+                leaf = jnp.argmax(st.best_gain).astype(jnp.int32)
             node = st.num_leaves - 1
             new_leaf = st.num_leaves
 
@@ -1112,7 +1269,9 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
             scat = st.best_is_cat[leaf]
             cmask = st.best_cat_mask[leaf]
 
-            col = jnp.take(bins, feat, axis=1).astype(jnp.int32)
+            gcol = meta[4][feat] if cfg.bundled else feat
+            col = _decode_col(jnp.take(bins, gcol, axis=1).astype(jnp.int32),
+                              feat, meta)
             is_nan = col == nan_bins[feat]
             go_left = jnp.where(scat, cmask[col], col <= sbin)
             go_left = jnp.where(is_nan & ~scat, dleft, go_left)
@@ -1142,11 +1301,16 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
                                    hist_right, gl, hl, cl, gr, hr, cr,
                                    meta, feature_mask, cegb, groups_mat,
                                    scale3)
+            if n_forced:
+                st = _record_forced_children(st, use_f, si, leaf, new_leaf)
             return st, row_leaf
 
         def cond(carry):
             st, _ = carry
-            return (st.num_leaves < L) & (jnp.max(st.best_gain) > _NEG_INF)
+            more = jnp.max(st.best_gain) > _NEG_INF
+            if n_forced:
+                more = more | (st.num_leaves - 1 < n_forced)
+            return (st.num_leaves < L) & more
 
         state, row_leaf = jax.lax.while_loop(cond, body, (state, row_leaf0))
         return _finish(state), row_leaf
@@ -1183,7 +1347,11 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
             extras.append(split_key)
             especs.append(P())
 
-        def body(bins, vals, fmask, nbpf, nanb, iscat, mono, *extra):
+        n_meta = len(meta)
+
+        def body(bins, vals, fmask, *rest):
+            m = rest[:n_meta]
+            extra = rest[n_meta:]
             i = 0
             s3 = cg = sk = None
             if have_scale:
@@ -1194,13 +1362,12 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
                 i += 2
             if have_key:
                 sk = extra[i]
-            return grow_fn(bins, vals, s3, fmask, (nbpf, nanb, iscat, mono),
-                           cg, sk, axis=data_axis)
+            return grow_fn(bins, vals, s3, fmask, m, cg, sk, axis=data_axis)
 
         return shard_map(
             body, mesh=mesh,
-            in_specs=(P(data_axis), P(data_axis), P(), P(), P(), P(), P())
-            + tuple(especs),
+            in_specs=(P(data_axis), P(data_axis), P())
+            + (P(),) * n_meta + tuple(especs),
             out_specs=(P(), P(data_axis)),
             **smap_kw,
         )(bins, vals, feature_mask, *meta, *extras)
@@ -1221,11 +1388,17 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
         quant_key: Optional[jnp.ndarray] = None,     # PRNG key (quantized)
         split_key: Optional[jnp.ndarray] = None,     # PRNG key
                                                      # (extra_trees / bynode)
+        feat_group: Optional[jnp.ndarray] = None,    # (F,) i32 (EFB)
+        feat_offset: Optional[jnp.ndarray] = None,   # (F,) i32 (EFB)
     ) -> Tuple[TreeArrays, jnp.ndarray]:
         meta = (num_bins_per_feature, nan_bins, is_categorical, monotone)
+        if cfg.bundled:
+            if feat_group is None or feat_offset is None:
+                raise ValueError("bundled grower needs feat_group/feat_offset")
+            meta = meta + (feat_group, feat_offset)
         cegb = None
         if cfg.split.use_cegb:
-            f = bins.shape[1]
+            f = num_bins_per_feature.shape[0]
             coupled = (cegb_coupled if cegb_coupled is not None
                        else jnp.zeros(f, jnp.float32))
             lazy = (cegb_lazy if cegb_lazy is not None

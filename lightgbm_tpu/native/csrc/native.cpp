@@ -22,6 +22,7 @@
 #include <fstream>
 #include <limits>
 #include <string>
+#include <thread>
 #include <unordered_map>
 #include <vector>
 
@@ -321,29 +322,49 @@ void ltpu_bin_matrix(const double* X, int64_t n, int64_t f,
                      const double* upper_bounds, int64_t max_b,
                      const int32_t* n_value_bins, const int32_t* nan_bins,
                      const uint8_t* zero_as_missing, uint16_t* out) {
-  for (int64_t j = 0; j < f; ++j) {
-    const double* ub = upper_bounds + j * max_b;
-    int nb = n_value_bins[j] - 1;
-    int nanb = nan_bins[j];
-    bool zam = zero_as_missing[j] != 0;
-    for (int64_t i = 0; i < n; ++i) {
-      double v = X[i * f + j];
-      if (zam && is_zero(v)) v = std::numeric_limits<double>::quiet_NaN();
-      uint16_t b;
-      if (std::isnan(v)) {
-        b = nanb >= 0 ? static_cast<uint16_t>(nanb) : 0;
-      } else {
-        int lo = 0, hi = nb;
-        while (lo < hi) {
-          int mid = (lo + hi) >> 1;
-          if (ub[mid] < v) lo = mid + 1;
-          else hi = mid;
+  // Row-blocked across hardware threads (reference binning is OpenMP-
+  // parallel over features, dataset_loader.cpp ConstructBinMappers).
+  auto work = [&](int64_t r0, int64_t r1) {
+    for (int64_t i = r0; i < r1; ++i) {
+      for (int64_t j = 0; j < f; ++j) {
+        const double* ub = upper_bounds + j * max_b;
+        int nb = n_value_bins[j] - 1;
+        int nanb = nan_bins[j];
+        double v = X[i * f + j];
+        if (zero_as_missing[j] != 0 && is_zero(v))
+          v = std::numeric_limits<double>::quiet_NaN();
+        uint16_t b;
+        if (std::isnan(v)) {
+          b = nanb >= 0 ? static_cast<uint16_t>(nanb) : 0;
+        } else {
+          int lo = 0, hi = nb;
+          while (lo < hi) {
+            int mid = (lo + hi) >> 1;
+            if (ub[mid] < v) lo = mid + 1;
+            else hi = mid;
+          }
+          b = static_cast<uint16_t>(lo);
         }
-        b = static_cast<uint16_t>(lo);
+        out[i * f + j] = b;
       }
-      out[i * f + j] = b;
     }
+  };
+  unsigned hw = std::thread::hardware_concurrency();
+  int64_t nt = hw == 0 ? 1 : static_cast<int64_t>(hw);
+  if (nt > 64) nt = 64;
+  if (n < (1 << 17) || nt <= 1) {
+    work(0, n);
+    return;
   }
+  std::vector<std::thread> threads;
+  int64_t chunk = (n + nt - 1) / nt;
+  for (int64_t t = 0; t < nt; ++t) {
+    int64_t r0 = t * chunk;
+    int64_t r1 = r0 + chunk < n ? r0 + chunk : n;
+    if (r0 >= r1) break;
+    threads.emplace_back(work, r0, r1);
+  }
+  for (auto& th : threads) th.join();
 }
 
 // ----------------------------------------------------------------- prediction

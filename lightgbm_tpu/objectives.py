@@ -40,9 +40,11 @@ class ObjectiveFunction:
     stochastic_gradients = False
 
     def init(self, label: np.ndarray, weight: Optional[np.ndarray],
-             group: Optional[np.ndarray], cfg: Config) -> None:
+             group: Optional[np.ndarray], cfg: Config,
+             position: Optional[np.ndarray] = None) -> None:
         self.label = jnp.asarray(label, jnp.float32)
         self.weight = None if weight is None else jnp.asarray(weight, jnp.float32)
+        self.position = position
         self.cfg = cfg
 
     def _apply_weight(self, grad: Array, hess: Array) -> Tuple[Array, Array]:
@@ -124,8 +126,8 @@ class RegressionL2(ObjectiveFunction):
         super().__init__(name="regression", is_constant_hessian=True)
         self.sqrt = False
 
-    def init(self, label, weight, group, cfg):
-        super().init(label, weight, group, cfg)
+    def init(self, label, weight, group, cfg, position=None):
+        super().init(label, weight, group, cfg, position)
         self.sqrt = bool(cfg.reg_sqrt)
         if self.sqrt:
             self.label = jnp.sign(self.label) * jnp.sqrt(jnp.abs(self.label))
@@ -260,8 +262,8 @@ class MAPE(ObjectiveFunction):
         super().__init__(name="mape", is_constant_hessian=True,
                          need_renew_tree_output=True)
 
-    def init(self, label, weight, group, cfg):
-        super().init(label, weight, group, cfg)
+    def init(self, label, weight, group, cfg, position=None):
+        super().init(label, weight, group, cfg, position)
         scale = 1.0 / jnp.maximum(1.0, jnp.abs(self.label))
         self.weight = scale if self.weight is None else self.weight * scale
 
@@ -332,8 +334,8 @@ class Binary(ObjectiveFunction):
     def __init__(self):
         super().__init__(name="binary")
 
-    def init(self, label, weight, group, cfg):
-        super().init(label, weight, group, cfg)
+    def init(self, label, weight, group, cfg, position=None):
+        super().init(label, weight, group, cfg, position)
         label01 = np.asarray(label)
         npos = float((label01 > 0).sum())
         nneg = float(len(label01) - npos)
@@ -376,8 +378,8 @@ class MulticlassSoftmax(ObjectiveFunction):
     def __init__(self):
         super().__init__(name="multiclass")
 
-    def init(self, label, weight, group, cfg):
-        super().init(label, weight, group, cfg)
+    def init(self, label, weight, group, cfg, position=None):
+        super().init(label, weight, group, cfg, position)
         self.num_model_per_iteration = cfg.num_class
         self.onehot = jax.nn.one_hot(
             jnp.asarray(label, jnp.int32), cfg.num_class, dtype=jnp.float32)
@@ -404,8 +406,8 @@ class MulticlassOVA(ObjectiveFunction):
     def __init__(self):
         super().__init__(name="multiclassova")
 
-    def init(self, label, weight, group, cfg):
-        super().init(label, weight, group, cfg)
+    def init(self, label, weight, group, cfg, position=None):
+        super().init(label, weight, group, cfg, position)
         self.num_model_per_iteration = cfg.num_class
         self.onehot = jax.nn.one_hot(
             jnp.asarray(label, jnp.int32), cfg.num_class, dtype=jnp.float32)

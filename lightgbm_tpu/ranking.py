@@ -48,10 +48,25 @@ class LambdaRankNDCG(ObjectiveFunction):
     def __init__(self):
         super().__init__(name="lambdarank")
 
-    def init(self, label, weight, group, cfg: Config):
-        super().init(label, weight, group, cfg)
+    def init(self, label, weight, group, cfg: Config, position=None):
+        super().init(label, weight, group, cfg, position)
         if group is None:
             raise ValueError("lambdarank requires query/group information")
+        # Unbiased LTR (reference RankingObjective positions,
+        # rank_objective.hpp:43-86,296-333): scores are adjusted by learned
+        # per-position bias factors, updated each iteration with a
+        # Newton-Raphson step on the accumulated lambdas/hessians.
+        self.pos_ids = None
+        if position is not None:
+            _, pos_ids = np.unique(np.asarray(position), return_inverse=True)
+            self.num_positions = int(pos_ids.max()) + 1
+            self.pos_ids = jnp.asarray(pos_ids.astype(np.int32))
+            self.pos_bias = jnp.zeros(self.num_positions, jnp.float32)
+            self.bias_lr = cfg.learning_rate
+            self.bias_reg = cfg.lambdarank_position_bias_regularization
+            # bias update mutates host-side state each call: keep out of the
+            # fused once-traced path (same routing as RankXENDCG's PRNG).
+            self.stochastic_gradients = True
         label_np = np.asarray(label, np.float64)
         gains = (np.asarray(cfg.label_gain, np.float64)
                  if cfg.label_gain else default_label_gain())
@@ -145,9 +160,26 @@ class LambdaRankNDCG(ObjectiveFunction):
         return grads
 
     def get_gradients(self, score):
+        if self.pos_ids is not None:
+            score = score + self.pos_bias[self.pos_ids]
         grad, hess = self._grad_fn(score, self.doc_idx, self.valid, self.qgain,
                                    self.inv_max_dcg)
-        return self._apply_weight(grad, hess)
+        grad, hess = self._apply_weight(grad, hess)
+        if self.pos_ids is not None:
+            # Newton step on per-position utility derivatives
+            # (rank_objective.hpp:296-331): fd_p = -sum(lambda), sd_p =
+            # -sum(hessian), both L2-regularized by instance count.
+            fd = -jax.ops.segment_sum(grad, self.pos_ids,
+                                      num_segments=self.num_positions)
+            sd = -jax.ops.segment_sum(hess, self.pos_ids,
+                                      num_segments=self.num_positions)
+            cnt = jax.ops.segment_sum(jnp.ones_like(grad), self.pos_ids,
+                                      num_segments=self.num_positions)
+            fd = fd - self.pos_bias * self.bias_reg * cnt
+            sd = sd - self.bias_reg * cnt
+            self.pos_bias = self.pos_bias + self.bias_lr * fd / (
+                jnp.abs(sd) + 0.001)
+        return grad, hess
 
 
 class RankXENDCG(ObjectiveFunction):
@@ -162,8 +194,8 @@ class RankXENDCG(ObjectiveFunction):
     def __init__(self):
         super().__init__(name="rank_xendcg")
 
-    def init(self, label, weight, group, cfg: Config):
-        super().init(label, weight, group, cfg)
+    def init(self, label, weight, group, cfg: Config, position=None):
+        super().init(label, weight, group, cfg, position)
         if group is None:
             raise ValueError("rank_xendcg requires query/group information")
         doc_idx, _ = _pad_queries(group)

@@ -1,0 +1,65 @@
+"""Forced splits (reference ``ForceSplits``,
+``serial_tree_learner.cpp:620`` + ``forcedsplits_filename``): a JSON tree of
+(feature, threshold) is applied from the root before gain-driven growth."""
+
+import json
+
+import numpy as np
+import pytest
+from sklearn.datasets import make_classification
+
+import lightgbm_tpu as lgb
+
+
+def test_forced_root_and_nested_child(tmp_path):
+    X, y = make_classification(n_samples=2000, n_features=8, n_informative=4,
+                               random_state=0)
+    spec = {
+        "feature": 5, "threshold": 0.25,
+        "left": {"feature": 3, "threshold": -0.5},
+    }
+    path = tmp_path / "forced.json"
+    path.write_text(json.dumps(spec))
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "min_data_in_leaf": 5, "verbosity": -1,
+                     "forcedsplits_filename": str(path)},
+                    lgb.Dataset(X, label=y), 4)
+    for tree in bst._gbdt.models[0]:
+        # node 0 = forced root; node 1 = forced split of its LEFT child
+        assert tree.split_feature[0] == 5
+        assert tree.split_feature[1] == 3
+        # node 1 must actually be the left child of node 0
+        assert tree.left_child[0] == 1
+        # forced thresholds bin-quantized around the requested value
+        td = bst._gbdt.train_data
+        thr0 = td.binned.mappers[5].bin_to_threshold(tree.split_bin[0])
+        assert abs(thr0 - 0.25) < 0.2
+    # training still learns: accuracy beyond chance
+    acc = ((bst.predict(X) > 0.5) == (y > 0.5)).mean()
+    assert acc > 0.8
+
+
+def test_forced_splits_survive_model_roundtrip(tmp_path):
+    X, y = make_classification(n_samples=1200, n_features=6, random_state=1)
+    path = tmp_path / "forced.json"
+    path.write_text(json.dumps({"feature": 2, "threshold": 0.0}))
+    params = {"objective": "binary", "num_leaves": 7, "verbosity": -1,
+              "forcedsplits_filename": str(path)}
+    bst = lgb.train(params, lgb.Dataset(X, label=y), 3)
+    s = bst.model_to_string()
+    reloaded = lgb.Booster(model_str=s)
+    np.testing.assert_allclose(reloaded.predict(X[:50]), bst.predict(X[:50]),
+                               rtol=1e-6)
+
+
+def test_forced_splits_reject_wave_config(tmp_path):
+    X, y = make_classification(n_samples=4000 + 2100, n_features=6,
+                               random_state=2)
+    path = tmp_path / "forced.json"
+    path.write_text(json.dumps({"feature": 0, "threshold": 0.0}))
+    # leaf_batch>1 downgrades with a warning rather than erroring
+    bst = lgb.train({"objective": "binary", "num_leaves": 7, "verbosity": -1,
+                     "tpu_leaf_batch": 8,
+                     "forcedsplits_filename": str(path)},
+                    lgb.Dataset(X, label=y), 2)
+    assert bst._gbdt.models[0][0].split_feature[0] == 0

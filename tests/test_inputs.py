@@ -1,0 +1,111 @@
+"""Input-format coverage: pandas DataFrames (incl. categorical dtype) and
+scipy sparse matrices (reference python-package basic.py _data_from_pandas
+and CSR ingestion paths)."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+pd = pytest.importorskip("pandas")
+
+
+def test_pandas_dataframe_train_predict():
+    rng = np.random.RandomState(0)
+    n = 800
+    df = pd.DataFrame({
+        "a": rng.randn(n),
+        "b": rng.randn(n),
+        "c": pd.Categorical(rng.choice(["x", "y", "z"], n)),
+    })
+    y = (df["a"].to_numpy() + (df["c"] == "x").to_numpy() > 0).astype(float)
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "verbosity": -1}, lgb.Dataset(df, label=y), 10)
+    # auto feature names from columns
+    assert bst.feature_name() == ["a", "b", "c"]
+    p_df = bst.predict(df)
+    assert ((p_df > 0.5) == (y > 0.5)).mean() > 0.9
+    # categorical column handled as categorical (codes round-trip)
+    ds = lgb.Dataset(df, label=y)
+    td = ds.construct({"objective": "binary", "verbosity": -1})
+    assert bool(td.binned.is_categorical[2])
+
+
+def test_pandas_object_column_rejected():
+    df = pd.DataFrame({"a": [1.0, 2.0], "b": ["p", "q"]})
+    with pytest.raises(ValueError, match="object dtype"):
+        lgb.Dataset(df, label=[0, 1]).construct({"objective": "binary"})
+
+
+def test_scipy_sparse_input():
+    sp = pytest.importorskip("scipy.sparse")
+    rng = np.random.RandomState(1)
+    n, f = 600, 30
+    dense = np.zeros((n, f))
+    for j in range(f):
+        rows = rng.choice(n, size=20, replace=False)
+        dense[rows, j] = rng.rand(20) + 0.5
+    y = (dense[:, 0] > 0).astype(float)
+    X = sp.csr_matrix(dense)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "min_data_in_leaf": 5, "verbosity": -1},
+                    lgb.Dataset(X, label=y), 5)
+    p_sparse = bst.predict(sp.csr_matrix(dense[:50]))
+    p_dense = bst.predict(dense[:50])
+    np.testing.assert_allclose(p_sparse, p_dense, rtol=1e-9)
+
+
+def test_pandas_series_label_and_weight():
+    rng = np.random.RandomState(2)
+    X = rng.randn(300, 4)
+    y = pd.Series((X[:, 0] > 0).astype(float))
+    w = pd.Series(np.ones(300))
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "verbosity": -1},
+                    lgb.Dataset(X, label=y, weight=w), 3)
+    assert bst.num_trees() == 3
+
+
+def test_pyarrow_table_input():
+    pa = pytest.importorskip("pyarrow")
+    rng = np.random.RandomState(3)
+    n = 500
+    codes = rng.randint(0, 4, n)
+    tbl = pa.table({
+        "f0": rng.randn(n),
+        "f1": rng.randn(n),
+        "cat": pa.array(np.array(["a", "b", "c", "d"])[codes]).dictionary_encode(),
+    })
+    y = (tbl.column("f0").to_numpy() + (codes == 1) > 0.3).astype(float)
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "verbosity": -1}, lgb.Dataset(tbl, label=y), 8)
+    assert bst.feature_name() == ["f0", "f1", "cat"]
+    td = lgb.Dataset(tbl, label=y).construct({"objective": "binary",
+                                              "verbosity": -1})
+    assert bool(td.binned.is_categorical[2])
+    acc = ((bst.predict(tbl) > 0.5) == (y > 0.5)).mean()
+    assert acc > 0.85
+
+
+def test_chunked_and_sequence_input():
+    rng = np.random.RandomState(4)
+    chunks = [rng.randn(200, 4) for _ in range(5)]
+    X = np.concatenate(chunks, axis=0)
+    y = (X[:, 0] > 0).astype(float)
+
+    class _Seq(lgb.Sequence):
+        def __init__(self, arr):
+            self.arr = arr
+
+        def __len__(self):
+            return len(self.arr)
+
+        def __getitem__(self, idx):
+            return self.arr[idx]
+
+    for data in (chunks, _Seq(X), [_Seq(chunks[0]), _Seq(chunks[1]),
+                                   np.concatenate(chunks[2:], axis=0)]):
+        bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                         "verbosity": -1}, lgb.Dataset(data, label=y), 3)
+        p_chunks = bst.predict(X[:50])
+        assert p_chunks.shape == (50,)

@@ -96,3 +96,41 @@ def test_average_precision_matches_sklearn(rng):
     s = rng.randn(300) + y
     assert _metric("average_precision")(y, s) == pytest.approx(
         skm.average_precision_score(y, s), abs=1e-9)
+
+
+def test_auc_mu_matches_pairwise_auc():
+    """auc_mu default weights reduce each pair to AUC on score_i - score_j
+    (reference AucMuMetric, multiclass_metric.hpp:183)."""
+    from lightgbm_tpu.metrics import _auc, _auc_mu
+
+    rng = np.random.RandomState(0)
+    n, k = 600, 3
+    y = rng.randint(0, k, n).astype(np.float64)
+    s = rng.randn(n, k) + 1.2 * np.eye(k)[y.astype(int)]
+    got = _auc_mu(k)(y, s, None, None)
+    expect = []
+    for i in range(k):
+        for j in range(i + 1, k):
+            m = (y == i) | (y == j)
+            # default W: v = e_j-ish rows -> t1*(score.v) = 2*(s_i - s_j)
+            d = s[m, i] - s[m, j]
+            expect.append(_auc((y[m] == i).astype(np.float64), d, None, None))
+    assert abs(got - float(np.mean(expect))) < 1e-12
+    assert 0.5 < got <= 1.0
+
+
+def test_auc_mu_trains_as_metric():
+    import lightgbm_tpu as lgb
+
+    rng = np.random.RandomState(1)
+    X = rng.randn(400, 5)
+    y = (X[:, 0] > 0).astype(int) + (X[:, 1] > 0.5).astype(int)
+    bst = lgb.train({"objective": "multiclass", "num_class": 3,
+                     "metric": "auc_mu", "num_leaves": 7, "verbosity": -1},
+                    lgb.Dataset(X, label=y), 3,
+                    valid_sets=[lgb.Dataset(X, label=y)])
+    res = bst._gbdt.eval_set()
+    names = [m for _, m, _, _ in res]
+    assert "auc_mu" in names
+    val = dict((m, v) for _, m, v, _ in res)["auc_mu"]
+    assert 0.5 < val <= 1.0
