@@ -283,6 +283,67 @@ class Dataset:
         self._train_data = None
         return self
 
+    def subset(self, used_indices, params=None):
+        """Row-subset Dataset (reference ``Dataset.subset`` /
+        ``CopySubrow`` — used by cv folds and bagging-style workflows).
+        Bins with THIS dataset as reference so mappers stay identical."""
+        if self.group is not None:
+            raise ValueError(
+                "subset() cannot slice a Dataset with query groups; "
+                "slice whole queries and rebuild the Dataset instead")
+        idx = np.asarray(used_indices, np.int64)
+        return Dataset(
+            self.data[idx],
+            label=None if self.label is None else self.label[idx],
+            reference=self,
+            weight=None if self.weight is None else self.weight[idx],
+            position=None if self.position is None else self.position[idx],
+            init_score=(None if self.init_score is None
+                        else np.asarray(self.init_score)[idx]),
+            feature_name=self.feature_name,
+            categorical_feature=self.categorical_feature,
+            params=dict(self.params, **(params or {})),
+        )
+
+    def add_features_from(self, other: "Dataset"):
+        """Horizontally stack another Dataset's features (reference
+        ``Dataset.add_features_from`` / ``AddFeaturesFrom``)."""
+        if self.num_data() != other.num_data():
+            raise ValueError("add_features_from needs equal row counts")
+        f0 = self.num_feature()
+        self.data = np.concatenate([self.data, other.data], axis=1)
+        if isinstance(self.feature_name, list) \
+                or isinstance(other.feature_name, list):
+            def _names(ds, base):
+                if isinstance(ds.feature_name, list):
+                    return list(ds.feature_name)
+                return [f"Column_{base + i}" for i in range(ds.num_feature())]
+            self.feature_name = _names(self, 0) + _names(other, f0)
+
+        def _cats_as_ints(ds, base):
+            spec = ds.categorical_feature
+            if not isinstance(spec, (list, tuple)):
+                return []
+            names = (ds.feature_name if isinstance(ds.feature_name, list)
+                     else [])
+            out = []
+            for c in spec:
+                if isinstance(c, int):
+                    out.append(c + base)
+                elif c in names:
+                    out.append(names.index(c) + base)
+                else:
+                    raise ValueError(
+                        f"categorical feature {c!r} not resolvable during "
+                        "add_features_from; use integer indices")
+            return out
+
+        cats = _cats_as_ints(self, 0) + _cats_as_ints(other, f0)
+        if cats:
+            self.categorical_feature = cats
+        self._train_data = None
+        return self
+
     def set_position(self, position):
         """Per-row positions for unbiased LTR (reference
         ``Dataset.set_position`` / Metadata positions)."""

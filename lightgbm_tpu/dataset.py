@@ -39,7 +39,7 @@ class TrainData:
     # EFB (reference FeatureGroup/FindGroups): bundled column matrix used by
     # the grower's histogram/partition hot path; built lazily on demand.
     bundles: Optional[object] = None
-    _bundles_tried: bool = False
+    _bundles_key: Optional[tuple] = None
     # device arrays (lazily uploaded)
     _bins_dev: Optional[jnp.ndarray] = None
     _bundled_bins_dev: Optional[jnp.ndarray] = None
@@ -112,8 +112,11 @@ class TrainData:
     def build_bundles(self, cfg: Config):
         """EFB bundling (reference FindGroups); None when data is dense or
         bundling is disabled.  Cached per TrainData."""
-        if not self._bundles_tried:
-            self._bundles_tried = True
+        key = (bool(cfg.enable_bundle), float(cfg.max_conflict_rate))
+        if self._bundles_key != key:
+            self._bundles_key = key
+            self.bundles = None
+            self._bundled_bins_dev = None
             if cfg.enable_bundle:
                 from .binning import build_bundles
                 self.bundles = build_bundles(

@@ -173,10 +173,6 @@ class GBDT:
                 "feature_fraction_bynode/interaction_constraints/CEGB; "
                 "falling back to data-parallel")
             voting = False
-        if voting and forced is not None:
-            Log.warning("tree_learner=voting does not compose with forced "
-                        "splits; falling back to data-parallel")
-            voting = False
         # EFB (reference FindGroups/FeatureGroup): histogram/partition run
         # on the bundled column matrix; split scans see reconstructed
         # per-feature views (models/grower.py _expand_hist).
@@ -195,6 +191,10 @@ class GBDT:
             while queue:
                 spec, parent, is_left = queue.pop(0)
                 fi = int(spec["feature"])
+                if train.binned.mappers[fi].is_categorical:
+                    raise ValueError(
+                        f"forced split on categorical feature {fi} is not "
+                        "supported (numerical thresholds only)")
                 thr = float(spec["threshold"])
                 sbin = int(train.binned.mappers[fi].value_to_bin(
                     np.asarray([thr]))[0])
@@ -212,6 +212,10 @@ class GBDT:
                             "growth; disabling wave batching "
                             "(tpu_leaf_batch=1)")
                 leaf_batch = 1
+            if voting:
+                Log.warning("tree_learner=voting does not compose with "
+                            "forced splits; falling back to data-parallel")
+                voting = False
         if self.bundles is not None:
             Log.info(f"EFB: bundled {train.num_features} features into "
                      f"{self.bundles.num_groups} columns")
@@ -241,6 +245,7 @@ class GBDT:
                            if cfg.use_quantized_grad else None)
         # PRNG for per-node randomness (extra_trees thresholds / bynode
         # feature sampling; reference extra_seed / feature_fraction_seed).
+        self._goss_key = jax.random.PRNGKey(cfg.bagging_seed)
         self._split_key = None
         if cfg.extra_trees or cfg.feature_fraction_bynode < 1.0:
             self._split_key = jax.random.PRNGKey(
@@ -393,16 +398,23 @@ class GBDT:
         n = self.train_data.num_data
         grads = None
         if strategy.is_goss:
+            top_k, other_k, amp = strategy.goss_constants()
             if grad is None:
+                # Device-resident GOSS (reference goss.hpp:30-60): gradients
+                # never leave HBM (round-1/2 review: the host argsort pull
+                # was a flagged per-iteration round trip).
+                from ..sampling import goss_mask_device
                 g_dev, h_dev = self._grad_fn(self.scores)
                 grads = (g_dev, h_dev)
-                gm = np.asarray(jax.device_get(g_dev)).reshape(n, -1)
-                hm = np.asarray(jax.device_get(h_dev)).reshape(n, -1)
+                gs = g_dev.reshape(n, -1).sum(axis=1)
+                hs = h_dev.reshape(n, -1).sum(axis=1)
+                key = jax.random.fold_in(self._goss_key, self.iter_)
+                mask_dev = goss_mask_device(gs, hs, key, top_k, other_k, amp)
             else:
                 gm = np.asarray(grad).reshape(n, -1)
                 hm = np.asarray(hess).reshape(n, -1)
-            mask_dev = jnp.asarray(strategy.mask(
-                self.iter_, gm.sum(axis=1), hm.sum(axis=1)))
+                mask_dev = jnp.asarray(strategy.mask(
+                    self.iter_, gm.sum(axis=1), hm.sum(axis=1)))
         elif strategy.is_bagging:
             if strategy.needs_resample(self.iter_) or self._bag_mask_dev is None:
                 self._bag_mask_dev = jnp.asarray(strategy.mask(self.iter_))

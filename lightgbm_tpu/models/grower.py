@@ -412,9 +412,17 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
                         gsum / jnp.maximum(gmax * (1.0 + 1e-6), 1e-30), 0.0)
         score = votes.astype(jnp.float32) + tie
         _, sel = jax.lax.top_k(score, sel_k)           # (k, 2k) replicated
-        hist_sel = jnp.take_along_axis(
-            hist_loc_s, sel[:, :, None, None], axis=1)  # (k, 2k, B, 3) local
-        hist_sel = jax.lax.psum(hist_sel, axis)        # ONLY winners cross
+        if cfg.bundled:
+            # expansion already happened (linear, psum-compatible)
+            hist_sel = jnp.take_along_axis(
+                hist_loc_s, sel[:, :, None, None], axis=1)
+            hist_sel = jax.lax.psum(hist_sel, axis)    # ONLY winners cross
+        else:
+            # psum the RAW slices (integer tensors under quantized
+            # training, bin.h:48-81); scale after the reduce.
+            hist_sel = jnp.take_along_axis(
+                hist_loc, sel[:, :, None, None], axis=1)
+            hist_sel = _scale_hist(jax.lax.psum(hist_sel, axis), scale3)
 
         def one(h, pg, ph, pc, po, selj, lo, hi, dep):
             bs = best_split(
@@ -722,16 +730,24 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
         fleaf = st.forced_leaf[si]
         feat = F_FEAT[si]
         sbin = F_BIN[si]
-        hist = _expand_hist(
-            _scale_hist(st.leaf_hist[fleaf], scale3), meta,
-            st.leaf_sum_grad[fleaf], st.leaf_sum_hess[fleaf],
-            st.leaf_count[fleaf])
-        cum = jnp.cumsum(hist[feat], axis=0)          # (B, 3) missing-right
-        gl, hl, cl = cum[sbin, 0], cum[sbin, 1], cum[sbin, 2]
-        pg, ph = st.leaf_sum_grad[fleaf], st.leaf_sum_hess[fleaf]
-        fgain = (leaf_gain(gl, hl, cfg.split)
-                 + leaf_gain(pg - gl, ph - hl, cfg.split)
-                 - leaf_gain(pg, ph, cfg.split))
+
+        def _forced_stats(_):
+            hist = _expand_hist(
+                _scale_hist(st.leaf_hist[fleaf], scale3), meta,
+                st.leaf_sum_grad[fleaf], st.leaf_sum_hess[fleaf],
+                st.leaf_count[fleaf])
+            cum = jnp.cumsum(hist[feat], axis=0)      # (B, 3) missing-right
+            gl, hl, cl = cum[sbin, 0], cum[sbin, 1], cum[sbin, 2]
+            pg, ph = st.leaf_sum_grad[fleaf], st.leaf_sum_hess[fleaf]
+            fgain = (leaf_gain(gl, hl, cfg.split)
+                     + leaf_gain(pg - gl, ph - hl, cfg.split)
+                     - leaf_gain(pg, ph, cfg.split))
+            return gl, hl, cl, fgain
+
+        # Pay the expand+cumsum only while forced splits remain.
+        gl, hl, cl, fgain = jax.lax.cond(
+            use, _forced_stats,
+            lambda _: (jnp.zeros((), jnp.float32),) * 4, None)
         tgt = jnp.where(use, fleaf, L + M)            # OOB drop when unused
         st = st._replace(
             best_gain=st.best_gain.at[tgt].set(fgain, mode="drop"),
@@ -751,10 +767,10 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
     def _record_forced_children(st, use, si, leaf, new_leaf):
         """Map the executed forced node's forced children onto the two
         result leaves."""
-        lc = jnp.where(use & (F_LC[si] >= 0),
-                       jnp.clip(F_LC[si], 0, n_forced - 1), n_forced)
-        rc = jnp.where(use & (F_RC[si] >= 0),
-                       jnp.clip(F_RC[si], 0, n_forced - 1), n_forced)
+        lc = jnp.where(use & (F_LC[si] >= 0) & (F_LC[si] < n_forced),
+                       F_LC[si], n_forced)
+        rc = jnp.where(use & (F_RC[si] >= 0) & (F_RC[si] < n_forced),
+                       F_RC[si], n_forced)
         return st._replace(
             forced_leaf=st.forced_leaf.at[lc].set(leaf, mode="drop")
                                       .at[rc].set(new_leaf, mode="drop"))

@@ -108,6 +108,60 @@ extern "C" {
 // Parse a CSV/TSV/LibSVM file. label_column: "" or "0"-style index or
 // "name:<col>" (requires header). Returns opaque handle (nullptr on error with
 // message in err). num_features_hint: LibSVM width override (0 = infer).
+//
+// Streaming: the file is consumed in 4MB blocks with a partial-line carry
+// (reference DatasetLoader's buffered TextReader) — peak memory is the
+// parsed matrix plus one block, never the raw text.
+namespace {
+struct BlockLineReader {
+  std::ifstream in;
+  std::string carry;
+  std::vector<char> buf;
+  bool done = false;
+  explicit BlockLineReader(const char* path)
+      : in(path, std::ios::binary), buf(4 << 20) {}
+  bool ok() const { return static_cast<bool>(in) || done; }
+  // Appends the next block's complete lines; false once exhausted.
+  bool next_block(std::vector<std::string>* lines) {
+    if (done) return false;
+    in.read(buf.data(), static_cast<std::streamsize>(buf.size()));
+    std::streamsize got = in.gcount();
+    if (got <= 0) {
+      done = true;
+      if (!carry.empty()) {
+        push_line(std::move(carry), lines);
+        carry.clear();
+      }
+      return !lines->empty();
+    }
+    const char* p = buf.data();
+    const char* end = p + got;
+    const char* line_start = p;
+    for (; p < end; ++p) {
+      if (*p == '\n') {
+        if (carry.empty()) {
+          push_line(std::string(line_start, p), lines);
+        } else {
+          carry.append(line_start, p);
+          push_line(std::move(carry), lines);
+          carry.clear();
+        }
+        line_start = p + 1;
+      }
+    }
+    carry.append(line_start, end);
+    return true;
+  }
+
+ private:
+  static void push_line(std::string line, std::vector<std::string>* lines) {
+    if (!line.empty() && line.back() == '\r') line.pop_back();
+    if (line.find_first_not_of(" \t\r\n") != std::string::npos)
+      lines->push_back(std::move(line));
+  }
+};
+}  // namespace
+
 void* ltpu_parse_file(const char* path, int has_header, const char* label_column,
                       int num_features_hint, int64_t* out_nrows,
                       int64_t* out_ncols, char* err, int err_len) {
@@ -118,34 +172,34 @@ void* ltpu_parse_file(const char* path, int has_header, const char* label_column
     }
     return nullptr;
   };
-  std::ifstream in(path, std::ios::binary);
-  if (!in) return fail(std::string("cannot open file: ") + path);
-  std::vector<std::string> lines;
-  {
-    std::string line;
-    while (std::getline(in, line)) {
-      if (!line.empty() && line.back() == '\r') line.pop_back();
-      if (line.find_first_not_of(" \t\r\n") != std::string::npos)
-        lines.push_back(std::move(line));
-    }
-  }
+  BlockLineReader reader(path);
+  if (!reader.in) return fail(std::string("cannot open file: ") + path);
+
+  // Prefix: enough lines to sniff the format and see the first data row.
+  std::vector<std::string> pending;
   size_t start = has_header ? 1 : 0;
-  if (lines.size() <= start) return fail("empty data file");
-  std::vector<std::string> head(lines.begin() + static_cast<long>(start),
-                                lines.begin() + static_cast<long>(std::min(start + 10, lines.size())));
+  while (pending.size() < start + 10) {
+    std::vector<std::string> block;
+    if (!reader.next_block(&block)) break;
+    for (auto& l : block) pending.push_back(std::move(l));
+  }
+  if (pending.size() <= start) return fail("empty data file");
+  std::vector<std::string> head(
+      pending.begin() + static_cast<long>(start),
+      pending.begin() +
+          static_cast<long>(std::min(start + 10, pending.size())));
   Format fmt = sniff_format(head);
 
   auto* pf = new ParsedFile();
+  std::string parse_err;
+
   if (fmt == Format::kLibSVM) {
     int64_t max_f = -1;
     std::vector<std::vector<std::pair<int64_t, double>>> rows;
-    rows.reserve(lines.size() - start);
-    for (size_t li = start; li < lines.size(); ++li) {
-      const std::string& line = lines[li];
+    auto handle_line = [&](const std::string& line) {
       std::vector<std::pair<int64_t, double>> row;
       const char* p = line.data();
       const char* end = p + line.size();
-      // first token = label
       const char* tok = p;
       while (p < end && !std::isspace(static_cast<unsigned char>(*p))) ++p;
       pf->y.push_back(parse_token(tok, p));
@@ -165,6 +219,13 @@ void* ltpu_parse_file(const char* path, int has_header, const char* label_column
         if (fi > max_f) max_f = fi;
       }
       rows.push_back(std::move(row));
+    };
+    for (size_t li = start; li < pending.size(); ++li) handle_line(pending[li]);
+    pending.clear();
+    std::vector<std::string> block;
+    while (reader.next_block(&block)) {
+      for (const auto& l : block) handle_line(l);
+      block.clear();
     }
     int64_t nf = num_features_hint > 0 ? num_features_hint : max_f + 1;
     pf->nrows = static_cast<int64_t>(rows.size());
@@ -180,7 +241,7 @@ void* ltpu_parse_file(const char* path, int has_header, const char* label_column
     std::string lc = label_column ? label_column : "";
     if (lc.rfind("name:", 0) == 0 && has_header) {
       std::vector<std::pair<const char*, const char*>> names;
-      split_line(lines[0], sep, &names);
+      split_line(pending[0], sep, &names);
       std::string want = lc.substr(5);
       label_idx = -1;
       for (size_t i = 0; i < names.size(); ++i) {
@@ -194,29 +255,43 @@ void* ltpu_parse_file(const char* path, int has_header, const char* label_column
       label_idx = std::atoi(lc.c_str());
     }
     std::vector<std::pair<const char*, const char*>> toks;
-    split_line(lines[start], sep, &toks);
+    split_line(pending[start], sep, &toks);
     int64_t ntok = static_cast<int64_t>(toks.size());
     if (label_idx >= ntok) { delete pf; return fail("label index out of range"); }
-    pf->nrows = static_cast<int64_t>(lines.size() - start);
     pf->ncols = ntok - 1;
-    pf->X.resize(static_cast<size_t>(pf->nrows * pf->ncols));
-    pf->y.resize(static_cast<size_t>(pf->nrows));
-    for (int64_t i = 0; i < pf->nrows; ++i) {
-      split_line(lines[start + static_cast<size_t>(i)], sep, &toks);
+    int64_t nrows = 0;
+    auto handle_line = [&](const std::string& line) -> bool {
+      split_line(line, sep, &toks);
       if (static_cast<int64_t>(toks.size()) != ntok) {
-        std::string msg = "inconsistent column count at data row " + std::to_string(i);
-        delete pf;
-        return fail(msg);
+        parse_err = "inconsistent column count at data row " +
+                    std::to_string(nrows);
+        return false;
       }
-      double* xrow = pf->X.data() + i * pf->ncols;
+      size_t base = pf->X.size();
+      pf->X.resize(base + static_cast<size_t>(pf->ncols));
+      double* xrow = pf->X.data() + base;
       int64_t c = 0;
       for (int64_t j = 0; j < ntok; ++j) {
         double v = parse_token(toks[static_cast<size_t>(j)].first,
                                toks[static_cast<size_t>(j)].second);
-        if (j == label_idx) pf->y[static_cast<size_t>(i)] = v;
+        if (j == label_idx) pf->y.push_back(v);
         else xrow[c++] = v;
       }
+      ++nrows;
+      return true;
+    };
+    for (size_t li = start; li < pending.size(); ++li) {
+      if (!handle_line(pending[li])) { delete pf; return fail(parse_err); }
     }
+    pending.clear();
+    std::vector<std::string> block;
+    while (reader.next_block(&block)) {
+      for (const auto& l : block) {
+        if (!handle_line(l)) { delete pf; return fail(parse_err); }
+      }
+      block.clear();
+    }
+    pf->nrows = nrows;
   }
   *out_nrows = pf->nrows;
   *out_ncols = pf->ncols;

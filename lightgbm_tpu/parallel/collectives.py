@@ -33,6 +33,29 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from .mesh import DATA_AXIS
 
 
+# ------------------------------------------------------- comm injection seam
+# Reference LGBM_NetworkInitWithFunctions (src/c_api.cpp:2773): external
+# integrations (Spark/SynapseML-style) inject their own reduce/allgather.
+# Here the XLA compiler owns routing, so the seam wraps the *facade*: when a
+# backend is registered, the facade functions below delegate to it instead
+# of the shard_map+psum implementations.
+_comm_backend = None
+
+
+def register_comm_backend(backend) -> None:
+    """Install an object with optional ``global_sum/global_min/global_max/
+    global_mean/histogram_reduce_scatter/allgather_histogram`` callables;
+    ``None`` restores the built-in XLA collectives."""
+    global _comm_backend
+    _comm_backend = backend
+
+
+def _injected(name):
+    fn = getattr(_comm_backend, name, None) if _comm_backend is not None \
+        else None
+    return fn
+
+
 def histogram_reduce_scatter(local_hist: jnp.ndarray, mesh: Mesh,
                              axis: str = DATA_AXIS) -> jnp.ndarray:
     """Sum per-shard histograms and leave each shard owning a feature block.
@@ -48,6 +71,9 @@ def histogram_reduce_scatter(local_hist: jnp.ndarray, mesh: Mesh,
     partials).  Returns (F/K, B, C) per shard, concatenated to (F, B, C) in
     the global view sharded along features.
     """
+    fn = _injected("histogram_reduce_scatter")
+    if fn is not None:
+        return fn(local_hist, mesh, axis)
     nshards = mesh.shape[axis]
     f = local_hist.shape[0]
     if f % nshards != 0:
@@ -69,6 +95,9 @@ def allgather_histogram(owned: jnp.ndarray, mesh: Mesh,
                         axis: str = DATA_AXIS) -> jnp.ndarray:
     """Inverse of the scatter: every shard receives all owned blocks
     (reference Bruck ``Network::Allgather``, ``network.cpp:121``)."""
+    fn = _injected("allgather_histogram")
+    if fn is not None:
+        return fn(owned, mesh, axis)
     def body(h):
         return jax.lax.all_gather(h, axis, axis=0, tiled=True)
 
@@ -112,18 +141,27 @@ def _scalar_sync(reduce_fn, value: jnp.ndarray, mesh: Mesh,
 def global_sum(value: jnp.ndarray, mesh: Mesh,
                axis: str = DATA_AXIS) -> jnp.ndarray:
     """reference ``Network::GlobalSyncUpBySum`` (``network.h:239``)."""
+    fn = _injected("global_sum")
+    if fn is not None:
+        return fn(value, mesh, axis)
     return _scalar_sync(jax.lax.psum, value, mesh, axis)
 
 
 def global_min(value: jnp.ndarray, mesh: Mesh,
                axis: str = DATA_AXIS) -> jnp.ndarray:
     """reference ``Network::GlobalSyncUpByMin`` (``network.h:168``)."""
+    fn = _injected("global_min")
+    if fn is not None:
+        return fn(value, mesh, axis)
     return _scalar_sync(jax.lax.pmin, value, mesh, axis)
 
 
 def global_max(value: jnp.ndarray, mesh: Mesh,
                axis: str = DATA_AXIS) -> jnp.ndarray:
     """reference ``Network::GlobalSyncUpByMax`` (``network.h:203``)."""
+    fn = _injected("global_max")
+    if fn is not None:
+        return fn(value, mesh, axis)
     return _scalar_sync(jax.lax.pmax, value, mesh, axis)
 
 
@@ -131,6 +169,9 @@ def global_mean(value: jnp.ndarray, weight: jnp.ndarray, mesh: Mesh,
                 axis: str = DATA_AXIS) -> jnp.ndarray:
     """Weighted mean across shards (reference ``GlobalSyncUpByMean``,
     ``network.h:263`` — used by boost-from-average, ``gbdt.cpp:313``)."""
+    fn = _injected("global_mean")
+    if fn is not None:
+        return fn(value, weight, mesh, axis)
     def body(v, w):
         return jax.lax.psum(v * w, axis) / jnp.maximum(
             jax.lax.psum(w, axis), 1e-35)

@@ -79,14 +79,24 @@ class SampleStrategy:
         mask[idx] = 1.0
         return mask
 
+    def goss_constants(self):
+        """(top_k, other_k, amplification) — shared by the host and device
+        GOSS paths (reference goss.hpp:30-60)."""
+        cfg = self.cfg
+        n = self.num_data
+        top_k = max(int(n * cfg.top_rate), 1)
+        other_k = int(n * cfg.other_rate)
+        amp = ((1.0 - cfg.top_rate) / cfg.other_rate
+               if cfg.other_rate > 0 else 0.0)
+        return top_k, other_k, amp
+
     def _goss_mask(self, grad: np.ndarray, hess: np.ndarray) -> np.ndarray:
         """GOSS (reference ``goss.hpp:30-60``): keep the top ``top_rate`` fraction
         by |grad*hess|, sample ``other_rate`` of the rest and up-weight them."""
         cfg = self.cfg
         n = self.num_data
         score = np.abs(grad * hess)
-        top_k = max(int(n * cfg.top_rate), 1)
-        other_k = int(n * cfg.other_rate)
+        top_k, other_k, _amp = self.goss_constants()
         order = np.argsort(-score, kind="stable")
         mask = np.zeros(n, np.float32)
         mask[order[:top_k]] = 1.0
@@ -96,6 +106,29 @@ class SampleStrategy:
                                    replace=False)
             mask[rest[pick]] = (1.0 - cfg.top_rate) / cfg.other_rate
         return mask
+
+
+def goss_mask_device(grad_sum, hess_sum, key, top_k: int, other_k: int,
+                     amplify: float):
+    """Device-resident GOSS (reference ``goss.hpp:30-60``) — no host
+    round-trip: exact top-k by |grad*hess|, gumbel-style uniform top-k for
+    the random remainder, amplification folded into the mask."""
+    import jax
+    import jax.numpy as jnp
+
+    n = grad_sum.shape[0]
+    score = jnp.abs(grad_sum * hess_sum)
+    _, top_idx = jax.lax.top_k(score, top_k)
+    mask = jnp.zeros(n, jnp.float32).at[top_idx].set(1.0)
+    if other_k > 0:
+        u = jax.random.uniform(key, (n,))
+        u = jnp.where(mask > 0.0, -1.0, u)       # exclude the top set
+        sel_vals, sel_idx = jax.lax.top_k(u, other_k)
+        # drop slots that fell back onto excluded rows (rest smaller than
+        # other_k)
+        tgt = jnp.where(sel_vals >= 0.0, sel_idx, n)
+        mask = mask.at[tgt].set(jnp.float32(amplify), mode="drop")
+    return mask
 
 
 class FeatureSampler:

@@ -109,3 +109,30 @@ def test_init_distributed_single_process_noop():
 
     rank, world = D.init_distributed(Config({"num_machines": 1}))
     assert (rank, world) == (0, 1)
+
+
+def test_comm_backend_injection(mesh):
+    """External comm injection seam (reference
+    LGBM_NetworkInitWithFunctions, c_api.cpp:2773): a registered backend
+    replaces the built-in XLA collectives in the facade."""
+    import lightgbm_tpu.parallel.collectives as C
+
+    calls = []
+
+    class FakeBackend:
+        def global_sum(self, value, mesh, axis):
+            calls.append("sum")
+            return jnp.asarray(42.0)
+
+    v = jnp.ones(8)
+    builtin = float(np.asarray(C.global_sum(v, mesh)))
+    try:
+        C.register_comm_backend(FakeBackend())
+        injected = float(np.asarray(C.global_sum(v, mesh)))
+        # unhooked functions keep the XLA path
+        mx = float(np.asarray(C.global_max(jnp.arange(8.0), mesh)))
+    finally:
+        C.register_comm_backend(None)
+    assert injected == 42.0 and calls == ["sum"]
+    assert builtin == 8.0 and mx == 7.0
+    assert float(np.asarray(C.global_sum(v, mesh))) == 8.0

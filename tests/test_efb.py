@@ -114,3 +114,17 @@ def test_efb_composes_with_sharded_and_voting_learners():
         assert bst._gbdt.bundles is not None
         auc = _auc(y, bst.predict(X, raw_score=True), None, None)
         assert auc > 0.6, (learner, auc)
+
+
+def test_enable_bundle_not_sticky_across_trainings():
+    """Re-training on the same Dataset with a different enable_bundle must
+    re-decide bundling (review regression: one-shot cache)."""
+    X, y = _onehot_data(n=3000)
+    ds = lgb.Dataset(X, label=y)
+    params = {"objective": "binary", "num_leaves": 15, "verbosity": -1}
+    b_on = lgb.train(dict(params, enable_bundle=True), ds, 2)
+    assert b_on._gbdt.bundles is not None
+    b_off = lgb.train(dict(params, enable_bundle=False), ds, 2)
+    assert b_off._gbdt.bundles is None
+    b_on2 = lgb.train(dict(params, enable_bundle=True), ds, 2)
+    assert b_on2._gbdt.bundles is not None

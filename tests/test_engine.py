@@ -300,3 +300,27 @@ def test_goss_other_rate_zero():
                      "other_rate": 0.0, "top_rate": 0.3, "num_leaves": 7,
                      "verbosity": -1}, lgb.Dataset(X, label=y), 5)
     assert bst.num_trees() >= 1
+
+
+def test_goss_device_mask_semantics():
+    """Device GOSS keeps exactly top_k rows at weight 1, ~other_k rows
+    amplified, rest zero (reference goss.hpp:30-60)."""
+    import jax
+    import numpy as np
+    from lightgbm_tpu.sampling import goss_mask_device
+
+    rng = np.random.RandomState(0)
+    n = 5000
+    g = rng.randn(n).astype(np.float32)
+    h = np.full(n, 0.25, np.float32)
+    top_k, other_k = 500, 750
+    amp = (1.0 - 0.1) / 0.15
+    mask = np.asarray(goss_mask_device(g, h, jax.random.PRNGKey(0),
+                                       top_k, other_k, amp))
+    assert (mask == 1.0).sum() == top_k
+    assert abs((np.isclose(mask, amp)).sum() - other_k) <= 1
+    # top set really is the top |g*h|
+    score = np.abs(g * h)
+    thr = np.sort(score)[-top_k]
+    assert score[mask == 1.0].min() >= thr - 1e-7
+    assert (mask == 0.0).sum() == n - top_k - np.isclose(mask, amp).sum()

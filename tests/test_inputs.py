@@ -109,3 +109,22 @@ def test_chunked_and_sequence_input():
                          "verbosity": -1}, lgb.Dataset(data, label=y), 3)
         p_chunks = bst.predict(X[:50])
         assert p_chunks.shape == (50,)
+
+
+def test_dataset_subset_and_add_features():
+    rng = np.random.RandomState(5)
+    X = rng.randn(400, 4)
+    y = (X[:, 0] > 0).astype(float)
+    ds = lgb.Dataset(X, label=y, weight=np.ones(400))
+    sub = ds.subset(np.arange(0, 400, 2))
+    assert sub.num_data() == 200
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "verbosity": -1}, sub, 3)
+    assert bst.num_trees() == 3
+
+    extra = lgb.Dataset(rng.randn(400, 2))
+    ds2 = lgb.Dataset(X.copy(), label=y, feature_name=[f"f{i}" for i in range(4)])
+    ds2.add_features_from(extra)
+    assert ds2.num_feature() == 6
+    td = ds2.construct({"objective": "binary", "verbosity": -1})
+    assert td.num_features == 6
