@@ -752,7 +752,8 @@ class GBDT:
             cache = getattr(self, "_loaded_mirror", None)
             if cache is None or cache[0] != self.num_trees:
                 cache = (self.num_trees,
-                         load_model_string(model_to_string(self)))
+                         load_model_string(
+                             model_to_string(self, fold_bias=False)))
                 self._loaded_mirror = cache
             return cache[1].predict(X, raw_score=raw_score,
                                     num_iteration=num_iteration,

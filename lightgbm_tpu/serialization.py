@@ -30,8 +30,10 @@ def _fmt_arr(arr, fmt="%.17g") -> str:
     return " ".join(fmt % v for v in np.asarray(arr).ravel())
 
 
-def _tree_to_string(tree, index: int, mappers) -> str:
-    """Serialize one tree (reference ``Tree::ToString``)."""
+def _tree_to_string(tree, index: int, mappers, bias: float = 0.0) -> str:
+    """Serialize one tree (reference ``Tree::ToString``).  ``bias`` folds
+    the boost-from-average constant into the first iteration's leaf values
+    (the reference stores no separate init score in the model file)."""
     m = tree.num_splits()
     lines = [f"Tree={index}", f"num_leaves={tree.num_leaves}"]
     cat_nodes = np.nonzero(tree.is_cat[:m])[0]
@@ -68,12 +70,14 @@ def _tree_to_string(tree, index: int, mappers) -> str:
     lines.append("decision_type=" + _fmt_arr(decision_type, "%d"))
     lines.append("left_child=" + _fmt_arr(tree.left_child[:m], "%d"))
     lines.append("right_child=" + _fmt_arr(tree.right_child[:m], "%d"))
-    lines.append("leaf_value=" + _fmt_arr(tree.leaf_value[: tree.num_leaves]))
+    lines.append("leaf_value=" + _fmt_arr(
+        np.asarray(tree.leaf_value[: tree.num_leaves], np.float64) + bias))
     lines.append("leaf_weight="
                  + _fmt_arr(tree.leaf_weight[: tree.num_leaves], "%g"))
     lines.append("leaf_count=" + _fmt_arr(
         tree.leaf_count[: tree.num_leaves].astype(np.int64), "%d"))
-    lines.append("internal_value=" + _fmt_arr(tree.internal_value[:m], "%g"))
+    lines.append("internal_value=" + _fmt_arr(
+        np.asarray(tree.internal_value[:m], np.float64) + bias, "%g"))
     lines.append("internal_count=" + _fmt_arr(
         tree.internal_count[:m].astype(np.int64), "%d"))
     if len(cat_nodes):
@@ -83,7 +87,9 @@ def _tree_to_string(tree, index: int, mappers) -> str:
         # Linear-leaf fields (reference Tree::ToString is_linear branch).
         nl = tree.num_leaves
         lines.append("is_linear=1")
-        lines.append("leaf_const=" + _fmt_arr(tree.leaf_const[:nl]))
+        # linear leaves predict const + coef.x; the bias folds there too
+        lines.append("leaf_const=" + _fmt_arr(
+            np.asarray(tree.leaf_const[:nl], np.float64) + bias))
         lines.append("num_features=" + _fmt_arr(
             [len(f) for f in tree.leaf_features[:nl]], "%d"))
         flat_feats = [int(v) for f in tree.leaf_features[:nl] for v in f]
@@ -95,7 +101,8 @@ def _tree_to_string(tree, index: int, mappers) -> str:
     return "\n".join(lines)
 
 
-def _loaded_tree_to_string(t: "LoadedTree", index: int) -> str:
+def _loaded_tree_to_string(t: "LoadedTree", index: int,
+                           bias: float = 0.0) -> str:
     """Re-serialize a loaded (raw-threshold) tree verbatim — used when saving a
     continuation booster so the base model's trees survive unchanged
     (reference: continuation re-saves the full ensemble)."""
@@ -109,9 +116,11 @@ def _loaded_tree_to_string(t: "LoadedTree", index: int) -> str:
     lines.append("decision_type=" + _fmt_arr(t.decision_type[:m], "%d"))
     lines.append("left_child=" + _fmt_arr(t.left_child[:m], "%d"))
     lines.append("right_child=" + _fmt_arr(t.right_child[:m], "%d"))
-    lines.append("leaf_value=" + _fmt_arr(t.leaf_value[: t.num_leaves]))
+    lines.append("leaf_value=" + _fmt_arr(
+        np.asarray(t.leaf_value[: t.num_leaves], np.float64) + bias))
     if t.internal_value is not None:
-        lines.append("internal_value=" + _fmt_arr(t.internal_value[:m], "%g"))
+        lines.append("internal_value=" + _fmt_arr(
+            np.asarray(t.internal_value[:m], np.float64) + bias, "%g"))
     if t.internal_count is not None:
         lines.append("internal_count=" + _fmt_arr(t.internal_count[:m], "%d"))
     if t.cat_boundaries is not None:
@@ -120,7 +129,8 @@ def _loaded_tree_to_string(t: "LoadedTree", index: int) -> str:
     if t.is_linear:
         nl = t.num_leaves
         lines.append("is_linear=1")
-        lines.append("leaf_const=" + _fmt_arr(t.leaf_const[:nl]))
+        lines.append("leaf_const=" + _fmt_arr(
+            np.asarray(t.leaf_const[:nl], np.float64) + bias))
         lines.append("num_features=" + _fmt_arr(
             [len(f) for f in t.leaf_features[:nl]], "%d"))
         lines.append("leaf_features=" + _fmt_arr(
@@ -132,8 +142,31 @@ def _loaded_tree_to_string(t: "LoadedTree", index: int) -> str:
     return "\n".join(lines)
 
 
+def _objective_to_string(cfg, num_class: int) -> str:
+    """Reference ``ObjectiveFunction::ToString`` parameter suffixes —
+    required for the reference binary to reload our models."""
+    name = cfg.objective
+    if name == "binary":
+        return f"binary sigmoid:{cfg.sigmoid:g}"
+    if name == "multiclass":
+        return f"multiclass num_class:{num_class}"
+    if name == "multiclassova":
+        return (f"multiclassova num_class:{num_class} "
+                f"sigmoid:{cfg.sigmoid:g}")
+    if name == "regression" and cfg.reg_sqrt:
+        return "regression sqrt"
+    if name == "quantile":
+        return f"quantile alpha:{cfg.alpha:g}"
+    return name
+
+
 def model_to_string(gbdt, num_iteration: Optional[int] = None,
-                    start_iteration: int = 0) -> str:
+                    start_iteration: int = 0,
+                    fold_bias: bool = True) -> str:
+    """``fold_bias``: write reference-compatible files (boost-from-average
+    folded into the first iteration's values, init_scores line zeroed); the
+    in-memory prediction mirror passes False to keep init scores explicit
+    so ``start_iteration`` slicing stays exact."""
     cfg = gbdt.cfg
     td = gbdt.train_data
     mappers = td.binned.mappers
@@ -146,15 +179,23 @@ def model_to_string(gbdt, num_iteration: Optional[int] = None,
            f"num_tree_per_iteration={gbdt.num_class}",
            "label_index=0",
            f"max_feature_idx={td.num_features - 1}",
-           # reference RegressionL2loss::ToString appends " sqrt"
-           f"objective={cfg.objective}"
-           + (" sqrt" if cfg.objective == "regression" and cfg.reg_sqrt
-              else ""),
+           # reference ObjectiveFunction::ToString suffixes: the loader
+           # (ours AND the reference binary) parses these back into config
+           # (e.g. binary_objective.hpp:181 "sigmoid:", multiclass
+           # "num_class:", regression " sqrt").
+           f"objective={_objective_to_string(cfg, gbdt.num_class)}",
            "feature_names=" + " ".join(
                td.feature_names or
                [f"Column_{i}" for i in range(td.num_features)]),
            "feature_infos=" + " ".join(_feature_info(m) for m in mappers),
-           "init_scores=" + _fmt_arr(init_scores),
+           # The reference has no init-score line: boost-from-average is
+           # folded into the first iteration's leaf values below so the
+           # reference binary reloads our models bit-compatibly.  The line
+           # stays (zeroed) for our own loader's benefit, and keeps the
+           # constant when a partial save drops the first iteration.
+           "init_scores=" + _fmt_arr(
+               np.zeros_like(init_scores)
+               if (fold_bias and start_iteration == 0) else init_scores),
            ""]
     end = None if num_iteration is None else start_iteration + num_iteration
     idx = 0
@@ -167,12 +208,14 @@ def model_to_string(gbdt, num_iteration: Optional[int] = None,
     iters = range(start_iteration, n_total if end is None else min(end, n_total))
     for t in iters:
         for k in range(gbdt.num_class):
+            bias = float(init_scores[k]) \
+                if (fold_bias and t == 0 and start_iteration == 0) else 0.0
             if t < n_base:
                 out.append(_loaded_tree_to_string(
-                    base.trees[t * gbdt.num_class + k], idx))
+                    base.trees[t * gbdt.num_class + k], idx, bias))
             else:
                 out.append(_tree_to_string(gbdt.models[k][t - n_base], idx,
-                                           mappers))
+                                           mappers, bias))
             idx += 1
     out.append("end of trees")
     out.append("")
@@ -468,9 +511,19 @@ class LoadedModel:
         self.feature_names = feature_names
         self.params = params
         self.header = dict(header or {})
-        self.cfg = Config({"objective": objective.split(" ")[0],
-                           "num_class": num_class} if num_class > 1 else
-                          {"objective": objective.split(" ")[0]})
+        obj_extra = {}
+        for tok in objective.split(" ")[1:]:
+            # reference ToString suffixes: "sigmoid:1", "num_class:3", "sqrt"
+            if ":" in tok:
+                key, val = tok.split(":", 1)
+                if key in ("sigmoid", "alpha"):
+                    obj_extra[key] = val
+                elif key == "num_class":
+                    obj_extra["num_class"] = val
+        cfg_dict = {"objective": objective.split(" ")[0], **obj_extra}
+        if num_class > 1:
+            cfg_dict["num_class"] = num_class
+        self.cfg = Config(cfg_dict)
         from .objectives import create_objective
         self.objective = create_objective(self.cfg) \
             if self.cfg.objective != "custom" else None

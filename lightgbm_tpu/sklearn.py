@@ -102,6 +102,7 @@ class LGBMModel:
         return "regression"
 
     def _lgb_params(self) -> Dict[str, Any]:
+        extra = getattr(self, "_lgb_extra", {})
         p = {
             "boosting": self.boosting_type,
             "objective": self.objective or self._default_objective(),
@@ -122,6 +123,7 @@ class LGBMModel:
         if self.random_state is not None:
             p["seed"] = int(self.random_state)
         p.update(self._other_params)
+        p.update(extra)
         return p
 
     def _class_sample_weight(self, y, sample_weight):
@@ -163,7 +165,7 @@ class LGBMModel:
         self._Booster = train(params, ds,
                               num_boost_round=self.n_estimators,
                               valid_sets=valid_sets, valid_names=valid_names,
-                              callbacks=callbacks)
+                              callbacks=callbacks, init_model=init_model)
         self.fitted_ = True
         return self
 
@@ -262,7 +264,8 @@ class LGBMRanker(LGBMModel):
     def _default_objective(self) -> str:
         return "lambdarank"
 
-    def fit(self, X, y, group=None, **kwargs):
+    def fit(self, X, y, group=None, eval_at=(1, 2, 3, 4, 5), **kwargs):
         if group is None:
             raise ValueError("LGBMRanker.fit requires group")
+        self._lgb_extra = {"eval_at": list(eval_at)}
         return super().fit(X, y, group=group, **kwargs)

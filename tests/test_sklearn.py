@@ -86,3 +86,24 @@ def test_class_weight_balanced():
     # balanced weighting should recover a reasonable recall on the minority
     minority_recall = (pred[y == 1] == 1).mean()
     assert minority_recall > 0.6
+
+
+def test_ranker_eval_at_and_init_model():
+    rng = np.random.RandomState(0)
+    n_q, per_q = 40, 10
+    n = n_q * per_q
+    X = rng.randn(n, 5)
+    y = (X[:, 0] > 0.3).astype(int) + (X[:, 1] > 0.5).astype(int)
+    group = np.full(n_q, per_q)
+    rk = lgb.LGBMRanker(n_estimators=5, num_leaves=7, min_child_samples=5)
+    rk.fit(X, y, group=group, eval_at=(3,),
+           eval_set=[(X, y)], eval_group=[group])
+    assert any("ndcg@3" in m for m in rk.evals_result_["valid_0"])
+
+    # continuation through the sklearn surface
+    clf = lgb.LGBMClassifier(n_estimators=3, num_leaves=7)
+    Xc, yc = X, (y > 0).astype(int)
+    clf.fit(Xc, yc)
+    clf2 = lgb.LGBMClassifier(n_estimators=2, num_leaves=7)
+    clf2.fit(Xc, yc, init_model=clf.booster_)
+    assert clf2.booster_.num_trees() >= 2
