@@ -1,0 +1,20 @@
+#!/bin/sh
+# Build the genuine LightGBM CLI from /root/reference without cmake (the
+# image's cmake is older than the reference requires) and without its
+# vendored submodules (empty in the mount):
+#   - fmt / fast_double_parser: minimal shim headers in this directory
+#     (the reference uses one fmt call and one fdp call)
+#   - Eigen: TensorFlow's bundled copy
+# Used in round 3 to verify bidirectional model interop and AUC parity
+# (docs/PERF.md); run tests/test_interop.py with
+# LGBM_REFERENCE_BIN=<out>/lightgbm for the live reverse-direction test.
+set -e
+OUT=${1:-/tmp/lgbbuild2}
+EIGEN=$(python -c "import tensorflow, os; print(os.path.join(os.path.dirname(tensorflow.__file__), 'include'))" 2>/dev/null \
+  || echo /opt/venv/lib/python3.12/site-packages/tensorflow/include)
+mkdir -p "$OUT"
+g++ -O2 -std=c++17 -fopenmp -DUSE_SOCKET -DEIGEN_MPL2_ONLY \
+  -I"$(dirname "$0")" -I/root/reference/include -I"$EIGEN" \
+  /root/reference/src/main.cpp /root/reference/src/*/*.cpp \
+  -o "$OUT/lightgbm" -lpthread
+echo "built $OUT/lightgbm"

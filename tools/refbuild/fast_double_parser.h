@@ -1,0 +1,12 @@
+// strtod-backed shim for fast_double_parser::parse_number (single call site
+// in LightGBM's common.h Atof).
+#pragma once
+#include <cstdlib>
+namespace fast_double_parser {
+inline const char* parse_number(const char* p, double* out) {
+  char* end = nullptr;
+  *out = std::strtod(p, &end);
+  if (end == p) return nullptr;
+  return end;
+}
+}  // namespace fast_double_parser

@@ -1,0 +1,53 @@
+// Minimal shim for the single fmt usage in LightGBM's common.h
+// (fmt::format_to_n(buffer, n, format, value) with "{}" / "{:.17g}" style
+// format strings).  snprintf-backed; sufficient for model serialization.
+#pragma once
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <type_traits>
+
+namespace fmt {
+struct format_to_n_result_shim { size_t size; };
+
+namespace detail {
+inline std::string translate(const char* f, bool is_fp, bool is_signed,
+                             bool is_64) {
+  // "{}" -> default; "{:.17g}" -> precision g
+  std::string s(f);
+  std::string spec;
+  auto colon = s.find(':');
+  if (colon != std::string::npos) {
+    spec = s.substr(colon + 1, s.size() - colon - 2);  // strip trailing }
+  }
+  if (!spec.empty()) return "%" + spec;
+  if (is_fp) return "%g";
+  if (is_64) return is_signed ? "%lld" : "%llu";
+  return is_signed ? "%d" : "%u";
+}
+}  // namespace detail
+
+template <typename T>
+inline format_to_n_result_shim format_to_n(char* buf, size_t n,
+                                           const char* format, T value) {
+  std::string f = detail::translate(
+      format, std::is_floating_point<T>::value, std::is_signed<T>::value,
+      sizeof(T) >= 8);
+  int written;
+  if (std::is_floating_point<T>::value) {
+    written = snprintf(buf, n, f.c_str(), static_cast<double>(value));
+  } else if (sizeof(T) >= 8) {
+    if (std::is_signed<T>::value)
+      written = snprintf(buf, n, f.c_str(), static_cast<long long>(value));
+    else
+      written = snprintf(buf, n, f.c_str(),
+                         static_cast<unsigned long long>(value));
+  } else {
+    if (std::is_signed<T>::value)
+      written = snprintf(buf, n, f.c_str(), static_cast<int>(value));
+    else
+      written = snprintf(buf, n, f.c_str(), static_cast<unsigned>(value));
+  }
+  return {written < 0 ? n : static_cast<size_t>(written)};
+}
+}  // namespace fmt
