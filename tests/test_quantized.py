@@ -120,3 +120,49 @@ class TestQuantizedTraining:
                       **extra}
             bst = lgb.train(params, lgb.Dataset(X, label=y), 30)
             assert _auc(y, bst.predict(X, raw_score=True)) > 0.8
+
+
+def test_quantized_composes_with_sharded_learner():
+    """Quantized training under the data mesh: int32 histograms psum across
+    shards (bin.h:48-81 integer reducers) and results track fp32 closely."""
+    import jax
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    from lightgbm_tpu.models.grower import _MIN_BUCKET
+    from lightgbm_tpu.metrics import _auc
+
+    n = 8 * (_MIN_BUCKET + 128)
+    rng = np.random.RandomState(0)
+    X = rng.randn(n, 10)
+    y = (X[:, 0] + 0.5 * X[:, 1] * X[:, 2] > 0).astype(np.float64)
+    params = {"objective": "binary", "num_leaves": 15, "min_data_in_leaf": 20,
+              "verbosity": -1, "tree_learner": "data",
+              "use_quantized_grad": True}
+    bst = lgb.train(params, lgb.Dataset(X, label=y), 5)
+    auc = _auc(y, bst.predict(X, raw_score=True), None, None)
+    fp32 = lgb.train(dict(params, use_quantized_grad=False),
+                     lgb.Dataset(X, label=y), 5)
+    auc_fp = _auc(y, fp32.predict(X, raw_score=True), None, None)
+    assert auc > auc_fp - 5e-3, (auc, auc_fp)
+
+
+def test_quantized_composes_with_efb():
+    from lightgbm_tpu.metrics import _auc
+
+    rng = np.random.RandomState(1)
+    n = 6000
+    blocks = []
+    for _ in range(3):
+        cat = rng.randint(0, 10, n)
+        oh = np.zeros((n, 10))
+        oh[np.arange(n), cat] = rng.rand(n) + 0.5
+        blocks.append(oh)
+    X = np.concatenate(blocks + [rng.randn(n, 4)], axis=1)
+    y = (X[:, 0] * 2 + X[:, 30] > 0.5).astype(np.float64)
+    params = {"objective": "binary", "num_leaves": 15, "min_data_in_leaf": 20,
+              "verbosity": -1, "enable_bundle": True,
+              "use_quantized_grad": True}
+    bst = lgb.train(params, lgb.Dataset(X, label=y), 6)
+    assert bst._gbdt.bundles is not None
+    auc = _auc(y, bst.predict(X, raw_score=True), None, None)
+    assert auc > 0.75, auc
