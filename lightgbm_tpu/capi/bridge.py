@@ -86,6 +86,47 @@ def dataset_create_from_file(filename, params, reference):
     return _CApiDataset(ds)
 
 
+def _csr_to_dense(indptr_mv, indptr_type, indices_mv, data_mv, dtype_code,
+                  nindptr, nelem, num_col):
+    indptr = np.frombuffer(
+        indptr_mv, dtype=_NP_DTYPES[indptr_type], count=nindptr)
+    indices = np.frombuffer(indices_mv, dtype=np.int32, count=nelem)
+    data = np.frombuffer(data_mv, dtype=_NP_DTYPES[dtype_code], count=nelem)
+    n = nindptr - 1
+    X = np.zeros((n, num_col), np.float64)
+    rows = np.repeat(np.arange(n), np.diff(indptr))
+    X[rows, indices] = data
+    return X
+
+
+def dataset_create_from_csr(indptr_mv, indptr_type, indices_mv, data_mv,
+                            dtype_code, nindptr, nelem, num_col, params,
+                            reference):
+    """Reference LGBM_DatasetCreateFromCSR: row-compressed sparse input;
+    densified here (EFB recovers the sparse-column win after binning)."""
+    from ..basic import Dataset
+    X = _csr_to_dense(indptr_mv, indptr_type, indices_mv, data_mv,
+                      dtype_code, nindptr, nelem, num_col)
+    ref = reference.dataset if reference is not None else None
+    return _CApiDataset(Dataset(X, params=_parse_params(params),
+                                reference=ref))
+
+
+def dataset_set_feature_names(handle, names):
+    names = list(names)
+    nf = handle.dataset.num_feature()
+    if len(names) != nf:
+        raise ValueError(
+            f"expected {nf} feature names, got {len(names)} (reference "
+            "LGBM_DatasetSetFeatureNames errors on mismatch)")
+    handle.dataset.feature_name = names
+    handle.dataset._train_data = None
+
+
+def dataset_get_feature_names(handle):
+    return handle.dataset._feature_names()
+
+
 def dataset_set_field(handle, name, mv, dtype_code, num_element):
     arr = np.frombuffer(mv, dtype=_NP_DTYPES[dtype_code],
                         count=num_element).copy()
@@ -210,10 +251,30 @@ def booster_get_eval(handle, data_idx):
     return [float(v) for d, _m, v, _hb in evals if d == want]
 
 
+def booster_reset_parameter(handle, params):
+    handle.bst.reset_parameter(_parse_params(params))
+
+
+def booster_predict_for_csr(handle, indptr_mv, indptr_type, indices_mv,
+                            data_mv, dtype_code, nindptr, nelem, num_col,
+                            predict_type, start_iteration, num_iteration,
+                            params):
+    X = _csr_to_dense(indptr_mv, indptr_type, indices_mv, data_mv,
+                      dtype_code, nindptr, nelem, num_col)
+    return _predict_dispatch(handle, X, predict_type, start_iteration,
+                             num_iteration, params)
+
+
 def booster_predict_for_mat(handle, mv, dtype_code, nrow, ncol, is_row_major,
                             predict_type, start_iteration, num_iteration,
                             params):
     X = _mat_from_memory(mv, dtype_code, nrow, ncol, is_row_major)
+    return _predict_dispatch(handle, X, predict_type, start_iteration,
+                             num_iteration, params)
+
+
+def _predict_dispatch(handle, X, predict_type, start_iteration,
+                      num_iteration, params):
     kw = dict(start_iteration=start_iteration,
               num_iteration=None if num_iteration <= 0 else num_iteration)
     kw.update({k: v for k, v in _parse_params(params).items()

@@ -126,6 +126,39 @@ Py_ssize_t dtype_size(int dtype) {
   }
 }
 
+// Write a Python list of strings to a (len, buffer_len)-bounded char**
+// (the reference's GetEvalNames/GetFeatureNames output convention).
+int copy_str_list_out(PyObject* lst, const int len, int* out_len,
+                      const size_t buffer_len, size_t* out_buffer_len,
+                      char** out_strs) {
+  Py_ssize_t n = PyList_Size(lst);
+  *out_len = static_cast<int>(n);
+  size_t maxlen = 1;
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    Py_ssize_t sl = 0;
+    if (PyUnicode_AsUTF8AndSize(PyList_GetItem(lst, i), &sl) == nullptr) {
+      set_error_from_python();
+      return -1;
+    }
+    if (static_cast<size_t>(sl) + 1 > maxlen) maxlen = sl + 1;
+  }
+  *out_buffer_len = maxlen;
+  if (out_strs != nullptr) {
+    for (Py_ssize_t i = 0; i < n && i < len; ++i) {
+      Py_ssize_t sl = 0;
+      const char* c = PyUnicode_AsUTF8AndSize(PyList_GetItem(lst, i), &sl);
+      size_t cp = static_cast<size_t>(sl) + 1 <= buffer_len
+                      ? static_cast<size_t>(sl) + 1
+                      : buffer_len;
+      if (cp > 0) {
+        std::memcpy(out_strs[i], c, cp - 1);
+        out_strs[i][cp - 1] = '\0';
+      }
+    }
+  }
+  return 0;
+}
+
 int copy_str_out(PyObject* s, int64_t buffer_len, int64_t* out_len,
                  char* out_str) {
   Py_ssize_t n = 0;
@@ -250,6 +283,70 @@ int LGBM_DatasetFree(DatasetHandle handle) {
   return 0;
 }
 
+int LGBM_DatasetCreateFromCSR(const void* indptr, int indptr_type,
+                              const int32_t* indices, const void* data,
+                              int data_type, int64_t nindptr, int64_t nelem,
+                              int64_t num_col, const char* parameters,
+                              DatasetHandle reference, DatasetHandle* out) {
+  Gil g;
+  if (!g.ok) return -1;
+  PyObject* ref = reference != nullptr
+                      ? reinterpret_cast<PyObject*>(reference)
+                      : Py_None;
+  Py_INCREF(ref);
+  PyObject* r = bridge_call(
+      "dataset_create_from_csr",
+      Py_BuildValue(
+          "(NiNNiLLLsN)",
+          mv_from(indptr, nindptr * dtype_size(indptr_type)), indptr_type,
+          mv_from(indices, nelem * 4),
+          mv_from(data, nelem * dtype_size(data_type)), data_type,
+          static_cast<long long>(nindptr), static_cast<long long>(nelem),
+          static_cast<long long>(num_col),
+          parameters != nullptr ? parameters : "", ref));
+  if (r == nullptr) return -1;
+  *out = r;
+  return 0;
+}
+
+int LGBM_DatasetSetFeatureNames(DatasetHandle handle,
+                                const char** feature_names, int num) {
+  Gil g;
+  if (!g.ok) return -1;
+  PyObject* lst = PyList_New(num);
+  for (int i = 0; i < num; ++i) {
+    PyObject* u = PyUnicode_FromString(feature_names[i]);
+    if (u == nullptr) {
+      set_error_from_python();
+      Py_DECREF(lst);
+      return -1;
+    }
+    PyList_SetItem(lst, i, u);
+  }
+  PyObject* r = bridge_call(
+      "dataset_set_feature_names",
+      Py_BuildValue("(ON)", reinterpret_cast<PyObject*>(handle), lst));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_DatasetGetFeatureNames(DatasetHandle handle, const int len,
+                                int* num_feature_names,
+                                const size_t buffer_len,
+                                size_t* out_buffer_len, char** out_strs) {
+  Gil g;
+  if (!g.ok) return -1;
+  PyObject* r = bridge_call(
+      "dataset_get_feature_names",
+      Py_BuildValue("(O)", reinterpret_cast<PyObject*>(handle)));
+  if (r == nullptr) return -1;
+  int rc = copy_str_list_out(r, len, num_feature_names, buffer_len,
+                             out_buffer_len, out_strs);
+  Py_DECREF(r);
+  return rc;
+}
+
 // ------------------------------------------------------------------ Booster
 int LGBM_BoosterCreate(DatasetHandle train_data, const char* parameters,
                        BoosterHandle* out) {
@@ -317,6 +414,50 @@ int LGBM_BoosterAddValidData(BoosterHandle handle, DatasetHandle valid_data) {
   return 0;
 }
 
+int LGBM_BoosterResetParameter(BoosterHandle handle,
+                               const char* parameters) {
+  Gil g;
+  if (!g.ok) return -1;
+  PyObject* r = bridge_call(
+      "booster_reset_parameter",
+      Py_BuildValue("(Os)", reinterpret_cast<PyObject*>(handle),
+                    parameters != nullptr ? parameters : ""));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_BoosterPredictForCSR(BoosterHandle handle, const void* indptr,
+                              int indptr_type, const int32_t* indices,
+                              const void* data, int data_type,
+                              int64_t nindptr, int64_t nelem, int64_t num_col,
+                              int predict_type, int start_iteration,
+                              int num_iteration, const char* parameter,
+                              int64_t* out_len, double* out_result) {
+  Gil g;
+  if (!g.ok) return -1;
+  PyObject* r = bridge_call(
+      "booster_predict_for_csr",
+      Py_BuildValue(
+          "(ONiNNiLLLiiis)", reinterpret_cast<PyObject*>(handle),
+          mv_from(indptr, nindptr * dtype_size(indptr_type)), indptr_type,
+          mv_from(indices, nelem * 4),
+          mv_from(data, nelem * dtype_size(data_type)), data_type,
+          static_cast<long long>(nindptr), static_cast<long long>(nelem),
+          static_cast<long long>(num_col), predict_type, start_iteration,
+          num_iteration, parameter != nullptr ? parameter : ""));
+  if (r == nullptr) return -1;
+  PyObject* raw = PyTuple_GetItem(r, 0);
+  int64_t n = PyLong_AsLongLong(PyTuple_GetItem(r, 1));
+  *out_len = n;
+  char* buf = PyBytes_AsString(raw);
+  if (buf != nullptr && out_result != nullptr) {
+    std::memcpy(out_result, buf, static_cast<size_t>(n) * sizeof(double));
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
 int LGBM_BoosterUpdateOneIter(BoosterHandle handle, int* is_finished) {
   Gil g;
   if (!g.ok) return -1;
@@ -380,30 +521,10 @@ int LGBM_BoosterGetEvalNames(BoosterHandle handle, const int len,
       "booster_get_eval_names",
       Py_BuildValue("(O)", reinterpret_cast<PyObject*>(handle)));
   if (r == nullptr) return -1;
-  Py_ssize_t n = PyList_Size(r);
-  *out_len = static_cast<int>(n);
-  size_t maxlen = 1;
-  for (Py_ssize_t i = 0; i < n; ++i) {
-    Py_ssize_t sl = 0;
-    PyUnicode_AsUTF8AndSize(PyList_GetItem(r, i), &sl);
-    if (static_cast<size_t>(sl) + 1 > maxlen) maxlen = sl + 1;
-  }
-  *out_buffer_len = maxlen;
-  if (out_strs != nullptr) {
-    for (Py_ssize_t i = 0; i < n && i < len; ++i) {
-      Py_ssize_t sl = 0;
-      const char* c = PyUnicode_AsUTF8AndSize(PyList_GetItem(r, i), &sl);
-      size_t cp = static_cast<size_t>(sl) + 1 <= buffer_len
-                      ? static_cast<size_t>(sl) + 1
-                      : buffer_len;
-      if (cp > 0) {
-        std::memcpy(out_strs[i], c, cp - 1);
-        out_strs[i][cp - 1] = '\0';
-      }
-    }
-  }
+  int rc = copy_str_list_out(r, len, out_len, buffer_len, out_buffer_len,
+                             out_strs);
   Py_DECREF(r);
-  return 0;
+  return rc;
 }
 
 int LGBM_BoosterGetEval(BoosterHandle handle, int data_idx, int* out_len,

@@ -35,8 +35,19 @@ int LGBM_DatasetCreateFromMat(const void* data, int data_type, int32_t nrow,
                               DatasetHandle* out);
 int LGBM_DatasetCreateFromFile(const char* filename, const char* parameters,
                                DatasetHandle reference, DatasetHandle* out);
+int LGBM_DatasetCreateFromCSR(const void* indptr, int indptr_type,
+                              const int32_t* indices, const void* data,
+                              int data_type, int64_t nindptr, int64_t nelem,
+                              int64_t num_col, const char* parameters,
+                              DatasetHandle reference, DatasetHandle* out);
 int LGBM_DatasetSetField(DatasetHandle handle, const char* field_name,
                          const void* field_data, int num_element, int type);
+int LGBM_DatasetSetFeatureNames(DatasetHandle handle,
+                                const char** feature_names, int num);
+int LGBM_DatasetGetFeatureNames(DatasetHandle handle, const int len,
+                                int* num_feature_names,
+                                const size_t buffer_len,
+                                size_t* out_buffer_len, char** out_strs);
 int LGBM_DatasetGetNumData(DatasetHandle handle, int32_t* out);
 int LGBM_DatasetGetNumFeature(DatasetHandle handle, int32_t* out);
 int LGBM_DatasetSaveBinary(DatasetHandle handle, const char* filename);
@@ -53,6 +64,14 @@ int LGBM_BoosterLoadModelFromString(const char* model_str,
 int LGBM_BoosterFree(BoosterHandle handle);
 int LGBM_BoosterAddValidData(BoosterHandle handle, DatasetHandle valid_data);
 int LGBM_BoosterUpdateOneIter(BoosterHandle handle, int* is_finished);
+int LGBM_BoosterResetParameter(BoosterHandle handle, const char* parameters);
+int LGBM_BoosterPredictForCSR(BoosterHandle handle, const void* indptr,
+                              int indptr_type, const int32_t* indices,
+                              const void* data, int data_type,
+                              int64_t nindptr, int64_t nelem, int64_t num_col,
+                              int predict_type, int start_iteration,
+                              int num_iteration, const char* parameter,
+                              int64_t* out_len, double* out_result);
 int LGBM_BoosterRollbackOneIter(BoosterHandle handle);
 int LGBM_BoosterGetCurrentIteration(BoosterHandle handle, int* out);
 int LGBM_BoosterGetNumClasses(BoosterHandle handle, int* out);
