@@ -204,3 +204,46 @@ int main() {
     got = np.array([float(v) for v in res.stdout.split()])
     want = bst.predict(rows, raw_score=True)
     np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+
+
+def test_convert_model_cpp_compiles_and_matches(tmp_path):
+    """Compile the generated if-else C++ (reference Tree::ToIfElse) and
+    check its raw scores against Booster.predict."""
+    import shutil
+    import subprocess
+
+    if shutil.which("g++") is None:
+        pytest.skip("no C++ compiler")
+    rng = np.random.RandomState(3)
+    X = rng.randn(500, 5)
+    X[rng.rand(500) < 0.1, 1] = np.nan
+    y = (X[:, 0] + np.nan_to_num(X[:, 1]) > 0).astype(np.float64)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "verbosity": -1}, lgb.Dataset(X, label=y), 5)
+    from lightgbm_tpu.convert_model import convert_model_string
+    from lightgbm_tpu.serialization import load_model_string
+    src = convert_model_string(load_model_string(bst.model_to_string()))
+    main = r"""
+#include <cstdio>
+int main() {
+  double row[5];
+  double out[1];
+  while (scanf("%lf %lf %lf %lf %lf", row, row+1, row+2, row+3, row+4) == 5) {
+    PredictRaw(row, out);
+    printf("%.10f\n", out[0]);
+  }
+  return 0;
+}
+"""
+    cpp = tmp_path / "model.cpp"
+    cpp.write_text(src + main)
+    exe = tmp_path / "model"
+    subprocess.run(["g++", "-O1", str(cpp), "-o", str(exe)], check=True,
+                   capture_output=True)
+    feed = "\n".join(" ".join("nan" if np.isnan(v) else f"{v:.17g}"
+                              for v in row) for row in X[:100])
+    res = subprocess.run([str(exe)], input=feed, capture_output=True,
+                         text=True, check=True, timeout=120)
+    got = np.array([float(t) for t in res.stdout.split()])
+    want = bst.predict(X[:100], raw_score=True)
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
