@@ -1,0 +1,42 @@
+"""Generate docs/PARAMETERS.md from the config spec table (the reference
+generates docs/Parameters.rst from config.h the same way,
+.ci/parameter-generator.py)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from lightgbm_tpu.config import _PARAMS  # noqa: E402
+
+
+def main():
+    out = ["# Parameters",
+           "",
+           "Generated from `lightgbm_tpu/config.py` by "
+           "`tools/gen_params_doc.py` — the single source of truth for the "
+           "parameter surface (reference: `docs/Parameters.rst` generated "
+           "from `config.h`).",
+           "",
+           "| parameter | type | default | aliases | constraints |",
+           "|---|---|---|---|---|"]
+    for name, typ, default, aliases, bounds in _PARAMS:
+        tname = typ if isinstance(typ, str) else typ.__name__
+        alias_s = ", ".join(aliases) if aliases else ""
+        if bounds is None:
+            bound_s = ""
+        else:
+            lo, hi = bounds
+            bound_s = f"{'' if lo is None else lo} .. {'' if hi is None else hi}"
+        d = "" if default is None else repr(default)
+        out.append(f"| `{name}` | {tname} | {d} | {alias_s} | {bound_s} |")
+    out.append("")
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "docs", "PARAMETERS.md")
+    with open(path, "w") as fh:
+        fh.write("\n".join(out))
+    print(f"wrote {path}: {len(_PARAMS)} parameters")
+
+
+if __name__ == "__main__":
+    main()
