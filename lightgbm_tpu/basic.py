@@ -451,6 +451,25 @@ class Booster:
                 **kwargs) -> np.ndarray:
         if num_iteration is None and self.best_iteration > 0:
             num_iteration = self.best_iteration
+        if start_iteration == 0:
+            start_iteration = int(kwargs.pop("start_iteration_predict", 0))
+        data2 = _as_2d(data)
+        nf = self.num_feature()
+        if data2.shape[1] != nf:
+            # reference predict_disable_shape_check semantics: extra columns
+            # are sliced, missing ones are an error unless disabled (padded
+            # with NaN -> routed by missing handling).
+            if not kwargs.pop("predict_disable_shape_check", False):
+                raise ValueError(
+                    f"data has {data2.shape[1]} features, model expects "
+                    f"{nf}; pass predict_disable_shape_check=True to "
+                    "override (reference LGBM_BoosterPredictForMat check)")
+            if data2.shape[1] > nf:
+                data2 = data2[:, :nf]
+            else:
+                pad = np.full((data2.shape[0], nf - data2.shape[1]), np.nan)
+                data2 = np.concatenate([data2, pad], axis=1)
+        data = data2
         if pred_leaf or pred_contrib:
             if getattr(self._gbdt, "base_model", None) is not None:
                 raise ValueError(
@@ -479,7 +498,10 @@ class Booster:
         return self._gbdt.num_class
 
     def num_feature(self) -> int:
-        return self._gbdt.train_data.num_features
+        td = getattr(self._gbdt, "train_data", None)
+        if td is not None:
+            return td.num_features
+        return int(self._gbdt.num_features)  # LoadedModel
 
     def feature_name(self) -> List[str]:
         names = self._gbdt.train_data.feature_names

@@ -222,6 +222,7 @@ def bin_dataset(
     zero_as_missing: bool = False,
     sample_cnt: int = 200000,
     random_state: int = 1,
+    max_bin_by_feature: Optional[Sequence[int]] = None,
 ) -> "BinnedData":
     """Bin a full feature matrix. Sampling mirrors the reference's
     ``DatasetLoader::SampleTextDataFromFile`` (``dataset_loader.cpp:1022``): bin
@@ -235,11 +236,22 @@ def bin_dataset(
     else:
         sample = X
     cat_set = set(int(c) for c in categorical_features)
+    if max_bin_by_feature is not None:
+        # reference CHECKs length == num features and every value > 1
+        if len(max_bin_by_feature) != f:
+            raise ValueError(
+                f"max_bin_by_feature has {len(max_bin_by_feature)} entries "
+                f"for {f} features (reference requires an exact match)")
+        if any(int(v) <= 1 for v in max_bin_by_feature):
+            raise ValueError("max_bin_by_feature values must be > 1")
     mappers: List[BinMapper] = []
     for j in range(f):
+        mb = max_bin
+        if max_bin_by_feature is not None:
+            mb = int(max_bin_by_feature[j])
         mappers.append(
             find_bin(
-                sample[:, j], max_bin, min_data_in_bin,
+                sample[:, j], mb, min_data_in_bin,
                 is_categorical=(j in cat_set),
                 use_missing=use_missing, zero_as_missing=zero_as_missing,
             )

@@ -92,9 +92,13 @@ def run(argv: List[str]) -> int:
         X, _, _, _ = load_data_file(data_path, cfg.label_column, cfg.header)
         pred = bst.predict(
             X, raw_score=cfg.predict_raw_score,
+            start_iteration=cfg.start_iteration_predict,
+            num_iteration=(cfg.num_iteration_predict
+                           if cfg.num_iteration_predict > 0 else None),
             pred_early_stop=cfg.pred_early_stop,
             pred_early_stop_freq=cfg.pred_early_stop_freq,
-            pred_early_stop_margin=cfg.pred_early_stop_margin)
+            pred_early_stop_margin=cfg.pred_early_stop_margin,
+            predict_disable_shape_check=cfg.predict_disable_shape_check)
         out = params.get("output_result", "LightGBM_predict_result.txt")
         np.savetxt(out, np.atleast_2d(pred.T).T, fmt="%.9g")
         Log.info(f"Finished prediction; results saved to {out}")

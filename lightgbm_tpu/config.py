@@ -180,7 +180,6 @@ _PARAMS: List[Tuple[str, Any, Any, Tuple[str, ...], Optional[Tuple[Any, Any]]]] 
     # TPU-specific knobs (no reference analog).
     ("tpu_histogram_impl", str, "auto", (), None),  # auto|pallas|flat_bf16|onehot|segment
     ("tpu_rows_block", int, 16384, (), (256, None)),
-    ("tpu_donate_buffers", bool, True, (), None),
     # Leaves split per growth step (wave growth); 1 = strict best-first.
     ("tpu_leaf_batch", int, 1, (), (1, 128)),
 ]

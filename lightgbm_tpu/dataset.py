@@ -74,6 +74,7 @@ class TrainData:
                 zero_as_missing=cfg.zero_as_missing,
                 sample_cnt=cfg.bin_construct_sample_cnt,
                 random_state=cfg.data_random_seed,
+                max_bin_by_feature=cfg.max_bin_by_feature,
             )
         mono = None
         if cfg.monotone_constraints:

@@ -45,6 +45,7 @@ def train(
         if params.get(alias):
             early_stopping_rounds = int(params[alias])
     first_metric_only = bool(params.get("first_metric_only", False))
+    es_min_delta = float(params.get("early_stopping_min_delta", 0.0))
 
     valid_sets = list(valid_sets or [])
     names = list(valid_names or [])
@@ -97,7 +98,8 @@ def train(
     if early_stopping_rounds is not None and valid_pairs:
         cbs.append(callback_mod.early_stopping(
             early_stopping_rounds, first_metric_only=first_metric_only,
-            verbose=params.get("verbosity", 1) > 0))
+            verbose=params.get("verbosity", 1) > 0,
+            min_delta=es_min_delta))
     cbs_before = [cb for cb in cbs if getattr(cb, "before_iteration", False)]
     cbs_after = [cb for cb in cbs if not getattr(cb, "before_iteration", False)]
     cbs_before.sort(key=lambda cb: getattr(cb, "order", 0))

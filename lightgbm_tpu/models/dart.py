@@ -87,8 +87,14 @@ class DART(GBDT):
         # by k/(k+1); the new tree is scaled by 1/(k+1).
         kd = len(drop_idx)
         if kd > 0:
-            factor_old = kd / (kd + 1.0)
-            factor_new = 1.0 / (kd + 1.0)
+            if cfg.xgboost_dart_mode:
+                # reference dart.hpp:140-145,179-196: shrinkage lr/(lr+k),
+                # dropped trees keep k/(k+lr)
+                denom = kd + cfg.learning_rate
+            else:
+                denom = kd + 1.0
+            factor_old = kd / denom
+            factor_new = 1.0 / denom
             for k in range(self.num_class):
                 new_idx = len(self.dev_models[k]) - 1
                 self._scale_new_tree(k, new_idx, factor_new)

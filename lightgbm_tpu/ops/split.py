@@ -45,6 +45,9 @@ class SplitConfig:
     # Monotone split-gain penalty near the root (reference
     # ComputeMonotoneSplitGainPenalty, monotone_constraints.hpp:357).
     monotone_penalty: float = 0.0
+    # Per-feature split-gain multipliers (reference feature_contri /
+    # config->feature_contri applied in FindBestThreshold* gain).
+    feature_contri: 'Optional[Tuple[float, ...]]' = None
     # Extremely-randomized trees (reference col_sampler + USE_RAND scans):
     # when set, each (node, feature) evaluates ONE random threshold.
     extra_trees: bool = False
@@ -376,6 +379,15 @@ def best_split(
         # (reference stops on "gain <= 0").
         gain_fb = jnp.where(gain_fb > _EPS, gain_fb, -jnp.inf)
 
+    if cfg.feature_contri is not None:
+        fc = jnp.asarray(cfg.feature_contri, jnp.float32)[:f]
+        fc = jnp.concatenate([fc, jnp.ones(f - fc.shape[0], jnp.float32)]) \
+            if fc.shape[0] < f else fc
+        scaled = gain_fb * fc[:, None]
+        # reference stops on best gain <= 0: a zeroed-out feature must not
+        # win over "no split"
+        gain_fb = jnp.where(jnp.isfinite(gain_fb) & (scaled > _EPS),
+                            scaled, -jnp.inf)
     gain_fb = jnp.where(feature_mask[:, None], gain_fb, -jnp.inf)
 
     flat = jnp.argmax(gain_fb)
@@ -433,6 +445,14 @@ def _merge_sorted_categorical(best, G, H, C, parent_grad, parent_hess,
     if penalty_col is not None:
         s_gain = s_gain - penalty_col[:, 0]
         s_gain = jnp.where(s_gain > _EPS, s_gain, -jnp.inf)
+    if cfg.feature_contri is not None:
+        f = s_gain.shape[0]
+        fc = jnp.asarray(cfg.feature_contri, jnp.float32)[:f]
+        fc = jnp.concatenate([fc, jnp.ones(f - fc.shape[0], jnp.float32)]) \
+            if fc.shape[0] < f else fc
+        s_scaled = s_gain * fc
+        s_gain = jnp.where(jnp.isfinite(s_gain) & (s_scaled > _EPS),
+                           s_scaled, -jnp.inf)
     s_gain = jnp.where(sorted_eligible & feature_mask, s_gain, -jnp.inf)
     sf = jnp.argmax(s_gain).astype(jnp.int32)
     sg = s_gain[sf]

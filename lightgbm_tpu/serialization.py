@@ -509,6 +509,9 @@ class LoadedModel:
         self.trees = trees
         self.init_scores = init_scores
         self.feature_names = feature_names
+        self.num_features = int(
+            (header or {}).get("max_feature_idx", len(feature_names) - 1)
+        ) + 1 if (header or feature_names) else len(feature_names)
         self.params = params
         self.header = dict(header or {})
         obj_extra = {}

@@ -177,3 +177,76 @@ def test_inert_layout_params_warn(capsys):
     err = capsys.readouterr()
     text = err.out + err.err
     assert "is_enable_sparse" in text and "two_round" in text
+
+
+def test_max_bin_by_feature_caps_per_feature():
+    rng = np.random.RandomState(0)
+    X = rng.randn(2000, 3)
+    y = (X[:, 0] > 0).astype(float)
+    ds = lgb.Dataset(X, label=y)
+    td = ds.construct({"objective": "binary", "max_bin": 100,
+                       "max_bin_by_feature": [5, 100, 100],
+                       "verbosity": -1})
+    assert td.binned.num_bins_per_feature[0] <= 6   # 5 value bins (+nan)
+    assert td.binned.num_bins_per_feature[1] > 20
+
+
+def test_feature_contri_scales_gains():
+    rng = np.random.RandomState(1)
+    X = rng.randn(3000, 3)
+    # feature 0 and 1 both informative; crushing 0's contribution must
+    # steer the root split to feature 1
+    y = (X[:, 0] + 0.95 * X[:, 1] > 0).astype(float)
+    base = {"objective": "binary", "num_leaves": 7, "verbosity": -1}
+    b0 = lgb.train(base, lgb.Dataset(X, label=y), 1)
+    assert b0._gbdt.models[0][0].split_feature[0] == 0
+    b1 = lgb.train(dict(base, feature_contri=[0.01, 1.0, 1.0]),
+                   lgb.Dataset(X, label=y), 1)
+    assert b1._gbdt.models[0][0].split_feature[0] == 1
+
+
+def test_early_stopping_min_delta():
+    rng = np.random.RandomState(2)
+    X = rng.randn(1500, 5)
+    y = (X[:, 0] > 0).astype(float)
+    ds = lgb.Dataset(X[:1000], label=y[:1000])
+    vs = lgb.Dataset(X[1000:], label=y[1000:], reference=ds)
+    params = {"objective": "binary", "num_leaves": 7, "metric": "auc",
+              "verbosity": -1, "early_stopping_round": 3}
+    full = lgb.train(params, ds, 60, valid_sets=[vs])
+    strict = lgb.train(dict(params, early_stopping_min_delta=0.05), ds, 60,
+                       valid_sets=[vs])
+    # demanding 0.05 AUC improvement per round stops much earlier
+    assert strict.best_iteration <= full.best_iteration
+    assert strict.num_trees() < 60
+
+
+def test_xgboost_dart_mode_changes_scaling():
+    rng = np.random.RandomState(3)
+    X = rng.randn(1200, 4)
+    y = (X[:, 0] > 0).astype(float)
+    base = {"objective": "binary", "boosting": "dart", "num_leaves": 7,
+            "verbosity": -1, "drop_rate": 0.5, "skip_drop": 0.0,
+            "drop_seed": 7}
+    b_norm = lgb.train(base, lgb.Dataset(X, label=y), 8)
+    b_xgb = lgb.train(dict(base, xgboost_dart_mode=True),
+                      lgb.Dataset(X, label=y), 8)
+    p_norm = b_norm.predict(X[:50], raw_score=True)
+    p_xgb = b_xgb.predict(X[:50], raw_score=True)
+    assert not np.allclose(p_norm, p_xgb)
+
+
+def test_predict_shape_check_and_start_iteration_predict():
+    rng = np.random.RandomState(4)
+    X = rng.randn(800, 4)
+    y = (X[:, 0] > 0).astype(float)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "verbosity": -1}, lgb.Dataset(X, label=y), 6)
+    with pytest.raises(ValueError, match="features"):
+        bst.predict(X[:5, :2])
+    p = bst.predict(X[:5, :2], predict_disable_shape_check=True)
+    assert p.shape == (5,)
+    # start_iteration_predict kwarg == start_iteration argument
+    a = bst.predict(X[:20], raw_score=True, start_iteration=3)
+    b = bst.predict(X[:20], raw_score=True, start_iteration_predict=3)
+    np.testing.assert_allclose(a, b)
