@@ -502,6 +502,33 @@ def build_bundles(binned: "BinnedData", *, max_conflict_rate: float = 0.0,
             bundle_nz.append(nz[:, j].copy())
             bundle_bins.append(1 + extra)
 
+    # The greedy pass enforced the budget on a sample only; re-check each
+    # multi-member bundle on the FULL matrix with the SAME accumulated
+    # criterion the greedy pass used (each of the m-1 additions was allowed
+    # <= budget conflicts, so a bundle may hold up to (m-1)*budget total)
+    # and evict the worst offender into a singleton until it fits —
+    # otherwise out-of-sample conflicts silently lose values last-writer-
+    # wins in bundle_row_matrix.  When the sample was the full matrix the
+    # greedy pass already enforced this exactly.
+    full_budget = int(max_conflict_rate * n)
+    n_evicted = 0
+    if n > s:
+        for bi in range(len(bundles)):
+            members = bundles[bi]
+            while len(members) > 1:
+                nz_cols = bins[:, members] != 0          # (N, m)
+                row_nnz = nz_cols.sum(axis=1)
+                conflicts = int(np.maximum(row_nnz - 1, 0).sum())
+                if conflicts <= (len(members) - 1) * full_budget:
+                    break
+                overlap = ((row_nnz > 1)[:, None] & nz_cols).sum(axis=0)
+                bundles.append([members.pop(int(np.argmax(overlap)))])
+                n_evicted += 1
+    if n_evicted:
+        from .utils.log import Log
+        Log.debug(f"EFB: evicted {n_evicted} feature(s) whose full-data "
+                  f"conflict count exceeded the sampled budget")
+
     n_single = f - sum(len(b) for b in bundles)
     n_groups = len(bundles) + n_single
     if n_groups > min_gain_cols * f:

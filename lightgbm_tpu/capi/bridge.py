@@ -48,6 +48,14 @@ def _parse_params(params: str) -> dict:
     return out
 
 
+def _str2bool(v) -> bool:
+    """Bool grammar shared with the config path (``config._coerce``) so
+    ``pred_early_stop=false`` through the C API behaves exactly like the
+    same string through ``Config``."""
+    from ..config import _coerce
+    return _coerce("pred_early_stop", bool, v)
+
+
 def _mat_from_memory(mv, dtype_code: int, nrow: int, ncol: int,
                      is_row_major: int) -> np.ndarray:
     arr = np.frombuffer(mv, dtype=_NP_DTYPES[dtype_code],
@@ -277,9 +285,13 @@ def _predict_dispatch(handle, X, predict_type, start_iteration,
                       num_iteration, params):
     kw = dict(start_iteration=start_iteration,
               num_iteration=None if num_iteration <= 0 else num_iteration)
-    kw.update({k: v for k, v in _parse_params(params).items()
-               if k in ("pred_early_stop", "pred_early_stop_freq",
-                        "pred_early_stop_margin")})
+    # Coerce C parameter-string values (reference Config::GetBool /
+    # GetInt / GetDouble semantics): "false" must disable, not enable.
+    coerce = {"pred_early_stop": _str2bool,
+              "pred_early_stop_freq": int,
+              "pred_early_stop_margin": float}
+    kw.update({k: coerce[k](v) for k, v in _parse_params(params).items()
+               if k in coerce})
     if predict_type == C_API_PREDICT_RAW_SCORE:
         out = handle.bst.predict(X, raw_score=True, **kw)
     elif predict_type == C_API_PREDICT_LEAF_INDEX:

@@ -736,25 +736,43 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
                 _scale_hist(st.leaf_hist[fleaf], scale3), meta,
                 st.leaf_sum_grad[fleaf], st.leaf_sum_hess[fleaf],
                 st.leaf_count[fleaf])
-            cum = jnp.cumsum(hist[feat], axis=0)      # (B, 3) missing-right
-            gl, hl, cl = cum[sbin, 0], cum[sbin, 1], cum[sbin, 2]
+            hb = hist[feat]                           # (B, 3)
+            nanb = meta[1][feat]
+            nan_pos = jnp.arange(hb.shape[0], dtype=jnp.int32) == nanb
+            cum = jnp.cumsum(jnp.where(nan_pos[:, None], 0.0, hb), axis=0)
             pg, ph = st.leaf_sum_grad[fleaf], st.leaf_sum_hess[fleaf]
-            fgain = (leaf_gain(gl, hl, cfg.split)
-                     + leaf_gain(pg - gl, ph - hl, cfg.split)
-                     - leaf_gain(pg, ph, cfg.split))
-            return gl, hl, cl, fgain
+
+            def _gain(gl, hl):
+                return (leaf_gain(gl, hl, cfg.split)
+                        + leaf_gain(pg - gl, ph - hl, cfg.split)
+                        - leaf_gain(pg, ph, cfg.split))
+
+            # Both missing directions, as the normal split machinery does
+            # (reference ForceSplits routes through ComputeBestSplitForFeature
+            # so the missing direction is derived, not fixed).
+            gl_r, hl_r, cl_r = cum[sbin, 0], cum[sbin, 1], cum[sbin, 2]
+            gn = jnp.sum(jnp.where(nan_pos, hb[:, 0], 0.0))
+            hn = jnp.sum(jnp.where(nan_pos, hb[:, 1], 0.0))
+            cn = jnp.sum(jnp.where(nan_pos, hb[:, 2], 0.0))
+            has_nan = nanb < hb.shape[0]
+            dl = has_nan & (_gain(gl_r + gn, hl_r + hn) > _gain(gl_r, hl_r))
+            gl = jnp.where(dl, gl_r + gn, gl_r)
+            hl = jnp.where(dl, hl_r + hn, hl_r)
+            cl = jnp.where(dl, cl_r + cn, cl_r)
+            return gl, hl, cl, _gain(gl, hl), dl
 
         # Pay the expand+cumsum only while forced splits remain.
-        gl, hl, cl, fgain = jax.lax.cond(
+        gl, hl, cl, fgain, dleft = jax.lax.cond(
             use, _forced_stats,
-            lambda _: (jnp.zeros((), jnp.float32),) * 4, None)
+            lambda _: (jnp.zeros((), jnp.float32),) * 4
+            + (jnp.zeros((), bool),), None)
         tgt = jnp.where(use, fleaf, L + M)            # OOB drop when unused
         st = st._replace(
             best_gain=st.best_gain.at[tgt].set(fgain, mode="drop"),
             best_feature=st.best_feature.at[tgt].set(feat, mode="drop"),
             best_bin=st.best_bin.at[tgt].set(sbin, mode="drop"),
             best_default_left=st.best_default_left.at[tgt].set(
-                False, mode="drop"),
+                dleft, mode="drop"),
             best_is_cat=st.best_is_cat.at[tgt].set(False, mode="drop"),
             best_cat_mask=st.best_cat_mask.at[tgt].set(
                 jnp.zeros(B, bool), mode="drop"),

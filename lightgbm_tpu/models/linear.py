@@ -102,6 +102,55 @@ def fit_leaf_linear_models(tree, X: np.ndarray, row_leaf: np.ndarray,
     tree.leaf_coeff = leaf_coeffs
 
 
+def refit_leaf_linear_models(tree, X: np.ndarray, row_leaf: np.ndarray,
+                             grad: np.ndarray, hess: np.ndarray,
+                             linear_lambda: float, decay_rate: float,
+                             shrinkage: float) -> None:
+    """Refit a linear tree's leaf models on new data (mutates ``tree``).
+
+    Mirrors ``LinearTreeLearner::CalculateLinear`` with ``is_refit=true``
+    (``linear_tree_learner.cpp:180,326-383``): each leaf KEEPS its existing
+    feature set, the weighted least squares is re-solved on the new rows,
+    and both constant and coefficients are decay-blended:
+    ``decay * old + (1 - decay) * new * shrinkage``.  Leaves with too few
+    usable rows keep their old model.
+    """
+    nl = tree.num_leaves
+    order = np.argsort(row_leaf, kind="stable")
+    bounds = np.searchsorted(row_leaf[order], np.arange(nl + 1))
+    leaf_const = np.asarray(tree.leaf_const, np.float64).copy()
+    leaf_coeff = [np.asarray(c, np.float64).copy() for c in tree.leaf_coeff]
+    for l in range(nl):
+        fl = np.asarray(tree.leaf_features[l], np.int64)
+        d = len(fl)
+        if d == 0:
+            continue
+        rows = order[bounds[l]: bounds[l + 1]]
+        if len(rows) == 0:
+            continue
+        Xl = X[rows][:, fl].astype(np.float64)
+        ok = ~np.isnan(Xl).any(axis=1)
+        if ok.sum() < d + 1:
+            continue
+        Xl = Xl[ok]
+        g = grad[rows][ok].astype(np.float64)
+        h = hess[rows][ok].astype(np.float64)
+        Xa = np.concatenate([Xl, np.ones((len(Xl), 1))], axis=1)
+        A = (Xa.T * h[None, :]) @ Xa
+        A[np.arange(d), np.arange(d)] += linear_lambda
+        b = Xa.T @ g
+        try:
+            coeffs = -np.linalg.solve(A, b)
+        except np.linalg.LinAlgError:
+            coeffs = -np.linalg.lstsq(A, b, rcond=None)[0]
+        leaf_coeff[l] = (decay_rate * leaf_coeff[l]
+                         + (1.0 - decay_rate) * coeffs[:d] * shrinkage)
+        leaf_const[l] = (decay_rate * leaf_const[l]
+                         + (1.0 - decay_rate) * coeffs[d] * shrinkage)
+    tree.leaf_const = leaf_const
+    tree.leaf_coeff = leaf_coeff
+
+
 def predict_linear(tree, leaf_idx: np.ndarray, X: np.ndarray) -> np.ndarray:
     """Linear-leaf prediction: ``const + sum coef*x``; rows with NaN in the
     leaf's features fall back to the plain leaf value (reference

@@ -18,9 +18,9 @@ from .basic import Booster
 from .sklearn import LGBMModel
 
 
-def _check_not_tuple_of_2_elements(obj, obj_name="obj"):
+def _require_pair(obj, obj_name="obj"):
     if not isinstance(obj, tuple) or len(obj) != 2:
-        raise TypeError(f"{obj_name} must be a tuple of 2 elements.")
+        raise TypeError(f"{obj_name} must be a pair of 2 elements")
 
 
 def _to_booster(booster) -> Booster:
@@ -70,7 +70,7 @@ def plot_importance(
 
     if ax is None:
         if figsize is not None:
-            _check_not_tuple_of_2_elements(figsize, "figsize")
+            _require_pair(figsize, "figsize")
         _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
     ylocs = np.arange(len(values))
     ax.barh(ylocs, values, align="center", height=height, **kwargs)
@@ -81,12 +81,12 @@ def plot_importance(
     ax.set_yticks(ylocs)
     ax.set_yticklabels(labels)
     if xlim is not None:
-        _check_not_tuple_of_2_elements(xlim, "xlim")
+        _require_pair(xlim, "xlim")
     else:
         xlim = (0, max(values) * 1.1)
     ax.set_xlim(xlim)
     if ylim is not None:
-        _check_not_tuple_of_2_elements(ylim, "ylim")
+        _require_pair(ylim, "ylim")
     else:
         ylim = (-1, len(values))
     ax.set_ylim(ylim)
@@ -149,15 +149,15 @@ def plot_split_value_histogram(
 
     if ax is None:
         if figsize is not None:
-            _check_not_tuple_of_2_elements(figsize, "figsize")
+            _require_pair(figsize, "figsize")
         _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
     width = width_coef * (bin_edges[1] - bin_edges[0])
     ax.bar(centers, hist, width=width, align="center", **kwargs)
     if xlim is not None:
-        _check_not_tuple_of_2_elements(xlim, "xlim")
+        _require_pair(xlim, "xlim")
         ax.set_xlim(xlim)
     if ylim is not None:
-        _check_not_tuple_of_2_elements(ylim, "ylim")
+        _require_pair(ylim, "ylim")
     else:
         ylim = (0, max(hist) * 1.1)
     ax.set_ylim(ylim)
@@ -205,7 +205,7 @@ def plot_metric(
 
     if ax is None:
         if figsize is not None:
-            _check_not_tuple_of_2_elements(figsize, "figsize")
+            _require_pair(figsize, "figsize")
         _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
 
     if dataset_names is None:
@@ -237,12 +237,12 @@ def plot_metric(
         ax.plot(x_, results, label=name)
     ax.legend(loc="best")
     if xlim is not None:
-        _check_not_tuple_of_2_elements(xlim, "xlim")
+        _require_pair(xlim, "xlim")
     else:
         xlim = (0, num_iteration)
     ax.set_xlim(xlim)
     if ylim is not None:
-        _check_not_tuple_of_2_elements(ylim, "ylim")
+        _require_pair(ylim, "ylim")
     else:
         range_result = max_result - min_result
         ylim = (min_result - range_result * 0.2,
@@ -336,7 +336,7 @@ def plot_tree(
 
     if ax is None:
         if figsize is not None:
-            _check_not_tuple_of_2_elements(figsize, "figsize")
+            _require_pair(figsize, "figsize")
         _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
     try:
         from graphviz import Digraph  # noqa: F401

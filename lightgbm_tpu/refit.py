@@ -48,12 +48,14 @@ def _refit_pass(
     n: int, k_cls: int, n_iters: int, init_scores: np.ndarray,
     objective, cfg: Config, decay_rate: float,
     route: Callable[[int, int], Tuple[np.ndarray, int, float, np.ndarray]],
-    store: Callable[[int, int, np.ndarray, np.ndarray], None],
+    store: Callable[..., "np.ndarray | None"],
 ) -> None:
     """Shared refit loop.  ``route(it, k) -> (leaf_idx, num_leaves,
     shrinkage, old_leaf_values)``; ``store(it, k, new_leaf_values,
-    leaf_counts)`` writes them back.  Scores progress exactly as the
-    reference's ``Boosting(); FitByExistingTree`` sequence."""
+    leaf_counts, leaf_idx, grad_k, hess_k)`` writes them back and may
+    return a per-row score contribution overriding ``new_leaf[leaf]``
+    (linear trees).  Scores progress exactly as the reference's
+    ``Boosting(); FitByExistingTree`` sequence."""
     import jax
     import jax.numpy as jnp
 
@@ -71,9 +73,12 @@ def _refit_pass(
             refit_val = _leaf_output_np(sum_g, sum_h, cfg) * shrinkage
             new_leaf = (decay_rate * np.asarray(old[:nl], np.float64)
                         + (1.0 - decay_rate) * refit_val)
-            store(it, k, new_leaf,
-                  np.bincount(leaf, minlength=nl).astype(np.float32))
-            scores[:, k] += new_leaf[leaf].astype(np.float32)
+            contrib = store(
+                it, k, new_leaf,
+                np.bincount(leaf, minlength=nl).astype(np.float32),
+                leaf, g[:, k], h[:, k])
+            scores[:, k] += (new_leaf[leaf] if contrib is None
+                             else contrib).astype(np.float32)
 
 
 def refit_loaded(model, X: np.ndarray, label: np.ndarray,
@@ -89,9 +94,6 @@ def refit_loaded(model, X: np.ndarray, label: np.ndarray,
     objective = _init_objective(create_objective(cfg), label, weight, group,
                                 cfg)
 
-    if any(t.is_linear for t in model.trees):
-        raise ValueError("refit of linear-tree models is not supported "
-                         "(leaf linear coefficients are not refit)")
     X = np.asarray(X, np.float64)
     k_cls = model.num_class
     new_model = copy.copy(model)
@@ -102,10 +104,17 @@ def refit_loaded(model, X: np.ndarray, label: np.ndarray,
         return (tree.predict_leaf(X), tree.num_leaves, tree.shrinkage,
                 np.asarray(tree.leaf_value, np.float64))
 
-    def store(it, k, new_leaf, _counts):
+    def store(it, k, new_leaf, _counts, leaf, gk, hk):
         tree = new_model.trees[it * k_cls + k]
         tree.leaf_value = np.asarray(tree.leaf_value, np.float64).copy()
         tree.leaf_value[: len(new_leaf)] = new_leaf
+        if getattr(tree, "is_linear", False):
+            from .models.linear import (predict_linear,
+                                        refit_leaf_linear_models)
+            refit_leaf_linear_models(tree, X, leaf, gk, hk,
+                                     cfg.linear_lambda, decay_rate,
+                                     tree.shrinkage)
+            return predict_linear(tree, leaf, X)
 
     _refit_pass(X.shape[0], k_cls, len(model.trees) // k_cls,
                 model.init_scores, objective, cfg, decay_rate, route, store)
@@ -118,12 +127,6 @@ def refit_booster(booster: "Booster", X: np.ndarray, label: np.ndarray,
     import jax.numpy as jnp
 
     gbdt = booster._gbdt
-    if getattr(gbdt, "base_model", None) is not None:
-        raise ValueError("refit of a continuation booster is not supported; "
-                         "save and reload the combined model first")
-    if gbdt.cfg.linear_tree:
-        raise ValueError("refit of linear-tree models is not supported "
-                         "(leaf linear coefficients are not refit)")
     cfg = gbdt.cfg
     binned = gbdt.train_data.binned
     bins = binned.apply(np.asarray(X))
@@ -138,25 +141,59 @@ def refit_booster(booster: "Booster", X: np.ndarray, label: np.ndarray,
     objective = _init_objective(copy.copy(gbdt.objective), label, weight,
                                 group, cfg)
 
+    # A continuation booster refits the COMBINED ensemble — the base model's
+    # trees come first, exactly as RefitTree walks every loaded model
+    # (gbdt.cpp:258 iterates models_ which includes input_model trees).
+    base = getattr(gbdt, "base_model", None)
+    nb = base.iter_ if base is not None else 0
+    init_scores = np.asarray(gbdt.init_scores, np.float64).copy()
+    Xf = np.asarray(X, np.float64)
+    if base is not None:
+        new_base = copy.copy(base)
+        new_base.trees = [copy.copy(t) for t in base.trees]
+        new_gbdt.base_model = new_base
+        init_scores[:k_cls] += np.asarray(base.init_scores,
+                                          np.float64)[:k_cls]
+
+    def _refit_linear(tree, leaf, gk, hk):
+        from .models.linear import predict_linear, refit_leaf_linear_models
+        refit_leaf_linear_models(tree, Xf, leaf, gk, hk, cfg.linear_lambda,
+                                 decay_rate, tree.shrinkage)
+        return predict_linear(tree, leaf, Xf)
+
     def route(it, k):
-        tree = copy.copy(gbdt.models[k][it])
-        new_gbdt._host_cache[k][it] = tree
+        if it < nb:
+            tree = new_gbdt.base_model.trees[it * k_cls + k]
+            return (tree.predict_leaf(Xf), tree.num_leaves, tree.shrinkage,
+                    np.asarray(tree.leaf_value, np.float64))
+        tree = copy.copy(gbdt.models[k][it - nb])
+        new_gbdt._host_cache[k][it - nb] = tree
         return (tree.predict_leaf_bins(bins, nan_bins), tree.num_leaves,
                 tree.shrinkage, np.asarray(tree.leaf_value, np.float64))
 
-    def store(it, k, new_leaf, counts):
-        tree = new_gbdt._host_cache[k][it]
+    def store(it, k, new_leaf, counts, leaf, gk, hk):
+        if it < nb:
+            tree = new_gbdt.base_model.trees[it * k_cls + k]
+            tree.leaf_value = np.asarray(tree.leaf_value, np.float64).copy()
+            tree.leaf_value[: len(new_leaf)] = new_leaf
+            if getattr(tree, "is_linear", False):
+                return _refit_linear(tree, leaf, gk, hk)
+            return None
+        tree = new_gbdt._host_cache[k][it - nb]
         nl = len(new_leaf)
         tree.leaf_value = tree.leaf_value.copy()
         tree.leaf_value[:nl] = new_leaf
         tree.leaf_count = counts[: len(tree.leaf_count)]
-        arrays = new_gbdt.dev_models[k][it]
+        arrays = new_gbdt.dev_models[k][it - nb]
         lv = np.zeros(arrays.leaf_value.shape[0], np.float32)
         lv[:nl] = new_leaf
-        new_gbdt.dev_models[k][it] = arrays._replace(
+        new_gbdt.dev_models[k][it - nb] = arrays._replace(
             leaf_value=jnp.asarray(lv))
+        if tree.is_linear:
+            return _refit_linear(tree, leaf, gk, hk)
+        return None
 
     n_iters = min(len(m) for m in gbdt.models) if gbdt.models else 0
-    _refit_pass(np.asarray(X).shape[0], k_cls, n_iters, gbdt.init_scores,
+    _refit_pass(np.asarray(X).shape[0], k_cls, nb + n_iters, init_scores,
                 objective, cfg, decay_rate, route, store)
     return new_b

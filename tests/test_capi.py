@@ -96,6 +96,17 @@ def test_capi_full_workflow(tmp_path):
     assert out_n.value == Xp.shape[0]
     c_pred = np.array(out[:])
 
+    # "pred_early_stop=false" must be parsed as bool false (reference
+    # Config::GetBool), not as a truthy non-empty string — predictions with
+    # the flag explicitly disabled must match the default exactly
+    out_es = (ctypes.c_double * Xp.shape[0])()
+    _check(lib, lib.LGBM_BoosterPredictForMat(
+        bst, Xp.ctypes.data_as(ctypes.c_void_p), 1,
+        ctypes.c_int32(Xp.shape[0]), ctypes.c_int32(Xp.shape[1]),
+        ctypes.c_int(1), ctypes.c_int(1), ctypes.c_int(0), ctypes.c_int(-1),
+        b"pred_early_stop=false", ctypes.byref(out_n), out_es))
+    np.testing.assert_array_equal(np.array(out_es[:]), c_pred)
+
     # save -> reload via string round trip
     buf_len = ctypes.c_int64(1 << 22)
     buf = ctypes.create_string_buffer(buf_len.value)

@@ -126,6 +126,62 @@ def test_refit_trained_booster():
     np.testing.assert_allclose(bst.predict(X2), p_old)
 
 
+def test_refit_continuation_booster():
+    """Refit walks the COMBINED ensemble (base trees first), mirroring
+    RefitTree over all loaded models (gbdt.cpp:258)."""
+    X, y = _make()
+    first = lgb.train(PARAMS, lgb.Dataset(X, label=y), num_boost_round=10)
+    resumed = lgb.train(PARAMS, lgb.Dataset(X, label=y), num_boost_round=10,
+                        init_model=first)
+    X2, y2 = _make(seed=41)
+    ref = resumed.refit(X2, y2, decay_rate=0.0)
+    assert ref.num_trees() == resumed.num_trees() == 20
+    p_old = resumed.predict(X2)
+    p_new = ref.predict(X2)
+    assert np.mean((p_new - y2) ** 2) < np.mean((p_old - y2) ** 2) + 1e-9
+    assert not np.allclose(p_old, p_new)
+    # base-model trees were refit too, not just the continuation's own
+    base_old = resumed._gbdt.base_model.trees[0].leaf_value
+    base_new = ref._gbdt.base_model.trees[0].leaf_value
+    assert not np.allclose(np.asarray(base_old), np.asarray(base_new))
+    # decay_rate=1 keeps the combined model unchanged
+    same = resumed.refit(X2, y2, decay_rate=1.0)
+    np.testing.assert_allclose(same.predict(X2), p_old, rtol=1e-5, atol=1e-6)
+
+
+def test_refit_linear_tree_booster(tmp_path):
+    """Linear-tree refit re-solves each leaf's model on the new data with
+    the leaf's EXISTING feature set, decay-blended (reference
+    ``LinearTreeLearner::CalculateLinear`` with ``is_refit=true``,
+    ``linear_tree_learner.cpp:326-383``)."""
+    X, y = _make()
+    params = dict(PARAMS, linear_tree=True, linear_lambda=0.01)
+    bst = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=8)
+    X2, y2 = _make(seed=51)
+    ref = bst.refit(X2, y2, decay_rate=0.0)
+    p_old = bst.predict(X2)
+    p_new = ref.predict(X2)
+    assert np.mean((p_new - y2) ** 2) < np.mean((p_old - y2) ** 2) + 1e-9
+    assert not np.allclose(p_old, p_new)
+    # coefficients actually moved, structure did not
+    t0_old, t0_new = bst._gbdt.models[0][0], ref._gbdt.models[0][0]
+    moved = any(len(a) and not np.allclose(a, b)
+                for a, b in zip(t0_old.leaf_coeff, t0_new.leaf_coeff))
+    assert moved
+    np.testing.assert_array_equal(t0_old.split_feature, t0_new.split_feature)
+    # decay_rate=1 keeps the model unchanged
+    same = bst.refit(X2, y2, decay_rate=1.0)
+    np.testing.assert_allclose(same.predict(X2), p_old, rtol=1e-5, atol=1e-6)
+    # refit survives save/load round-trip
+    ref.save_model(str(tmp_path / "lin.txt"))
+    loaded = lgb.Booster(model_file=str(tmp_path / "lin.txt"))
+    np.testing.assert_allclose(loaded.predict(X2), p_new, rtol=1e-5,
+                               atol=1e-6)
+    # and a LOADED linear model can itself be refit
+    ref2 = loaded.refit(X2, y2, decay_rate=0.5)
+    assert not np.allclose(ref2.predict(X2), p_new)
+
+
 def test_refit_loaded_booster(tmp_path):
     X, y = _make()
     bst = lgb.train(PARAMS, lgb.Dataset(X, label=y), num_boost_round=10)

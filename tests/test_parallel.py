@@ -223,6 +223,38 @@ def test_voting_reduces_collective_bytes():
     assert vote_bytes < data_bytes * 0.6, (vote_bytes, data_bytes)
 
 
+def test_voting_composition_fallback(capsys):
+    """Voting-parallel's unsupported knobs warn and fall back to
+    data-parallel instead of silently mis-training (documented deviation:
+    the reference's voting learner composes with its ColSampler)."""
+    n, f = 8 * 256, 12
+    rng = np.random.RandomState(5)
+    X = rng.randn(n, f)
+    y = (X[:, 0] > 0).astype(np.float64)
+    base = {"objective": "binary", "num_leaves": 7, "verbosity": 1,
+            "min_data_in_leaf": 5, "tree_learner": "voting"}
+    for bad in ({"extra_trees": True},
+                {"feature_fraction_bynode": 0.5},
+                {"interaction_constraints": [[0, 1], [2, 3]]},
+                {"cegb_penalty_split": 0.1}):
+        bst = lgb.train(dict(base, **bad), lgb.Dataset(X, label=y), 2)
+        assert bst.num_trees() == 2
+        out = capsys.readouterr()
+        assert "does not compose" in out.out + out.err
+    import json, tempfile, os as _os
+    fd, path = tempfile.mkstemp(suffix=".json")
+    with _os.fdopen(fd, "w") as fh:
+        json.dump({"feature": 0, "threshold": 0.0}, fh)
+    try:
+        bst = lgb.train(dict(base, forcedsplits_filename=path),
+                        lgb.Dataset(X, label=y), 2)
+        assert bst.num_trees() == 2
+        out = capsys.readouterr()
+        assert "forced splits" in out.out + out.err
+    finally:
+        _os.unlink(path)
+
+
 def test_voting_training_quality():
     """Voting-parallel training must track serial quality closely (it is an
     approximation — reference docs call the quality loss negligible)."""
