@@ -123,10 +123,18 @@ def refit_leaf_linear_models(tree, X: np.ndarray, row_leaf: np.ndarray,
     for l in range(nl):
         fl = np.asarray(tree.leaf_features[l], np.int64)
         d = len(fl)
-        if d == 0:
-            continue
         rows = order[bounds[l]: bounds[l + 1]]
         if len(rows) == 0:
+            continue
+        if d == 0:
+            # Constant-only leaf (all coefficients were dropped at fit
+            # time): predict_linear serves leaf_const for it, so the
+            # constant must still be refit — intercept-only solve.
+            g = grad[rows].astype(np.float64)
+            h = hess[rows].astype(np.float64)
+            c = -g.sum() / (h.sum() + linear_lambda)
+            leaf_const[l] = (decay_rate * leaf_const[l]
+                             + (1.0 - decay_rate) * c * shrinkage)
             continue
         Xl = X[rows][:, fl].astype(np.float64)
         ok = ~np.isnan(Xl).any(axis=1)

@@ -1,0 +1,161 @@
+"""CPU-hermetic HLO cost-model regression harness.
+
+The end-to-end TPU number depends on chip availability; these tests pin the
+*compiled program's* cost structure so a perf regression (a per-leaf
+sequential ladder, a duplicated leaf-histogram buffer, an oversized per-wave
+collective, a histogram that silently de-quantizes) fails CI on any
+platform, chip or no chip.
+
+Technique: compile the bench-shaped grower (255 leaves, leaf_batch=16,
+28 features, 256 bins — BASELINE.md's Higgs config) with XLA:CPU and parse
+the optimized HLO text.  The wave while-loop body appears exactly once in
+the HLO regardless of trip count, so per-wave tensor shapes, carry buffers
+and collective volumes are all statically checkable.
+
+Reference perf anchors: docs/Experiments.rst:113 (Higgs speed table) and
+src/treelearner/data_parallel_tree_learner.cpp:284 (one histogram reduce
+per step).
+"""
+
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import lightgbm_tpu.models.grower as G
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.dataset import TrainData
+from lightgbm_tpu.models.gbdt import _split_config
+from lightgbm_tpu.parallel.mesh import DATA_AXIS, make_mesh
+
+# Bench shape (BASELINE.md: Higgs 28 features; bench.py: 255 leaves,
+# leaf_batch 16, 256 bins).  N only has to be big enough to keep every
+# bucket branch alive; the sharded compile needs > _MIN_BUCKET (2048)
+# rows per shard or make_grower falls back to the mask layout.
+N, F, B, L, W = 8192, 28, 256, 255, 16
+N_SHARDED = 8 * 4096
+
+_DTYPE_BYTES = {"pred": 1, "s8": 1, "u8": 1, "u16": 2, "bf16": 2,
+                "f32": 4, "s32": 4, "u32": 4, "f64": 8, "s64": 8, "u64": 8}
+
+
+def _shape_bytes(dtype: str, dims: str) -> int:
+    n = int(np.prod([int(d) for d in dims.split(",") if d])) if dims else 1
+    return _DTYPE_BYTES[dtype] * n
+
+
+def _parse_shapes(txt: str):
+    return re.findall(
+        r"(pred|s8|u8|u16|bf16|f32|s32|u32|f64|s64|u64)\[([0-9,]*)\]", txt)
+
+
+@pytest.fixture(scope="module")
+def hlo():
+    """Compiled HLO of the bench-shaped wave grower: fp32 serial, quantized
+    serial, and fp32 8-way data-parallel."""
+    cfg = Config({"objective": "binary", "verbosity": -1})
+
+    def compile_text(quantized=False, mesh=None):
+        n = N if mesh is None else N_SHARDED
+        rng = np.random.RandomState(0)
+        X = rng.randn(n, F)
+        y = (X[:, 0] > 0).astype(np.float64)
+        td = TrainData.build(X, y, cfg)
+        meta = td.feature_meta_device()
+        gcfg = G.GrowerConfig(num_leaves=L, num_bins=B,
+                              split=_split_config(cfg), leaf_batch=W,
+                              quantized=quantized)
+        grow = G.make_grower(gcfg, mesh=mesh, data_axis=DATA_AXIS)
+        args = [jnp.asarray(td.binned.bins), jnp.zeros(n, jnp.float32),
+                jnp.ones(n, jnp.float32), jnp.ones(n, jnp.float32),
+                jnp.ones(F, bool), meta["num_bins_per_feature"],
+                meta["nan_bins"], meta["is_categorical"], meta["monotone"]]
+        txt = grow.lower(*args).compile().as_text()
+        if mesh is not None:
+            # Guard against the mask-layout fallback silently compiling a
+            # collective-free program (rows/shard must exceed _MIN_BUCKET).
+            assert "all-reduce" in txt
+        return txt
+
+    return {"fp32": compile_text(),
+            "quant": compile_text(quantized=True),
+            "sharded": compile_text(mesh=make_mesh(8, 1))}
+
+
+def _whiles(txt):
+    """Carry-tuple type strings of every while op."""
+    return re.findall(r"= \(([^)]*)\) while\(", txt)
+
+
+def _grow_while(txt, hist_shape):
+    """The growth loop: the while whose carry holds the leaf histogram."""
+    matches = [w for w in _whiles(txt) if hist_shape in w]
+    assert len(matches) == 1, f"expected one grow loop, found {len(matches)}"
+    return matches[0]
+
+
+def test_wave_batches_w_leaves_per_step(hlo):
+    """The wave body histograms W=16 smaller siblings per sequential step:
+    the (W, F, B, 3) batched histogram tensor must exist.  A reintroduced
+    per-leaf ladder (leaf_batch silently ignored) removes this shape and
+    multiplies sequential steps by W."""
+    assert f"f32[{W},{F},{B},3]" in hlo["fp32"]
+    assert f"s32[{W},{F},{B},3]" in hlo["quant"]
+
+
+def test_single_leaf_hist_buffer_in_carry(hlo):
+    """Exactly ONE (L, F, B, 3) histogram buffer lives in the growth loop's
+    carry — a second copy (e.g. an M-packed kernel's staging buffer or a
+    defensive clone) doubles the dominant HBM resident."""
+    hist = f"f32[{L},{F},{B},3]"
+    carry = _grow_while(hlo["fp32"], hist)
+    assert carry.count(hist) == 1, carry.count(hist)
+
+
+def test_growth_carry_bytes_bounded(hlo):
+    """Total growth-loop carry stays within 10% + 4 MB of the leaf_hist
+    buffer itself (leaf_hist dominates by design; everything else is
+    O(N + L*B))."""
+    hist_bytes = L * F * B * 3 * 4
+    carry = _grow_while(hlo["fp32"], f"f32[{L},{F},{B},3]")
+    total = sum(_shape_bytes(d, s) for d, s in _parse_shapes(carry))
+    assert total <= hist_bytes * 1.10 + (4 << 20), (total, hist_bytes)
+
+
+def test_while_op_count_bounded(hlo):
+    """The program stays a handful of loops (grow loop + inner fori-loops
+    + histogram block scans), not an unrolled per-leaf ladder."""
+    assert len(_whiles(hlo["fp32"])) <= 14, len(_whiles(hlo["fp32"]))
+
+
+def test_quantized_hist_stays_integer(hlo):
+    """Quantized training carries the leaf histograms as s32 end to end
+    (reference bin.h:48-81 int histograms); an f32 leaf-hist buffer means
+    something upcast inside the loop."""
+    txt = hlo["quant"]
+    assert f"s32[{L},{F},{B},3]" in txt
+    assert f"f32[{L},{F},{B},3]" not in txt
+
+
+def test_collective_bytes_per_wave(hlo):
+    """Data-parallel moves ONE (W, F, B, 3) histogram all-reduce per wave
+    plus the root histogram and O(W) scalars (reference: one reduce per
+    step, data_parallel_tree_learner.cpp:284).  Reducing the full
+    (L, F, B, 3) leaf_hist — or reducing the wave hist twice — blows this
+    budget by an order of magnitude."""
+    txt = hlo["sharded"]
+    total = 0
+    wave_hist_reduces = 0
+    for m in re.finditer(
+            r"= (pred|s8|u8|u16|bf16|f32|s32|u32|f64)\[([0-9,]*)\][^=]*"
+            r"all-reduce", txt):
+        total += _shape_bytes(m.group(1), m.group(2))
+        if m.group(2) == f"{W},{F},{B},3":
+            wave_hist_reduces += 1
+    wave_bytes = W * F * B * 3 * 4
+    root_bytes = F * B * 3 * 4
+    assert wave_hist_reduces == 1, wave_hist_reduces
+    assert total <= wave_bytes + root_bytes + (256 << 10), (
+        total, wave_bytes + root_bytes)

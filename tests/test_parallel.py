@@ -148,6 +148,50 @@ def test_sharded_perm_grower_matches_serial_exactly():
                                    rtol=1e-4, atol=1e-6)
 
 
+def test_sharded_perm_parity_at_bench_depth():
+    """Same exact-structure parity at bench-like depth: 255 leaves,
+    leaf_batch=16, 100k rows — exercises the sharded-perm bucket ladder
+    deep enough that every bucket branch and the full wave scheduler run
+    (VERDICT r3: the 8-leaf dryrun proves lockstep, not depth)."""
+    import lightgbm_tpu.models.grower as G
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.dataset import TrainData
+    from lightgbm_tpu.models.gbdt import _split_config
+
+    n, f = 8 * 12800, 12                               # 102,400 rows
+    rng = np.random.RandomState(11)
+    X = rng.randn(n, f)
+    y = (X[:, 0] + 0.7 * X[:, 1] * X[:, 2] + np.sin(2 * X[:, 3])
+         + 0.3 * rng.randn(n) > 0)
+    cfg = Config({"objective": "binary", "num_leaves": 255,
+                  "min_data_in_leaf": 20, "verbosity": -1})
+    td = TrainData.build(X, y.astype(np.float64), cfg)
+    meta = td.feature_meta_device()
+    bins = jnp.asarray(td.binned.bins)
+    p = 0.5
+    grad = jnp.asarray((p - y).astype(np.float32))
+    hess = jnp.asarray(np.full(n, p * (1 - p), np.float32))
+    args = (bins, grad, hess, jnp.ones(n, jnp.float32), jnp.ones(f, bool),
+            meta["num_bins_per_feature"], meta["nan_bins"],
+            meta["is_categorical"], meta["monotone"])
+    gcfg = G.GrowerConfig(num_leaves=255, num_bins=td.binned.max_num_bins,
+                          split=_split_config(cfg), leaf_batch=16)
+    tree_s, rl_s = G.make_grower(gcfg)(*args)
+    tree_m, rl_m = G.make_grower(gcfg, mesh=make_mesh(8, 1),
+                                 data_axis=DATA_AXIS)(*args)
+    assert int(tree_s.num_leaves) == int(tree_m.num_leaves) == 255
+    np.testing.assert_array_equal(np.asarray(tree_s.split_feature),
+                                  np.asarray(tree_m.split_feature))
+    np.testing.assert_array_equal(np.asarray(tree_s.split_bin),
+                                  np.asarray(tree_m.split_bin))
+    np.testing.assert_array_equal(np.asarray(tree_s.left_child),
+                                  np.asarray(tree_m.left_child))
+    np.testing.assert_array_equal(np.asarray(rl_s), np.asarray(rl_m))
+    np.testing.assert_allclose(np.asarray(tree_s.leaf_value),
+                               np.asarray(tree_m.leaf_value),
+                               rtol=1e-3, atol=1e-5)
+
+
 def test_sharded_training_metric_parity():
     """End-to-end data-parallel training must match serial at METRIC level
     (reference test_dual.py:37 asserts near-equal evals, not loose corr)."""

@@ -1,0 +1,88 @@
+"""Generate tests/fixtures/bench_auc.json: genuine LightGBM's holdout AUC
+at the (scaled-down) bench config.
+
+The bench trains 255 leaves / lr 0.1 / max_bin 255 / min_sum_hessian 100
+on Higgs-like data (bench.py mirrors docs/Experiments.rst:82-91).  This
+script trains the GENUINE LightGBM CLI (built via
+tools/refbuild/build_reference.sh) on the exact same synthetic data at
+200k rows and records its holdout AUC, so CI can pin our wave-grower
+quality against the reference's at the bench config without the binary
+present (tests/test_wave_grower.py::test_bench_config_auc_parity).
+
+Usage: python tools/gen_bench_auc_fixture.py [path-to-lightgbm-binary]
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import make_higgs_like  # noqa: E402
+
+N_TRAIN, N_VALID, F, ITERS, SEED = 200_000, 50_000, 28, 10, 0
+
+PARAMS = {
+    "objective": "binary",
+    "num_leaves": 255,
+    "learning_rate": 0.1,
+    "max_bin": 255,
+    "min_data_in_leaf": 0,
+    "min_sum_hessian_in_leaf": 100.0,
+    "num_iterations": ITERS,
+    "verbosity": -1,
+}
+
+
+def auc(y, score):
+    order = np.argsort(score)
+    y = np.asarray(y, np.float64)[order]
+    n_pos = y.sum()
+    n_neg = len(y) - n_pos
+    ranks = np.arange(1, len(y) + 1)
+    return (ranks[y == 1].sum() - n_pos * (n_pos + 1) / 2) / (n_pos * n_neg)
+
+
+def main():
+    binary = sys.argv[1] if len(sys.argv) > 1 else "/tmp/lgbbuild2/lightgbm"
+    X, y = make_higgs_like(N_TRAIN + N_VALID, F, seed=SEED)
+    Xt, yt = X[:N_TRAIN], y[:N_TRAIN]
+    Xv, yv = X[N_TRAIN:], y[N_TRAIN:]
+    with tempfile.TemporaryDirectory() as td:
+        np.savetxt(os.path.join(td, "train.csv"),
+                   np.column_stack([yt, Xt]), delimiter=",", fmt="%.7g")
+        np.savetxt(os.path.join(td, "valid.csv"),
+                   np.column_stack([yv, Xv]), delimiter=",", fmt="%.7g")
+        conf = [f"{k}={v}" for k, v in PARAMS.items()]
+        subprocess.run(
+            [binary, "task=train", f"data={td}/train.csv",
+             f"output_model={td}/model.txt", "saved_feature_importance_type=0"]
+            + conf, check=True, capture_output=True)
+        subprocess.run(
+            [binary, "task=predict", f"data={td}/valid.csv",
+             f"input_model={td}/model.txt",
+             f"output_result={td}/preds.txt", "predict_raw_score=true"],
+            check=True, capture_output=True)
+        preds = np.loadtxt(os.path.join(td, "preds.txt"))
+    ref_auc = float(auc(yv, preds))
+    out = {
+        "description": "genuine LightGBM holdout AUC at the scaled bench "
+                       "config (see tools/gen_bench_auc_fixture.py)",
+        "data": {"generator": "bench.make_higgs_like", "seed": SEED,
+                 "n_train": N_TRAIN, "n_valid": N_VALID, "n_features": F},
+        "params": PARAMS,
+        "ref_auc": ref_auc,
+    }
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tests", "fixtures", "bench_auc.json")
+    with open(path, "w") as fh:
+        json.dump(out, fh, indent=1)
+    print("ref_auc:", ref_auc, "->", path)
+
+
+if __name__ == "__main__":
+    main()
