@@ -196,7 +196,10 @@ class Dataset:
                             categorical_feature = cats
             except ImportError:
                 pass
-        self.data = _as_2d(data)
+        # scipy sparse stays sparse all the way into binning (binned
+        # column-wise from CSC, binning._bin_sparse_matrix) — a Bosch-class
+        # 1.2M x 968 CSR must never materialize as ~9 GB of dense f64.
+        self.data = data.tocsr() if _is_scipy_sparse(data) else _as_2d(data)
         self.label = None if label is None else np.asarray(label)
         self.reference = reference
         self.weight = None if weight is None else np.asarray(weight, np.float64)
@@ -243,7 +246,7 @@ class Dataset:
                       if self.reference is not None else None)
             self._train_data = TrainData.build(
                 self.data, self.label if self.label is not None
-                else np.zeros(len(self.data)), cfg,
+                else np.zeros(self.data.shape[0]), cfg,
                 weight=self.weight, group=self.group,
                 position=self.position,
                 init_score=self.init_score,
@@ -311,7 +314,11 @@ class Dataset:
         if self.num_data() != other.num_data():
             raise ValueError("add_features_from needs equal row counts")
         f0 = self.num_feature()
-        self.data = np.concatenate([self.data, other.data], axis=1)
+        if _is_scipy_sparse(self.data) or _is_scipy_sparse(other.data):
+            import scipy.sparse as sp
+            self.data = sp.hstack([self.data, other.data], format="csr")
+        else:
+            self.data = np.concatenate([self.data, other.data], axis=1)
         if isinstance(self.feature_name, list) \
                 or isinstance(other.feature_name, list):
             def _names(ds, base):
@@ -453,7 +460,11 @@ class Booster:
             num_iteration = self.best_iteration
         if start_iteration == 0:
             start_iteration = int(kwargs.pop("start_iteration_predict", 0))
-        data2 = _as_2d(data)
+        # Sparse predict batches stay sparse into host binning (a Bosch-
+        # class CSR must not densify at predict either); only pred_leaf/
+        # pred_contrib and the NaN shape-pad need a dense copy.
+        sparse_in = _is_scipy_sparse(data)
+        data2 = data.tocsr() if sparse_in else _as_2d(data)
         nf = self.num_feature()
         if data2.shape[1] != nf:
             # reference predict_disable_shape_check semantics: extra columns
@@ -465,7 +476,13 @@ class Booster:
                     f"{nf}; pass predict_disable_shape_check=True to "
                     "override (reference LGBM_BoosterPredictForMat check)")
             if data2.shape[1] > nf:
-                data2 = data2[:, :nf]
+                data2 = data2[:, :nf]      # CSR column slice stays sparse
+            elif sparse_in:
+                # only the NaN pad needs a dense copy
+                data2 = np.asarray(data2.todense(), np.float64)
+                sparse_in = False
+                pad = np.full((data2.shape[0], nf - data2.shape[1]), np.nan)
+                data2 = np.concatenate([data2, pad], axis=1)
             else:
                 pad = np.full((data2.shape[0], nf - data2.shape[1]), np.nan)
                 data2 = np.concatenate([data2, pad], axis=1)
@@ -477,10 +494,13 @@ class Booster:
                     "supported yet; save_model() and reload, then predict")
             from .explain import predict_leaf_index, predict_contrib
             fn = predict_leaf_index if pred_leaf else predict_contrib
-            return fn(self._gbdt, _as_2d(data), start_iteration, num_iteration)
+            dense = (np.asarray(data.todense(), np.float64) if sparse_in
+                     else _as_2d(data))
+            return fn(self._gbdt, dense, start_iteration, num_iteration)
         es_kwargs = {kk: vv for kk, vv in kwargs.items()
                      if kk.startswith("pred_early_stop")}
-        return self._gbdt.predict(_as_2d(data), raw_score=raw_score,
+        return self._gbdt.predict(data if sparse_in else _as_2d(data),
+                                  raw_score=raw_score,
                                   num_iteration=num_iteration,
                                   start_iteration=start_iteration,
                                   **es_kwargs)

@@ -212,6 +212,10 @@ def find_bin(
     )
 
 
+def _is_sparse(X) -> bool:
+    return hasattr(X, "tocsc") and hasattr(X, "tocsr")
+
+
 def bin_dataset(
     X: np.ndarray,
     max_bin: int = 255,
@@ -226,15 +230,25 @@ def bin_dataset(
 ) -> "BinnedData":
     """Bin a full feature matrix. Sampling mirrors the reference's
     ``DatasetLoader::SampleTextDataFromFile`` (``dataset_loader.cpp:1022``): bin
-    boundaries come from a row subsample, then the full matrix is discretized."""
-    X = np.asarray(X)
+    boundaries come from a row subsample, then the full matrix is discretized.
+
+    scipy sparse inputs are binned column-wise straight from CSC — peak
+    memory stays O(nnz) + the (N, F) uint8/16 bin matrix, never a dense f64
+    copy (the reference's sparse answer is ``SparseBin``,
+    ``src/io/sparse_bin.hpp:73``; here post-binning storage is dense-narrow
+    + EFB, so only INGESTION needs the sparse-aware path)."""
+    sparse = _is_sparse(X)
+    if not sparse:
+        X = np.asarray(X)
     n, f = X.shape
     if n > sample_cnt:
         rng = np.random.RandomState(random_state)
         idx = rng.choice(n, size=sample_cnt, replace=False)
-        sample = X[idx]
+        sample = X[idx] if not sparse else X.tocsr()[np.sort(idx)]
     else:
         sample = X
+    if sparse:
+        sample = sample.tocsc()
     cat_set = set(int(c) for c in categorical_features)
     if max_bin_by_feature is not None:
         # reference CHECKs length == num features and every value > 1
@@ -245,13 +259,21 @@ def bin_dataset(
         if any(int(v) <= 1 for v in max_bin_by_feature):
             raise ValueError("max_bin_by_feature values must be > 1")
     mappers: List[BinMapper] = []
+    s = sample.shape[0]
     for j in range(f):
         mb = max_bin
         if max_bin_by_feature is not None:
             mb = int(max_bin_by_feature[j])
+        if sparse:
+            nz = np.asarray(sample.data[sample.indptr[j]:
+                                        sample.indptr[j + 1]], np.float64)
+            col = np.zeros(s, np.float64)
+            col[: len(nz)] = nz       # find_bin is order-invariant
+        else:
+            col = sample[:, j]
         mappers.append(
             find_bin(
-                sample[:, j], mb, min_data_in_bin,
+                col, mb, min_data_in_bin,
                 is_categorical=(j in cat_set),
                 use_missing=use_missing, zero_as_missing=zero_as_missing,
             )
@@ -259,29 +281,69 @@ def bin_dataset(
     return BinnedData.from_mappers(X, mappers)
 
 
-def _bin_full_matrix(X: np.ndarray, mappers: List["BinMapper"],
-                     dtype) -> np.ndarray:
+def _bin_sparse_matrix(X, mappers: List["BinMapper"], dtype) -> np.ndarray:
+    """Bin a scipy sparse matrix column-wise without densifying: every
+    column starts at its zero-value bin, then only the nonzeros are
+    discretized and scattered.  Peak extra memory is O(nnz)."""
+    csc = X.tocsc()
+    n, f = csc.shape
+    out = np.empty((n, f), dtype=dtype)
+    zero = np.zeros(1, np.float64)
+    for j, m in enumerate(mappers):
+        out[:, j] = m.value_to_bin(zero)[0]
+        lo, hi = csc.indptr[j], csc.indptr[j + 1]
+        if hi > lo:
+            out[csc.indices[lo:hi], j] = m.value_to_bin(
+                np.asarray(csc.data[lo:hi], np.float64)).astype(dtype)
+    return out
+
+
+def predict_dense_chunks(predict_fn, X, chunk: int = 65536) -> np.ndarray:
+    """Run a dense-only predict over a sparse matrix in row chunks: peak
+    extra memory stays O(chunk * F) instead of the full dense copy (used
+    where raw-value tree traversal genuinely needs dense rows — loaded
+    models, linear trees)."""
+    outs = [np.asarray(predict_fn(
+                np.asarray(X[lo:lo + chunk].todense(), np.float64)),
+                np.float64)
+            for lo in range(0, X.shape[0], chunk)]
+    return np.concatenate(outs, axis=0)
+
+
+def bake_bin_luts(mappers: List["BinMapper"]):
+    """Flatten the numerical mappers into the (ubm, nvb, nnb, zam) arrays
+    ``native.bin_matrix`` consumes.  Single source of the bin-encoding
+    convention — shared by batch binning here and the C API's single-row
+    fast path (capi/bridge.py FastConfig)."""
+    f = len(mappers)
+    max_b = max((len(m.upper_bounds) for m in mappers
+                 if m.upper_bounds is not None), default=1)
+    ubm = np.full((f, max_b), np.inf, np.float64)
+    nvb = np.ones(f, np.int32)
+    nnb = np.full(f, -1, np.int32)
+    zam = np.zeros(f, np.uint8)
+    for j, m in enumerate(mappers):
+        if m.is_categorical or m.upper_bounds is None:
+            continue
+        k = len(m.upper_bounds)
+        ubm[j, :k] = m.upper_bounds
+        nvb[j] = m.num_bins - (1 if m.has_nan_bin else 0) + 1
+        nnb[j] = m.nan_bin if m.has_nan_bin else -1
+        zam[j] = 1 if m.missing_type == MISSING_ZERO else 0
+    return ubm, nvb, nnb, zam
+
+
+def _bin_full_matrix(X, mappers: List["BinMapper"], dtype) -> np.ndarray:
     """Bin every column in one threaded native pass (numerical features);
     categorical columns fall back to the per-feature LUT path."""
+    if _is_sparse(X):
+        return _bin_sparse_matrix(X, mappers, dtype)
+    X = np.asarray(X)
     n, f = X.shape
     any_num = any(not m.is_categorical for m in mappers)
     out = None
     if any_num:
-        max_b = max((len(m.upper_bounds) for m in mappers
-                     if m.upper_bounds is not None), default=1)
-        ubm = np.full((f, max_b), np.inf, np.float64)
-        nvb = np.ones(f, np.int32)
-        nnb = np.full(f, -1, np.int32)
-        zam = np.zeros(f, np.uint8)
-        for j, m in enumerate(mappers):
-            if m.is_categorical or m.upper_bounds is None:
-                continue
-            k = len(m.upper_bounds)
-            ubm[j, :k] = m.upper_bounds
-            nvb[j] = m.num_bins - (1 if m.has_nan_bin else 0) + 1
-            nnb[j] = m.nan_bin if m.has_nan_bin else -1
-            zam[j] = 1 if m.missing_type == MISSING_ZERO else 0
-        nb = native.bin_matrix(X, ubm, nvb, nnb, zam)
+        nb = native.bin_matrix(X, *bake_bin_luts(mappers))
         if nb is not None:
             out = nb.astype(dtype, copy=False)
     if out is None:
@@ -341,9 +403,12 @@ class BinnedData:
     def num_features(self) -> int:
         return self.bins.shape[1]
 
-    def apply(self, X: np.ndarray) -> np.ndarray:
+    def apply(self, X) -> np.ndarray:
         """Bin new data (e.g. a validation set) with the training mappers —
-        reference ``LoadFromFileAlignWithOtherDataset`` (``dataset_loader.cpp:299``)."""
+        reference ``LoadFromFileAlignWithOtherDataset`` (``dataset_loader.cpp:299``).
+        Accepts dense arrays or scipy sparse (binned straight from CSC)."""
+        if _is_sparse(X):
+            return _bin_sparse_matrix(X, self.mappers, self.bins.dtype)
         return _bin_full_matrix(np.asarray(X), self.mappers,
                                 self.bins.dtype)
 

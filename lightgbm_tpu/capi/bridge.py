@@ -67,8 +67,47 @@ def _mat_from_memory(mv, dtype_code: int, nrow: int, ncol: int,
 
 # ------------------------------------------------------------------- Dataset
 class _CApiDataset:
-    def __init__(self, dataset):
-        self.dataset = dataset  # lightgbm_tpu.basic.Dataset
+    def __init__(self, dataset=None):
+        self._dataset = dataset  # lightgbm_tpu.basic.Dataset
+        # Streaming state (reference LGBM_DatasetCreateByReference +
+        # PushRows protocol, c_api.h:162-323): rows accumulate into a
+        # preallocated buffer; the real Dataset materializes lazily on
+        # first non-push access (or at MarkFinished).
+        self.pending = None
+
+    @property
+    def dataset(self):
+        if self._dataset is None and self.pending is not None:
+            self._finish_pending()
+        return self._dataset
+
+    @dataset.setter
+    def dataset(self, ds):
+        self._dataset = ds
+
+    def _finish_pending(self):
+        from ..basic import Dataset
+        p = self.pending
+        if p["data"] is None:
+            raise RuntimeError("no rows pushed before dataset use "
+                               "(LGBM_DatasetPushRows*)")
+        got = p["pushed"]
+        if got != p["n"]:
+            raise RuntimeError(
+                f"streamed dataset expected {p['n']} rows, got {got}")
+        group = None
+        if p["query"] is not None:
+            # per-row query ids -> group sizes (reference
+            # Metadata::SetQuery conversion)
+            q = p["query"]
+            change = np.nonzero(np.diff(q))[0] + 1
+            bounds = np.concatenate([[0], change, [len(q)]])
+            group = np.diff(bounds)
+        self._dataset = Dataset(
+            p["data"], label=p["label"], weight=p["weight"],
+            init_score=p["init_score"], group=group,
+            params=p["params"], reference=p["ref"])
+        self.pending = None
 
 
 def dataset_create_from_mat(mv, dtype_code, nrow, ncol, is_row_major,
@@ -118,6 +157,139 @@ def dataset_create_from_csr(indptr_mv, indptr_type, indices_mv, data_mv,
     ref = reference.dataset if reference is not None else None
     return _CApiDataset(Dataset(X, params=_parse_params(params),
                                 reference=ref))
+
+
+def dataset_create_from_csc(col_ptr_mv, col_ptr_type, indices_mv, data_mv,
+                            dtype_code, ncol_ptr, nelem, num_row, params,
+                            reference):
+    """Reference LGBM_DatasetCreateFromCSC (c_api.h:385): column-compressed
+    input — fed to the sparse-direct binning path (binning.
+    _bin_sparse_matrix), never densified."""
+    import scipy.sparse as sp
+
+    from ..basic import Dataset
+    col_ptr = np.frombuffer(col_ptr_mv, dtype=_NP_DTYPES[col_ptr_type],
+                            count=ncol_ptr)
+    indices = np.frombuffer(indices_mv, dtype=np.int32, count=nelem)
+    data = np.frombuffer(data_mv, dtype=_NP_DTYPES[dtype_code],
+                         count=nelem).astype(np.float64)
+    X = sp.csc_matrix((data, indices, col_ptr),
+                      shape=(num_row, ncol_ptr - 1))
+    ref = reference.dataset if reference is not None else None
+    return _CApiDataset(Dataset(X, params=_parse_params(params),
+                                reference=ref))
+
+
+def dataset_create_by_reference(reference, num_total_row):
+    """Reference LGBM_DatasetCreateByReference (c_api.h:162): an empty
+    dataset aligned with ``reference``, to be filled by PushRows."""
+    w = _CApiDataset()
+    ref = reference.dataset if reference is not None else None
+    w.pending = {
+        "n": int(num_total_row), "data": None, "pushed": 0,
+        "label": None, "weight": None, "init_score": None, "query": None,
+        "params": dict(ref.params) if ref is not None else {},
+        "ref": ref,
+    }
+    return w
+
+
+def _push_target(handle, ncol=None):
+    p = handle.pending
+    if p is None:
+        raise RuntimeError("PushRows on a non-streaming dataset (create it "
+                           "with LGBM_DatasetCreateByReference)")
+    if p["data"] is None:
+        if ncol is None:
+            if p["ref"] is None:
+                raise RuntimeError("CSR metadata push needs a reference "
+                                   "dataset or a prior push to fix ncol")
+            ncol = p["ref"].num_feature()
+        p["data"] = np.zeros((p["n"], ncol), np.float64)
+    if ncol is not None and p["data"].shape[1] != ncol:
+        raise ValueError(f"pushed ncol {ncol} != {p['data'].shape[1]}")
+    return p
+
+
+def _push_metadata(p, start_row, nrow, label_mv, weight_mv, init_score_mv,
+                   query_mv):
+    if label_mv is not None:
+        if p["label"] is None:
+            p["label"] = np.zeros(p["n"], np.float32)
+        p["label"][start_row:start_row + nrow] = np.frombuffer(
+            label_mv, np.float32, count=nrow)
+    if weight_mv is not None:
+        if p["weight"] is None:
+            p["weight"] = np.zeros(p["n"], np.float32)
+        p["weight"][start_row:start_row + nrow] = np.frombuffer(
+            weight_mv, np.float32, count=nrow)
+    if init_score_mv is not None:
+        if p["init_score"] is None:
+            p["init_score"] = np.zeros(p["n"], np.float64)
+        p["init_score"][start_row:start_row + nrow] = np.frombuffer(
+            init_score_mv, np.float64, count=nrow)
+    if query_mv is not None:
+        if p["query"] is None:
+            p["query"] = np.zeros(p["n"], np.int32)
+        p["query"][start_row:start_row + nrow] = np.frombuffer(
+            query_mv, np.int32, count=nrow)
+
+
+def dataset_push_rows(handle, mv, dtype_code, nrow, ncol, start_row,
+                      label_mv=None, weight_mv=None, init_score_mv=None,
+                      query_mv=None):
+    """LGBM_DatasetPushRows / ...WithMetadata (c_api.h:212,239)."""
+    p = _push_target(handle, ncol)
+    p["data"][start_row:start_row + nrow] = _mat_from_memory(
+        mv, dtype_code, nrow, ncol, 1)
+    _push_metadata(p, start_row, nrow, label_mv, weight_mv, init_score_mv,
+                   query_mv)
+    p["pushed"] += nrow
+
+
+def dataset_push_rows_by_csr(handle, indptr_mv, indptr_type, indices_mv,
+                             data_mv, dtype_code, nindptr, nelem, num_col,
+                             start_row, label_mv=None, weight_mv=None,
+                             init_score_mv=None, query_mv=None):
+    """LGBM_DatasetPushRowsByCSR / ...WithMetadata (c_api.h:265,294)."""
+    p = _push_target(handle, int(num_col))
+    nrow = nindptr - 1
+    block = _csr_to_dense(indptr_mv, indptr_type, indices_mv, data_mv,
+                          dtype_code, nindptr, nelem, num_col)
+    p["data"][start_row:start_row + nrow] = block
+    _push_metadata(p, start_row, nrow, label_mv, weight_mv, init_score_mv,
+                   query_mv)
+    p["pushed"] += nrow
+
+
+def dataset_push_rows_by_csr_meta(handle, indptr_mv, indptr_type,
+                                  indices_mv, data_mv, dtype_code, nindptr,
+                                  nelem, start_row, label_mv=None,
+                                  weight_mv=None, init_score_mv=None,
+                                  query_mv=None):
+    """LGBM_DatasetPushRowsByCSRWithMetadata (c_api.h:294): num_col comes
+    from the reference dataset / prior pushes."""
+    p = _push_target(handle)
+    num_col = p["data"].shape[1]
+    nrow = nindptr - 1
+    block = _csr_to_dense(indptr_mv, indptr_type, indices_mv, data_mv,
+                          dtype_code, nindptr, nelem, num_col)
+    p["data"][start_row:start_row + nrow] = block
+    _push_metadata(p, start_row, nrow, label_mv, weight_mv, init_score_mv,
+                   query_mv)
+    p["pushed"] += nrow
+
+
+def dataset_set_wait_for_manual_finish(handle, wait):
+    """Accepted no-op: finalization here is lazy on first dataset access,
+    so there is no auto-finish to suppress — MarkFinished simply forces it
+    eagerly.  (Reference uses the flag to gate its push-count auto-finish,
+    c_api.cpp DatasetSetWaitForManualFinish.)"""
+
+
+def dataset_mark_finished(handle):
+    if handle.pending is not None:
+        handle._finish_pending()
 
 
 def dataset_set_feature_names(handle, names):
@@ -302,6 +474,129 @@ def _predict_dispatch(handle, X, predict_type, start_iteration,
         out = handle.bst.predict(X, **kw)
     out = np.ascontiguousarray(out, np.float64)
     return out.tobytes(), out.size
+
+
+class _CApiFastConfig:
+    """Reference FastConfig (c_api.cpp FastConfigHandle, c_api.h:1332):
+    bind booster + predict params once so the per-row call skips parameter
+    parsing, shape checks and pipeline re-setup.  The per-call path is:
+    one native bin_matrix call on the (1, F) row + one native tree
+    traversal per class — no jax, no Dataset, no Python-level loops."""
+
+    def __init__(self, handle, predict_type, start_iteration, num_iteration,
+                 dtype_code, ncol, params):
+        self.dtype = _NP_DTYPES[dtype_code]
+        self.dtype_size_bytes = int(np.dtype(self.dtype).itemsize)
+        self.ncol = int(ncol)
+        self.predict_type = predict_type
+        bst = handle.bst
+        self.raw_only = predict_type == C_API_PREDICT_RAW_SCORE
+        gbdt = bst._gbdt
+        num_iteration = None if num_iteration <= 0 else num_iteration
+        self._fallback = None
+        # Honor the bound parameter string exactly like the batch path
+        # (_predict_dispatch): early-stop requests route to the host
+        # mirror, which implements margin-based exit.
+        coerce = {"pred_early_stop": _str2bool,
+                  "pred_early_stop_freq": int,
+                  "pred_early_stop_margin": float}
+        self._es_kwargs = {k: coerce[k](v)
+                           for k, v in _parse_params(params).items()
+                           if k in coerce}
+        use_es = bool(self._es_kwargs.get("pred_early_stop"))
+        td = getattr(gbdt, "train_data", None)
+        from .. import native
+        if (td is not None and native.available() and not use_es
+                and predict_type in (0, C_API_PREDICT_RAW_SCORE)
+                and not gbdt.cfg.linear_tree
+                and getattr(gbdt, "base_model", None) is None):
+            self.binned = td.binned
+            nan_bins = np.asarray(td.binned.nan_bins)
+            self.k = gbdt.num_class
+            # pre-marshal the tree packs ONCE (re-flattening per call is
+            # what the reference's FastConfig exists to avoid)
+            self.predictors = []
+            for kk in range(self.k):
+                trees = gbdt.models[kk]
+                end = (len(trees) if num_iteration is None
+                       else min(len(trees), start_iteration + num_iteration))
+                self.predictors.append(native.make_bins_predictor(
+                    trees[start_iteration:end], nan_bins))
+            self.init_scores = np.asarray(gbdt.init_scores, np.float64)
+            # pre-bake the numerical bin LUTs so per-row binning is one
+            # native call, not a per-mapper Python loop
+            mappers = td.binned.mappers
+            if any(m.is_categorical for m in mappers):
+                self._bin_row = lambda row: self.binned.apply(row)
+            else:
+                from ..binning import bake_bin_luts
+                luts = bake_bin_luts(mappers)
+                self._bin_row = lambda row: native.bin_matrix(row, *luts)
+            # Host-numpy output transform — the per-row path must stay
+            # jax-free (a device dispatch per serving call would dominate
+            # the <1ms budget).  Formulas mirror the objectives'
+            # convert_output.
+            name = gbdt.cfg.objective
+            sig = float(getattr(gbdt.cfg, "sigmoid", 1.0))
+            if self.raw_only:
+                self.transform = None
+            elif name == "binary":
+                self.transform = lambda s: 1.0 / (1.0 + np.exp(-sig * s))
+            elif name in ("poisson", "gamma", "tweedie"):
+                self.transform = np.exp
+            elif name in ("multiclass", "softmax"):
+                def _softmax(s):
+                    e = np.exp(s - s.max())
+                    return e / e.sum()
+                self.transform = _softmax
+            elif name == "multiclassova":
+                self.transform = lambda s: 1.0 / (1.0 + np.exp(-sig * s))
+            elif name == "regression" and gbdt.cfg.reg_sqrt:
+                self.transform = lambda s: np.sign(s) * s * s
+            elif gbdt.objective is not None:
+                obj = gbdt.objective
+                self.transform = lambda s: np.asarray(
+                    obj.convert_output(s), np.float64).reshape(-1)
+            else:
+                self.transform = None
+        else:
+            # loaded/linear/continuation/early-stop boosters: bound host
+            # predict with the parsed parameter string applied
+            self._fallback = (bst, dict(
+                raw_score=self.raw_only,
+                pred_leaf=predict_type == C_API_PREDICT_LEAF_INDEX,
+                pred_contrib=predict_type == C_API_PREDICT_CONTRIB,
+                start_iteration=start_iteration,
+                num_iteration=num_iteration, **self._es_kwargs))
+
+    def predict_row(self, mv):
+        row = np.frombuffer(mv, dtype=self.dtype,
+                            count=self.ncol).reshape(1, -1)
+        if self._fallback is not None:
+            bst, kw = self._fallback
+            out = np.ascontiguousarray(bst.predict(row, **kw), np.float64)
+            return out.tobytes(), out.size
+        bins = self._bin_row(row.astype(np.float64, copy=False))
+        out = np.empty(self.k, np.float64)
+        buf = np.zeros(1, np.float64)
+        for kk in range(self.k):
+            buf[0] = 0.0
+            if self.predictors[kk] is not None:
+                self.predictors[kk](bins, buf)
+            out[kk] = buf[0] + self.init_scores[kk]
+        if self.transform is not None:
+            out = np.asarray(self.transform(out), np.float64).reshape(-1)
+        return out.tobytes(), out.size
+
+
+def booster_predict_fast_init(handle, predict_type, start_iteration,
+                              num_iteration, dtype_code, ncol, params):
+    return _CApiFastConfig(handle, predict_type, start_iteration,
+                           num_iteration, dtype_code, ncol, params)
+
+
+def booster_predict_fast(fast, mv):
+    return fast.predict_row(mv)
 
 
 def booster_predict_for_file(handle, data_filename, data_has_header,

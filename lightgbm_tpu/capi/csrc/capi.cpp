@@ -656,6 +656,225 @@ int LGBM_BoosterFeatureImportance(BoosterHandle handle, int num_iteration,
   return 0;
 }
 
+// --------------------------------------------------- streaming push + CSC
+// (reference c_api.h:162-385: CreateByReference + PushRows* protocol)
+
+static PyObject* mv_or_none(const void* p, Py_ssize_t bytes) {
+  if (p == nullptr) Py_RETURN_NONE;
+  return mv_from(p, bytes);
+}
+
+int LGBM_DatasetCreateFromCSC(const void* col_ptr, int col_ptr_type,
+                              const int32_t* indices, const void* data,
+                              int data_type, int64_t ncol_ptr, int64_t nelem,
+                              int64_t num_row, const char* parameters,
+                              DatasetHandle reference, DatasetHandle* out) {
+  Gil g;
+  if (!g.ok) return -1;
+  PyObject* ref = reference != nullptr
+                      ? reinterpret_cast<PyObject*>(reference)
+                      : Py_None;
+  Py_INCREF(ref);
+  PyObject* r = bridge_call(
+      "dataset_create_from_csc",
+      Py_BuildValue(
+          "(NiNNiLLLsN)",
+          mv_from(col_ptr, ncol_ptr * dtype_size(col_ptr_type)), col_ptr_type,
+          mv_from(indices, nelem * 4),
+          mv_from(data, nelem * dtype_size(data_type)), data_type,
+          static_cast<long long>(ncol_ptr), static_cast<long long>(nelem),
+          static_cast<long long>(num_row),
+          parameters != nullptr ? parameters : "", ref));
+  if (r == nullptr) return -1;
+  *out = r;
+  return 0;
+}
+
+int LGBM_DatasetCreateByReference(const DatasetHandle reference,
+                                  int64_t num_total_row,
+                                  DatasetHandle* out) {
+  Gil g;
+  if (!g.ok) return -1;
+  PyObject* ref = reference != nullptr
+                      ? reinterpret_cast<PyObject*>(reference)
+                      : Py_None;
+  Py_INCREF(ref);
+  PyObject* r = bridge_call(
+      "dataset_create_by_reference",
+      Py_BuildValue("(NL)", ref, static_cast<long long>(num_total_row)));
+  if (r == nullptr) return -1;
+  *out = r;
+  return 0;
+}
+
+int LGBM_DatasetPushRows(DatasetHandle dataset, const void* data,
+                         int data_type, int32_t nrow, int32_t ncol,
+                         int32_t start_row) {
+  Gil g;
+  if (!g.ok) return -1;
+  PyObject* r = bridge_call(
+      "dataset_push_rows",
+      Py_BuildValue("(ONiiii)", reinterpret_cast<PyObject*>(dataset),
+                    mv_from(data, static_cast<Py_ssize_t>(nrow) * ncol *
+                                      dtype_size(data_type)),
+                    data_type, nrow, ncol, start_row));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_DatasetPushRowsWithMetadata(DatasetHandle dataset, const void* data,
+                                     int data_type, int32_t nrow,
+                                     int32_t ncol, int32_t start_row,
+                                     const float* label, const float* weight,
+                                     const double* init_score,
+                                     const int32_t* query, int32_t tid) {
+  (void)tid;  // single-writer bridge; the reference uses it for OMP slots
+  Gil g;
+  if (!g.ok) return -1;
+  PyObject* r = bridge_call(
+      "dataset_push_rows",
+      Py_BuildValue("(ONiiiiNNNN)", reinterpret_cast<PyObject*>(dataset),
+                    mv_from(data, static_cast<Py_ssize_t>(nrow) * ncol *
+                                      dtype_size(data_type)),
+                    data_type, nrow, ncol, start_row,
+                    mv_or_none(label, static_cast<Py_ssize_t>(nrow) * 4),
+                    mv_or_none(weight, static_cast<Py_ssize_t>(nrow) * 4),
+                    mv_or_none(init_score,
+                               static_cast<Py_ssize_t>(nrow) * 8),
+                    mv_or_none(query, static_cast<Py_ssize_t>(nrow) * 4)));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_DatasetPushRowsByCSR(DatasetHandle dataset, const void* indptr,
+                              int indptr_type, const int32_t* indices,
+                              const void* data, int data_type,
+                              int64_t nindptr, int64_t nelem, int64_t num_col,
+                              int64_t start_row) {
+  Gil g;
+  if (!g.ok) return -1;
+  PyObject* r = bridge_call(
+      "dataset_push_rows_by_csr",
+      Py_BuildValue(
+          "(ONiNNiLLLL)", reinterpret_cast<PyObject*>(dataset),
+          mv_from(indptr, nindptr * dtype_size(indptr_type)), indptr_type,
+          mv_from(indices, nelem * 4),
+          mv_from(data, nelem * dtype_size(data_type)), data_type,
+          static_cast<long long>(nindptr), static_cast<long long>(nelem),
+          static_cast<long long>(num_col),
+          static_cast<long long>(start_row)));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_DatasetPushRowsByCSRWithMetadata(
+    DatasetHandle dataset, const void* indptr, int indptr_type,
+    const int32_t* indices, const void* data, int data_type, int64_t nindptr,
+    int64_t nelem, int64_t start_row, const float* label, const float* weight,
+    const double* init_score, const int32_t* query, int32_t tid) {
+  (void)tid;
+  Gil g;
+  if (!g.ok) return -1;
+  // num_col is carried by the pending buffer (allocated by a prior push or
+  // CreateByReference's reference dataset) — reference drops it here too.
+  long long nrow = static_cast<long long>(nindptr) - 1;
+  PyObject* r = bridge_call(
+      "dataset_push_rows_by_csr_meta",
+      Py_BuildValue(
+          "(ONiNNiLLLNNNN)", reinterpret_cast<PyObject*>(dataset),
+          mv_from(indptr, nindptr * dtype_size(indptr_type)), indptr_type,
+          mv_from(indices, nelem * 4),
+          mv_from(data, nelem * dtype_size(data_type)), data_type,
+          static_cast<long long>(nindptr), static_cast<long long>(nelem),
+          static_cast<long long>(start_row),
+          mv_or_none(label, static_cast<Py_ssize_t>(nrow) * 4),
+          mv_or_none(weight, static_cast<Py_ssize_t>(nrow) * 4),
+          mv_or_none(init_score, static_cast<Py_ssize_t>(nrow) * 8),
+          mv_or_none(query, static_cast<Py_ssize_t>(nrow) * 4)));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_DatasetSetWaitForManualFinish(DatasetHandle dataset, int wait) {
+  Gil g;
+  if (!g.ok) return -1;
+  PyObject* r = bridge_call(
+      "dataset_set_wait_for_manual_finish",
+      Py_BuildValue("(Oi)", reinterpret_cast<PyObject*>(dataset), wait));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_DatasetMarkFinished(DatasetHandle dataset) {
+  Gil g;
+  if (!g.ok) return -1;
+  PyObject* r = bridge_call(
+      "dataset_mark_finished",
+      Py_BuildValue("(O)", reinterpret_cast<PyObject*>(dataset)));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+// ------------------------------------------------- single-row fast predict
+// (reference FastConfig, c_api.h:1332-1385)
+
+typedef void* FastConfigHandle;
+
+int LGBM_BoosterPredictForMatSingleRowFastInit(
+    BoosterHandle handle, const int predict_type, const int start_iteration,
+    const int num_iteration, const int data_type, const int32_t ncol,
+    const char* parameter, FastConfigHandle* out_fastConfig) {
+  Gil g;
+  if (!g.ok) return -1;
+  PyObject* r = bridge_call(
+      "booster_predict_fast_init",
+      Py_BuildValue("(Oiiiiis)", reinterpret_cast<PyObject*>(handle),
+                    predict_type, start_iteration, num_iteration, data_type,
+                    ncol, parameter != nullptr ? parameter : ""));
+  if (r == nullptr) return -1;
+  *out_fastConfig = r;
+  return 0;
+}
+
+int LGBM_BoosterPredictForMatSingleRowFast(FastConfigHandle fastConfig_handle,
+                                           const void* data, int64_t* out_len,
+                                           double* out_result) {
+  Gil g;
+  if (!g.ok) return -1;
+  PyObject* fast = reinterpret_cast<PyObject*>(fastConfig_handle);
+  PyObject* ncol = PyObject_GetAttrString(fast, "ncol");
+  PyObject* dt = PyObject_GetAttrString(fast, "dtype_size_bytes");
+  Py_ssize_t bytes = PyLong_AsSsize_t(ncol) * PyLong_AsSsize_t(dt);
+  Py_DECREF(ncol);
+  Py_DECREF(dt);
+  PyObject* r = bridge_call(
+      "booster_predict_fast",
+      Py_BuildValue("(ON)", fast, mv_from(data, bytes)));
+  if (r == nullptr) return -1;
+  PyObject* raw = PyTuple_GetItem(r, 0);
+  int64_t n = PyLong_AsLongLong(PyTuple_GetItem(r, 1));
+  *out_len = n;
+  char* buf = PyBytes_AsString(raw);
+  if (buf != nullptr && out_result != nullptr) {
+    std::memcpy(out_result, buf, static_cast<size_t>(n) * sizeof(double));
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_FastConfigFree(FastConfigHandle fastConfig) {
+  Gil g;
+  if (!g.ok) return -1;
+  Py_XDECREF(reinterpret_cast<PyObject*>(fastConfig));
+  return 0;
+}
+
 int LGBM_CAPIVersion() { return 1; }
 
 }  // extern "C"

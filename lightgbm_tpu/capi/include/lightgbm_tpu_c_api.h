@@ -40,6 +40,34 @@ int LGBM_DatasetCreateFromCSR(const void* indptr, int indptr_type,
                               int data_type, int64_t nindptr, int64_t nelem,
                               int64_t num_col, const char* parameters,
                               DatasetHandle reference, DatasetHandle* out);
+int LGBM_DatasetCreateFromCSC(const void* col_ptr, int col_ptr_type,
+                              const int32_t* indices, const void* data,
+                              int data_type, int64_t ncol_ptr, int64_t nelem,
+                              int64_t num_row, const char* parameters,
+                              DatasetHandle reference, DatasetHandle* out);
+int LGBM_DatasetCreateByReference(const DatasetHandle reference,
+                                  int64_t num_total_row, DatasetHandle* out);
+int LGBM_DatasetPushRows(DatasetHandle dataset, const void* data,
+                         int data_type, int32_t nrow, int32_t ncol,
+                         int32_t start_row);
+int LGBM_DatasetPushRowsWithMetadata(DatasetHandle dataset, const void* data,
+                                     int data_type, int32_t nrow,
+                                     int32_t ncol, int32_t start_row,
+                                     const float* label, const float* weight,
+                                     const double* init_score,
+                                     const int32_t* query, int32_t tid);
+int LGBM_DatasetPushRowsByCSR(DatasetHandle dataset, const void* indptr,
+                              int indptr_type, const int32_t* indices,
+                              const void* data, int data_type,
+                              int64_t nindptr, int64_t nelem, int64_t num_col,
+                              int64_t start_row);
+int LGBM_DatasetPushRowsByCSRWithMetadata(
+    DatasetHandle dataset, const void* indptr, int indptr_type,
+    const int32_t* indices, const void* data, int data_type, int64_t nindptr,
+    int64_t nelem, int64_t start_row, const float* label, const float* weight,
+    const double* init_score, const int32_t* query, int32_t tid);
+int LGBM_DatasetSetWaitForManualFinish(DatasetHandle dataset, int wait);
+int LGBM_DatasetMarkFinished(DatasetHandle dataset);
 int LGBM_DatasetSetField(DatasetHandle handle, const char* field_name,
                          const void* field_data, int num_element, int type);
 int LGBM_DatasetSetFeatureNames(DatasetHandle handle,
@@ -89,6 +117,15 @@ int LGBM_BoosterPredictForMat(BoosterHandle handle, const void* data,
                               int start_iteration, int num_iteration,
                               const char* parameter, int64_t* out_len,
                               double* out_result);
+typedef void* FastConfigHandle;
+int LGBM_BoosterPredictForMatSingleRowFastInit(
+    BoosterHandle handle, const int predict_type, const int start_iteration,
+    const int num_iteration, const int data_type, const int32_t ncol,
+    const char* parameter, FastConfigHandle* out_fastConfig);
+int LGBM_BoosterPredictForMatSingleRowFast(FastConfigHandle fastConfig_handle,
+                                           const void* data, int64_t* out_len,
+                                           double* out_result);
+int LGBM_FastConfigFree(FastConfigHandle fastConfig);
 int LGBM_BoosterPredictForFile(BoosterHandle handle, const char* data_filename,
                                int data_has_header, int predict_type,
                                int start_iteration, int num_iteration,

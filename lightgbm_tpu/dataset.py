@@ -60,7 +60,9 @@ class TrainData:
         feature_names: Optional[List[str]] = None,
         reference: Optional["TrainData"] = None,
     ) -> "TrainData":
-        X = np.asarray(X)
+        from .binning import _is_sparse
+        if not _is_sparse(X):
+            X = np.asarray(X)
         if reference is not None:
             binned = dataclasses.replace(
                 reference.binned, bins=reference.binned.apply(X))
@@ -90,8 +92,11 @@ class TrainData:
             init_score=None if init_score is None else np.asarray(init_score),
             feature_names=feature_names,
             monotone_constraints=mono,
-            # Reference keeps raw data when linear_tree=true (Dataset raw_data_)
-            raw=np.asarray(X, np.float64) if cfg.linear_tree else None,
+            # Reference keeps raw data when linear_tree=true (Dataset
+            # raw_data_); sparse raw must densify for the per-leaf solves.
+            raw=(None if not cfg.linear_tree
+                 else np.asarray(X.todense() if _is_sparse(X) else X,
+                                 np.float64)),
         )
 
     @property

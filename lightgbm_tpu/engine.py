@@ -74,7 +74,11 @@ def train(
             # Work on a shallow copy: the caller's Dataset must keep its own
             # init_score (re-running train() on it would otherwise compound).
             out = copy.copy(ds)
-            pred = np.asarray(base.predict_raw(ds.data), np.float64)
+            from .binning import _is_sparse, predict_dense_chunks
+            if _is_sparse(ds.data):
+                pred = predict_dense_chunks(base.predict_raw, ds.data)
+            else:
+                pred = np.asarray(base.predict_raw(ds.data), np.float64)
             if ds.init_score is not None:
                 pred = pred + np.asarray(ds.init_score,
                                          np.float64).reshape(pred.shape)

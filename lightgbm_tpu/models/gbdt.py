@@ -135,13 +135,32 @@ class GBDT:
         from ..parallel.mesh import mesh_for_tree_learner, shard_arrays
         self.mesh = mesh_for_tree_learner(cfg.tree_learner)
         self.feature_sampler = FeatureSampler(cfg, train.num_features)
-        if (train.monotone_constraints is not None
-                and np.any(train.monotone_constraints != 0)
-                and cfg.monotone_constraints_method not in ("basic",)):
+        from ..utils.log import Log as _Log
+        has_mono = (train.monotone_constraints is not None
+                    and np.any(train.monotone_constraints != 0))
+        mono_method = cfg.monotone_constraints_method
+        if has_mono and mono_method not in ("basic", "intermediate",
+                                            "advanced"):
             raise ValueError(
-                f"monotone_constraints_method="
-                f"{cfg.monotone_constraints_method} is not supported; only "
-                f"'basic' (with monotone_penalty) is implemented")
+                f"unknown monotone_constraints_method={mono_method}; "
+                "expected basic, intermediate or advanced")
+        if has_mono and mono_method == "advanced":
+            # Reference advanced mode adds per-threshold constraint slices
+            # (AdvancedLeafConstraints, monotone_constraints.hpp:583) on
+            # top of intermediate; the per-leaf machinery here is the
+            # intermediate one, which is its superset-accuracy baseline.
+            _Log.warning(
+                "monotone_constraints_method=advanced: per-threshold "
+                "constraint slicing is not implemented; using the "
+                "intermediate per-leaf recomputation (its baseline)")
+            mono_method = "intermediate"
+        self._mono_intermediate = has_mono and mono_method == "intermediate"
+        if self._mono_intermediate and (cfg.extra_trees
+                                        or cfg.feature_fraction_bynode < 1.0):
+            raise ValueError(
+                "monotone_constraints_method=intermediate does not compose "
+                "with extra_trees / feature_fraction_bynode; use "
+                "monotone_constraints_method=basic")
         # Storage-layout knobs with no TPU analog: two-pass text loading has
         # no dense-HBM equivalent, and is_enable_sparse is subsumed by EFB
         # (enable_bundle), which covers the sparse-column win here — say so
@@ -233,6 +252,16 @@ class GBDT:
         if self.bundles is not None:
             Log.info(f"EFB: bundled {train.num_features} features into "
                      f"{self.bundles.num_groups} columns")
+        if self._mono_intermediate and leaf_batch > 1:
+            Log.warning("monotone_constraints_method=intermediate requires "
+                        "sequential leaf-wise growth; disabling wave "
+                        "batching (tpu_leaf_batch=1)")
+            leaf_batch = 1
+        if self._mono_intermediate and voting:
+            Log.warning("tree_learner=voting does not compose with "
+                        "monotone_constraints_method=intermediate; falling "
+                        "back to data-parallel")
+            voting = False
         self.grower_cfg = GrowerConfig(
             num_leaves=cfg.num_leaves,
             max_depth=cfg.max_depth,
@@ -254,6 +283,7 @@ class GBDT:
             voting=voting,
             vote_top_k=cfg.top_k,
             bundled=self.bundles is not None,
+            mono_intermediate=self._mono_intermediate,
         )
         self._quant_key = (jax.random.PRNGKey(cfg.seed)
                            if cfg.use_quantized_grad else None)
@@ -678,14 +708,22 @@ class GBDT:
         model: a continuation base model's trees come first (reference
         ``GBDT::GetPredictAt`` over the full ensemble), then this booster's."""
         if self.base_model is not None:
+            from ..binning import _is_sparse
             nb = self.base_model.iter_
             end = (None if num_iteration is None
                    else start_iteration + num_iteration)
             b_start = min(start_iteration, nb)
             b_num = (nb if end is None else max(min(end, nb), b_start)) - b_start
-            base = self.base_model.predict_raw(
-                np.asarray(X, np.float64), num_iteration=b_num,
-                start_iteration=b_start)
+            if _is_sparse(X):
+                from ..binning import predict_dense_chunks
+                base = predict_dense_chunks(
+                    lambda Xd: self.base_model.predict_raw(
+                        Xd, num_iteration=b_num, start_iteration=b_start),
+                    X)
+            else:
+                base = self.base_model.predict_raw(
+                    np.asarray(X, np.float64), num_iteration=b_num,
+                    start_iteration=b_start)
             own_start = max(start_iteration - nb, 0)
             own_num = (None if end is None
                        else max(end - nb - own_start, 0))
@@ -699,8 +737,16 @@ class GBDT:
         batch traversal (small batches; no device round-trip) or the device
         ensemble scan (large batches)."""
         from .. import native
+        from ..binning import _is_sparse, predict_dense_chunks
 
-        X = np.asarray(X)
+        if _is_sparse(X):
+            if self.cfg.linear_tree:
+                # linear leaves need raw values; densify in row chunks
+                return predict_dense_chunks(
+                    lambda Xd: self._predict_raw_linear(
+                        Xd, num_iteration, start_iteration), X)
+        else:
+            X = np.asarray(X)
         if self.cfg.linear_tree:
             return self._predict_raw_linear(X, num_iteration, start_iteration)
         host_bins = self.train_data.binned.apply(X)
@@ -762,7 +808,10 @@ class GBDT:
             # (reference Predictor + prediction_early_stop.cpp); the
             # serialized mirror is cached and rebuilt only when trees were
             # added/removed since.
+            from ..binning import _is_sparse
             from ..serialization import load_model_string, model_to_string
+            if _is_sparse(X):
+                X = np.asarray(X.todense(), np.float64)
             cache = getattr(self, "_loaded_mirror", None)
             if cache is None or cache[0] != self.num_trees:
                 cache = (self.num_trees,

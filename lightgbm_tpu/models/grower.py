@@ -110,6 +110,17 @@ class GrowerConfig:
     # applied before gain-driven growth; indices refer into this tuple,
     # -1 = no forced child.
     forced_splits: Optional[Tuple[Tuple[int, int, int, int], ...]] = None
+    # Intermediate monotone mode (reference IntermediateLeafConstraints,
+    # monotone_constraints.hpp:516): per-leaf output bounds are recomputed
+    # every step from the CURRENT outputs of leaves adjacent in feature
+    # space (one vectorized O(L^2 F) rectangle-adjacency pass — the
+    # TPU-shaped equivalent of the reference's recursive
+    # GoUpToFindLeavesToUpdate tree walk), and every leaf's stored best
+    # split is refreshed against the new bounds from its resident
+    # histogram (the reference's RecomputeBestSplitForLeaf).  Sequential
+    # growth only (leaf_batch=1): simultaneous wave splits of adjacent
+    # leaves could violate each other's freshly-derived bounds.
+    mono_intermediate: bool = False
 
 
 class TreeArrays(NamedTuple):
@@ -167,6 +178,8 @@ class _GrowState(NamedTuple):
     leaf_path: jnp.ndarray       # (L, F) bool — features on each leaf's path
     rng: jnp.ndarray             # (2,) u32 PRNG key (extra_trees / bynode)
     forced_leaf: jnp.ndarray     # (K,) i32 leaf of each pending forced split
+    leaf_bin_lo: jnp.ndarray     # (L, F) i32 bin-rectangle bounds, or (1, 1)
+    leaf_bin_hi: jnp.ndarray     #   dummies when mono_intermediate is off
     tree: TreeArrays
 
 
@@ -355,6 +368,17 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
         raise ValueError(
             "forced splits require leaf_batch=1 and are not supported with "
             "voting-parallel (the wave scheduler would reorder them)")
+    inter = cfg.mono_intermediate and cfg.split.has_monotone
+    if inter and (cfg.leaf_batch > 1 or cfg.voting):
+        raise ValueError(
+            "monotone_constraints_method=intermediate requires sequential "
+            "growth (leaf_batch=1, non-voting): simultaneous splits of "
+            "adjacent leaves could violate each other's fresh bounds")
+    if inter and need_key:
+        raise ValueError(
+            "monotone_constraints_method=intermediate does not compose with "
+            "extra_trees / feature_fraction_bynode (the per-step best-split "
+            "refresh would re-draw their per-node randomness)")
     if cfg.voting and (use_rand or use_bynode or use_groups
                        or cfg.split.use_cegb):
         raise ValueError(
@@ -502,6 +526,9 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
             rng=(key if key is not None
                  else jnp.zeros(2, jnp.uint32)),
             forced_leaf=jnp.zeros(max(n_forced, 1), jnp.int32),
+            leaf_bin_lo=jnp.zeros((L, f) if inter else (1, 1), jnp.int32),
+            leaf_bin_hi=(jnp.full((L, f), B, jnp.int32) if inter
+                         else jnp.ones((1, 1), jnp.int32)),
             tree=tree,
         )
 
@@ -557,24 +584,55 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
         depth2 = jnp.stack([st.leaf_depth[leaf] + 1,
                             st.leaf_depth[leaf] + 1])
         if cfg.split.has_monotone:
-            # Basic monotone bounds (reference BasicLeafConstraints::Update,
-            # monotone_constraints.hpp:487): a numerical split on a monotone
-            # feature caps both children at the child-output midpoint;
-            # outputs are always clipped to the leaf's inherited bounds.
             plo, phi = st.leaf_lo[leaf], st.leaf_hi[leaf]
             out_l = jnp.clip(out_l, plo, phi)
             out_r = jnp.clip(out_r, plo, phi)
-            mono_t = meta[3][st.best_feature[leaf]]
-            is_num = ~st.best_is_cat[leaf]
-            mid = (out_l + out_r) / 2.0
-            lo_l = jnp.where((mono_t < 0) & is_num, jnp.maximum(plo, mid), plo)
-            hi_l = jnp.where((mono_t > 0) & is_num, jnp.minimum(phi, mid), phi)
-            lo_r = jnp.where((mono_t > 0) & is_num, jnp.maximum(plo, mid), plo)
-            hi_r = jnp.where((mono_t < 0) & is_num, jnp.minimum(phi, mid), phi)
-            st = st._replace(
-                leaf_lo=st.leaf_lo.at[pair].set(jnp.stack([lo_l, lo_r])),
-                leaf_hi=st.leaf_hi.at[pair].set(jnp.stack([hi_l, hi_r])))
-            bounds2 = (jnp.stack([lo_l, lo_r]), jnp.stack([hi_l, hi_r]))
+            if inter:
+                # Intermediate mode: children inherit the parent's bounds
+                # verbatim; the real bounds (and every leaf's refreshed
+                # best split) come from _inter_refresh right after this
+                # split.  Track the children's bin rectangles for the
+                # adjacency pass.
+                feat = st.best_feature[leaf]
+                is_num = ~st.best_is_cat[leaf]
+                cut = st.best_bin[leaf] + 1
+                lo_p = st.leaf_bin_lo[leaf]
+                hi_p = st.leaf_bin_hi[leaf]
+                fhot1 = jnp.arange(lo_p.shape[0]) == feat
+                hi_l_r = jnp.where(fhot1 & is_num,
+                                   jnp.minimum(hi_p, cut), hi_p)
+                lo_r_r = jnp.where(fhot1 & is_num,
+                                   jnp.maximum(lo_p, cut), lo_p)
+                st = st._replace(
+                    leaf_bin_lo=st.leaf_bin_lo.at[pair].set(
+                        jnp.stack([lo_p, lo_r_r])),
+                    leaf_bin_hi=st.leaf_bin_hi.at[pair].set(
+                        jnp.stack([hi_l_r, hi_p])),
+                    leaf_lo=st.leaf_lo.at[pair].set(jnp.stack([plo, plo])),
+                    leaf_hi=st.leaf_hi.at[pair].set(jnp.stack([phi, phi])))
+                bounds2 = (jnp.stack([plo, plo]), jnp.stack([phi, phi]))
+            else:
+                # Basic monotone bounds (reference
+                # BasicLeafConstraints::Update,
+                # monotone_constraints.hpp:487): a numerical split on a
+                # monotone feature caps both children at the child-output
+                # midpoint; outputs are always clipped to the leaf's
+                # inherited bounds.
+                mono_t = meta[3][st.best_feature[leaf]]
+                is_num = ~st.best_is_cat[leaf]
+                mid = (out_l + out_r) / 2.0
+                lo_l = jnp.where((mono_t < 0) & is_num,
+                                 jnp.maximum(plo, mid), plo)
+                hi_l = jnp.where((mono_t > 0) & is_num,
+                                 jnp.minimum(phi, mid), phi)
+                lo_r = jnp.where((mono_t > 0) & is_num,
+                                 jnp.maximum(plo, mid), plo)
+                hi_r = jnp.where((mono_t < 0) & is_num,
+                                 jnp.minimum(phi, mid), phi)
+                st = st._replace(
+                    leaf_lo=st.leaf_lo.at[pair].set(jnp.stack([lo_l, lo_r])),
+                    leaf_hi=st.leaf_hi.at[pair].set(jnp.stack([hi_l, hi_r])))
+                bounds2 = (jnp.stack([lo_l, lo_r]), jnp.stack([hi_l, hi_r]))
         node_key = None
         if need_key:
             rng, node_key = jax.random.split(st.rng)
@@ -630,6 +688,79 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
             best_gl=st.best_gl.at[pair].set(bs2.sum_grad_left),
             best_hl=st.best_hl.at[pair].set(bs2.sum_hess_left),
             best_cl=st.best_cl.at[pair].set(bs2.count_left),
+        )
+
+    def _inter_refresh(st, scale3, meta, feature_mask, cegb=None,
+                       groups_mat=None):
+        """Intermediate monotone mode, per-step bound + best-split refresh.
+
+        Reference ``IntermediateLeafConstraints`` (monotone_constraints.hpp:
+        516) walks the tree recursively after each split
+        (``GoUpToFindLeavesToUpdate``) to tighten the output bounds of
+        leaves contiguous with the new children, then recomputes the best
+        split of each touched leaf (``RecomputeBestSplitForLeaf``,
+        serial_tree_learner.cpp:879).  With static shapes the TPU-shaped
+        equivalent is: (1) ONE vectorized O(L^2 F) rectangle-adjacency pass
+        deriving every leaf's bounds fresh from the CURRENT outputs of its
+        feature-space neighbours — fresh derivation subsumes the reference's
+        incremental min/max tightening and can only be looser-or-equal
+        (= better splits) while preserving monotonicity; (2) ONE vmapped
+        split rescan over ALL leaves from their resident histograms (the
+        (L, F, B, 3) leaf_hist makes this a data-reuse win, not a rescan of
+        rows)."""
+        mono = meta[3]
+        f = mono.shape[0]
+        lo_r, hi_r = st.leaf_bin_lo, st.leaf_bin_hi            # (L, F)
+        alive = jnp.arange(L) < st.num_leaves
+        o_lo, o_hi = lo_r[:, None, :], hi_r[:, None, :]
+        t_lo, t_hi = lo_r[None, :, :], hi_r[None, :, :]
+        overlap = (o_lo < t_hi) & (t_lo < o_hi)                # (L, L, F)
+        n_overlap = jnp.sum(overlap, axis=-1)                  # (L, L)
+        # pair (i, j) is adjacent along f iff their rectangles overlap in
+        # every OTHER feature dimension
+        adj = (n_overlap[:, :, None]
+               - overlap.astype(jnp.int32)) == (f - 1)
+        inc = (mono > 0)[None, None, :]
+        dec = (mono < 0)[None, None, :]
+        # out_j upper-bounds leaf i's future children when j sits wholly on
+        # i's increasing side (or decreasing side under a negative
+        # constraint) in an adjacent position
+        upper = adj & ((inc & (o_hi <= t_lo)) | (dec & (t_hi <= o_lo)))
+        pair_up = jnp.any(upper, axis=-1) & alive[:, None] & alive[None, :]
+        out = st.leaf_out
+        new_hi = jnp.min(jnp.where(pair_up, out[None, :], jnp.inf), axis=1)
+        new_lo = jnp.max(jnp.where(pair_up.T, out[None, :], -jnp.inf),
+                         axis=1)
+        st = st._replace(leaf_lo=new_lo, leaf_hi=new_hi)
+
+        histL = _expand_hist_batch(
+            _scale_hist(st.leaf_hist, scale3), meta, st.leaf_sum_grad,
+            st.leaf_sum_hess, st.leaf_count)
+        penaltyL = None
+        if cfg.split.use_cegb and cegb is not None:
+            coupled, lazy = cegb
+            penaltyL = jax.vmap(
+                lambda c, p: _cegb_penalty(c, st.feat_used, p, coupled,
+                                           lazy))(st.leaf_count,
+                                                  st.leaf_path)
+        bs = _best_for_batch(
+            histL, st.leaf_sum_grad, st.leaf_sum_hess, st.leaf_count, meta,
+            feature_mask, penaltyL, st.leaf_out, None,
+            st.leaf_path if track_path else None, groups_mat,
+            (new_lo, new_hi), st.leaf_depth)
+        depth_ok = (jnp.ones(L, bool) if cfg.max_depth <= 0
+                    else st.leaf_depth < cfg.max_depth)
+        gain = jnp.where(alive & depth_ok, bs.gain, _NEG_INF)
+        return st._replace(
+            best_gain=gain,
+            best_feature=bs.feature,
+            best_bin=bs.bin,
+            best_default_left=bs.default_left,
+            best_is_cat=bs.is_cat,
+            best_cat_mask=bs.cat_mask,
+            best_gl=bs.sum_grad_left,
+            best_hl=bs.sum_hess_left,
+            best_cl=bs.count_left,
         )
 
     def _scale_hist(hist, scale3):
@@ -962,6 +1093,9 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
                                     scale3)
             if n_forced:
                 st = _record_forced_children(st, use_f, si, leaf, new_leaf)
+            if inter:
+                st = _inter_refresh(st, scale3, meta, feature_mask, cegb,
+                                    groups_mat)
             return st
 
         def cond(st: _GrowState):
@@ -1337,6 +1471,9 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
                                    scale3)
             if n_forced:
                 st = _record_forced_children(st, use_f, si, leaf, new_leaf)
+            if inter:
+                st = _inter_refresh(st, scale3, meta, feature_mask, cegb,
+                                    groups_mat)
             return st, row_leaf
 
         def cond(carry):

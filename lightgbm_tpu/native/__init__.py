@@ -265,6 +265,32 @@ def _flatten_trees(trees, with_counts=False):
     return out
 
 
+def make_bins_predictor(trees, nan_bins: np.ndarray):
+    """Bind a tree list ONCE and return ``run(bins, out) -> out``.
+
+    The serving fast path (C API FastConfig, reference c_api.h:1332): the
+    per-call cost of :func:`predict_bins` is dominated by re-flattening the
+    tree pack; this pre-marshals it so a single-row call is just the native
+    traversal.  Returns None when the native library is unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    t = _flatten_trees(trees)
+    nan_bins = np.ascontiguousarray(nan_bins, np.int32)
+    ntrees = len(trees)
+
+    def run(bins: np.ndarray, out: np.ndarray) -> np.ndarray:
+        bins = np.ascontiguousarray(bins, np.uint16)
+        n, f = bins.shape
+        lib.ltpu_predict_bins(
+            bins, n, f, nan_bins, ntrees,
+            t["node_off"], t["leaf_off"], t["sf"], t["sb"], t["dl"],
+            t["ic"], t["cat"], t["words"], t["lc"], t["rc"], t["lv"], out)
+        return out
+
+    return run
+
+
 def predict_bins(bins: np.ndarray, nan_bins: np.ndarray, trees,
                  out: Optional[np.ndarray] = None) -> Optional[np.ndarray]:
     """Sum of tree outputs over binned rows. ``trees``: list of Tree

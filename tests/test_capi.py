@@ -315,3 +315,176 @@ def test_capi_csr_and_feature_names():
     assert preds.std() > 1e-6  # actually discriminates
     _check(lib, lib.LGBM_BoosterFree(bst))
     _check(lib, lib.LGBM_DatasetFree(ds))
+
+
+def test_capi_streaming_push():
+    """CreateByReference + PushRows chunks + WithMetadata (reference
+    streaming protocol, c_api.h:162-323): a dataset streamed in 4 chunks
+    must train identically to the one-shot matrix dataset."""
+    lib = _load()
+    rng = np.random.RandomState(8)
+    n, f = 800, 6
+    X = rng.randn(n, f)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float64)
+
+    ref = _dataset_from_mat(lib, X, y, params=b"max_bin=63")
+    stream = ctypes.c_void_p()
+    _check(lib, lib.LGBM_DatasetCreateByReference(
+        ref, ctypes.c_int64(n), ctypes.byref(stream)))
+    _check(lib, lib.LGBM_DatasetSetWaitForManualFinish(stream, 1))
+    chunk = n // 4
+    for i in range(4):
+        blk = np.ascontiguousarray(X[i * chunk:(i + 1) * chunk], np.float64)
+        lab = np.ascontiguousarray(y[i * chunk:(i + 1) * chunk], np.float32)
+        _check(lib, lib.LGBM_DatasetPushRowsWithMetadata(
+            stream, blk.ctypes.data_as(ctypes.c_void_p), 1,
+            ctypes.c_int32(chunk), ctypes.c_int32(f),
+            ctypes.c_int32(i * chunk),
+            lab.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            None, None, None, ctypes.c_int32(0)))
+    _check(lib, lib.LGBM_DatasetMarkFinished(stream))
+    nd = ctypes.c_int32()
+    _check(lib, lib.LGBM_DatasetGetNumData(stream, ctypes.byref(nd)))
+    assert nd.value == n
+
+    def _train(ds):
+        bst = ctypes.c_void_p()
+        _check(lib, lib.LGBM_BoosterCreate(
+            ds, b"objective=binary num_leaves=15 min_data_in_leaf=5 "
+                b"verbosity=-1 max_bin=63 deterministic=true seed=3",
+            ctypes.byref(bst)))
+        fin = ctypes.c_int()
+        for _ in range(8):
+            _check(lib, lib.LGBM_BoosterUpdateOneIter(bst, ctypes.byref(fin)))
+        return bst
+
+    b_stream = _train(stream)
+    b_mat = _train(_dataset_from_mat(lib, X, y, params=b"max_bin=63"))
+    Xp = np.ascontiguousarray(X[:100], np.float64)
+    outs = []
+    for bst in (b_stream, b_mat):
+        out = (ctypes.c_double * 100)()
+        out_n = ctypes.c_int64()
+        _check(lib, lib.LGBM_BoosterPredictForMat(
+            bst, Xp.ctypes.data_as(ctypes.c_void_p), 1,
+            ctypes.c_int32(100), ctypes.c_int32(f), ctypes.c_int(1),
+            ctypes.c_int(0), ctypes.c_int(0), ctypes.c_int(-1), b"",
+            ctypes.byref(out_n), out))
+        outs.append(np.array(out[:]))
+    np.testing.assert_allclose(outs[0], outs[1], rtol=1e-9)
+
+
+def test_capi_csr_push_and_csc_create():
+    sp = pytest.importorskip("scipy.sparse")
+    lib = _load()
+    rng = np.random.RandomState(9)
+    n, f = 600, 8
+    dense = np.where(rng.rand(n, f) < 0.3, rng.randn(n, f), 0.0)
+    y = (dense[:, 0] > 0).astype(np.float64)
+
+    # CSC create routes through the sparse-direct binning path
+    csc = sp.csc_matrix(dense)
+    indptr = np.ascontiguousarray(csc.indptr, np.int32)
+    indices = np.ascontiguousarray(csc.indices, np.int32)
+    vals = np.ascontiguousarray(csc.data, np.float64)
+    h_csc = ctypes.c_void_p()
+    _check(lib, lib.LGBM_DatasetCreateFromCSC(
+        indptr.ctypes.data_as(ctypes.c_void_p), 2,
+        indices.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        vals.ctypes.data_as(ctypes.c_void_p), 1,
+        ctypes.c_int64(len(indptr)), ctypes.c_int64(csc.nnz),
+        ctypes.c_int64(n), b"max_bin=63", ctypes.c_void_p(),
+        ctypes.byref(h_csc)))
+    nd = ctypes.c_int32()
+    nf = ctypes.c_int32()
+    _check(lib, lib.LGBM_DatasetGetNumData(h_csc, ctypes.byref(nd)))
+    _check(lib, lib.LGBM_DatasetGetNumFeature(h_csc, ctypes.byref(nf)))
+    assert (nd.value, nf.value) == (n, f)
+
+    # CSR streaming push against it
+    stream = ctypes.c_void_p()
+    _check(lib, lib.LGBM_DatasetCreateByReference(
+        h_csc, ctypes.c_int64(n), ctypes.byref(stream)))
+    csr = sp.csr_matrix(dense)
+    half = n // 2
+    for i, (lo, hi) in enumerate(((0, half), (half, n))):
+        blk = csr[lo:hi]
+        bi = np.ascontiguousarray(blk.indptr, np.int32)
+        bj = np.ascontiguousarray(blk.indices, np.int32)
+        bv = np.ascontiguousarray(blk.data, np.float64)
+        lab = np.ascontiguousarray(y[lo:hi], np.float32)
+        _check(lib, lib.LGBM_DatasetPushRowsByCSRWithMetadata(
+            stream, bi.ctypes.data_as(ctypes.c_void_p), 2,
+            bj.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            bv.ctypes.data_as(ctypes.c_void_p), 1,
+            ctypes.c_int64(len(bi)), ctypes.c_int64(blk.nnz),
+            ctypes.c_int64(lo),
+            lab.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            None, None, None, ctypes.c_int32(0)))
+    _check(lib, lib.LGBM_DatasetMarkFinished(stream))
+    bst = ctypes.c_void_p()
+    _check(lib, lib.LGBM_BoosterCreate(
+        stream, b"objective=binary num_leaves=7 verbosity=-1 max_bin=63",
+        ctypes.byref(bst)))
+    fin = ctypes.c_int()
+    for _ in range(3):
+        _check(lib, lib.LGBM_BoosterUpdateOneIter(bst, ctypes.byref(fin)))
+    it = ctypes.c_int()
+    _check(lib, lib.LGBM_BoosterGetCurrentIteration(bst, ctypes.byref(it)))
+    assert it.value == 3
+
+
+def test_capi_single_row_fast_predict():
+    """FastConfig single-row serving (reference c_api.h:1332): parity with
+    the batch path and a sub-millisecond per-call budget."""
+    import time
+
+    lib = _load()
+    rng = np.random.RandomState(10)
+    n, f = 1200, 10
+    X = rng.randn(n, f)
+    y = (X[:, 0] + 0.3 * X[:, 1] > 0).astype(np.float64)
+    ds = _dataset_from_mat(lib, X, y)
+    bst = ctypes.c_void_p()
+    _check(lib, lib.LGBM_BoosterCreate(
+        ds, b"objective=binary num_leaves=31 verbosity=-1",
+        ctypes.byref(bst)))
+    fin = ctypes.c_int()
+    for _ in range(20):
+        _check(lib, lib.LGBM_BoosterUpdateOneIter(bst, ctypes.byref(fin)))
+
+    fast = ctypes.c_void_p()
+    _check(lib, lib.LGBM_BoosterPredictForMatSingleRowFastInit(
+        bst, ctypes.c_int(0), ctypes.c_int(0), ctypes.c_int(-1),
+        ctypes.c_int(1), ctypes.c_int32(f), b"", ctypes.byref(fast)))
+
+    # parity vs batch predict
+    rows = np.ascontiguousarray(X[:50], np.float64)
+    batch = (ctypes.c_double * 50)()
+    out_n = ctypes.c_int64()
+    _check(lib, lib.LGBM_BoosterPredictForMat(
+        bst, rows.ctypes.data_as(ctypes.c_void_p), 1, ctypes.c_int32(50),
+        ctypes.c_int32(f), ctypes.c_int(1), ctypes.c_int(0),
+        ctypes.c_int(0), ctypes.c_int(-1), b"", ctypes.byref(out_n), batch))
+    one = ctypes.c_double()
+    for i in range(50):
+        row = np.ascontiguousarray(rows[i], np.float64)
+        _check(lib, lib.LGBM_BoosterPredictForMatSingleRowFast(
+            fast, row.ctypes.data_as(ctypes.c_void_p),
+            ctypes.byref(out_n), ctypes.byref(one)))
+        assert out_n.value == 1
+        # batch path converts outputs through jax f32; the fast path's
+        # host-numpy sigmoid is f64 — identical rounding is not expected
+        np.testing.assert_allclose(one.value, batch[i], rtol=1e-6,
+                                   atol=1e-7)
+
+    # latency budget: <= 1 ms/call averaged over 200 calls (after warmup)
+    row = np.ascontiguousarray(rows[0], np.float64)
+    t0 = time.perf_counter()
+    for _ in range(200):
+        lib.LGBM_BoosterPredictForMatSingleRowFast(
+            fast, row.ctypes.data_as(ctypes.c_void_p),
+            ctypes.byref(out_n), ctypes.byref(one))
+    per_call_ms = (time.perf_counter() - t0) / 200 * 1e3
+    assert per_call_ms < 1.0, f"{per_call_ms:.3f} ms/call"
+    _check(lib, lib.LGBM_FastConfigFree(fast))

@@ -1,0 +1,171 @@
+"""REAL multi-process distributed smoke test.
+
+Reference pattern: tests/distributed/_test_distributed.py:168-196 — spawn
+worker processes on localhost, bootstrap ranks from a machine list, train
+distributed, assert parity with the single-process result.
+
+Here: 2 OS processes x 4 virtual CPU devices each bootstrap through
+``parallel/distributed.py`` (machine-list parse -> rank derivation ->
+``jax.distributed.initialize``), build ONE global 8-device mesh spanning
+both processes, run the sharded grower over it, and the parent asserts the
+resulting tree is IDENTICAL to the single-process serial tree.  This is the
+only test where the collectives actually cross a process boundary (gRPC
+loopback instead of intra-process threads).
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+N, F, LEAVES = 8 * 2304, 12, 31
+
+
+def _make_data():
+    rng = np.random.RandomState(7)
+    X = rng.randn(N, F)
+    y = (X[:, 0] + 0.7 * X[:, 1] * X[:, 2] + 0.3 * rng.randn(N) > 0)
+    return X, y.astype(np.float64)
+
+
+WORKER = r"""
+import os, sys
+sys.path.insert(0, os.environ["LGB_REPO"])
+import _hermetic
+jax = _hermetic.force_cpu(4)
+import numpy as np
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.parallel.distributed import (global_mesh, init_distributed,
+                                               is_multi_process, shutdown)
+from lightgbm_tpu.parallel import collectives
+from lightgbm_tpu.parallel.mesh import DATA_AXIS
+
+rank_expect = int(os.environ["LIGHTGBM_TPU_RANK"])
+boot = Config({"machines": os.environ["LGB_MACHINES"], "num_machines": 2,
+               "verbosity": -1})
+rank, world = init_distributed(boot)
+assert (rank, world) == (rank_expect, 2), (rank, world)
+assert is_multi_process()
+assert len(jax.devices()) == 8, len(jax.devices())
+mesh = global_mesh()
+
+# L1 facade over a REAL process boundary: psum of per-device values.
+vals = jax.device_put(np.arange(8, dtype=np.float32),
+                      NamedSharding(mesh, P(DATA_AXIS)))
+got = float(np.asarray(collectives.global_sum(vals, mesh))[0])
+assert got == 28.0, got
+
+# sharded grower over the global mesh
+sys.path.insert(0, os.path.join(os.environ["LGB_REPO"], "tests"))
+from test_distributed_mp import _make_data
+import lightgbm_tpu.models.grower as G
+from lightgbm_tpu.dataset import TrainData
+from lightgbm_tpu.models.gbdt import _split_config
+
+X, y = _make_data()
+tcfg = Config({"objective": "binary", "num_leaves": 31,
+               "min_data_in_leaf": 20, "verbosity": -1})
+td = TrainData.build(X, y, tcfg)
+meta = td.feature_meta_device()
+gcfg = G.GrowerConfig(num_leaves=31, num_bins=td.binned.max_num_bins,
+                      split=_split_config(tcfg))
+grow = G.make_grower(gcfg, mesh=mesh, data_axis=DATA_AXIS)
+row = NamedSharding(mesh, P(DATA_AXIS))
+rep = NamedSharding(mesh, P())
+n = X.shape[0]
+grad = jax.device_put((0.5 - y).astype(np.float32), row)
+hess = jax.device_put(np.full(n, 0.25, np.float32), row)
+mask = jax.device_put(np.ones(n, np.float32), row)
+bins = jax.device_put(np.asarray(td.binned.bins), NamedSharding(mesh, P(DATA_AXIS, None)))
+fmask = jax.device_put(np.ones(X.shape[1], bool), rep)
+metas = [jax.device_put(np.asarray(meta[k]), rep)
+         for k in ("num_bins_per_feature", "nan_bins", "is_categorical",
+                   "monotone")]
+tree, _row_leaf = grow(bins, grad, hess, mask, fmask, *metas)
+if rank == 0:
+    np.savez(os.environ["LGB_OUT"],
+             split_feature=np.asarray(tree.split_feature),
+             split_bin=np.asarray(tree.split_bin),
+             left_child=np.asarray(tree.left_child),
+             leaf_value=np.asarray(tree.leaf_value),
+             num_leaves=int(tree.num_leaves))
+shutdown()
+print("WORKER_OK", rank)
+"""
+
+
+def test_two_process_data_parallel_matches_serial(tmp_path):
+    # pick two free loopback ports: one for the jax coordinator (entry 0 of
+    # the machine list = coordinator, like the reference's rank-0 socket)
+    with socket.socket() as s1, socket.socket() as s2:
+        s1.bind(("127.0.0.1", 0))
+        s2.bind(("127.0.0.1", 0))
+        p1, p2 = s1.getsockname()[1], s2.getsockname()[1]
+    machines = f"127.0.0.1:{p1},127.0.0.1:{p2}"
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER)
+    out_npz = str(tmp_path / "tree.npz")
+
+    procs = []
+    for rank in range(2):
+        env = dict(os.environ)
+        env.update({"LGB_REPO": REPO, "LGB_MACHINES": machines,
+                    "LIGHTGBM_TPU_RANK": str(rank), "LGB_OUT": out_npz,
+                    "JAX_PLATFORMS": "cpu"})
+        procs.append(subprocess.Popen(
+            [sys.executable, "-u", str(script)], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    outs = []
+    for pr in procs:
+        try:
+            out, _ = pr.communicate(timeout=600)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out)
+    for rank, (pr, out) in enumerate(zip(procs, outs)):
+        assert pr.returncode == 0, f"rank {rank} failed:\n{out[-4000:]}"
+        assert f"WORKER_OK {rank}" in out
+
+    # single-process serial reference tree on the same data
+    import jax.numpy as jnp
+
+    import lightgbm_tpu.models.grower as G
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.dataset import TrainData
+    from lightgbm_tpu.models.gbdt import _split_config
+
+    X, y = _make_data()
+    tcfg = Config({"objective": "binary", "num_leaves": 31,
+                   "min_data_in_leaf": 20, "verbosity": -1})
+    td = TrainData.build(X, y, tcfg)
+    meta = td.feature_meta_device()
+    gcfg = G.GrowerConfig(num_leaves=31, num_bins=td.binned.max_num_bins,
+                          split=_split_config(tcfg))
+    tree, _ = G.make_grower(gcfg)(
+        jnp.asarray(td.binned.bins),
+        jnp.asarray((0.5 - y).astype(np.float32)),
+        jnp.full(N, 0.25, jnp.float32), jnp.ones(N, jnp.float32),
+        jnp.ones(F, bool), meta["num_bins_per_feature"], meta["nan_bins"],
+        meta["is_categorical"], meta["monotone"])
+
+    got = np.load(out_npz)
+    assert got["num_leaves"] == int(tree.num_leaves)
+    np.testing.assert_array_equal(got["split_feature"],
+                                  np.asarray(tree.split_feature))
+    np.testing.assert_array_equal(got["split_bin"],
+                                  np.asarray(tree.split_bin))
+    np.testing.assert_array_equal(got["left_child"],
+                                  np.asarray(tree.left_child))
+    np.testing.assert_allclose(got["leaf_value"],
+                               np.asarray(tree.leaf_value),
+                               rtol=1e-4, atol=1e-6)

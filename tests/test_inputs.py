@@ -55,6 +55,75 @@ def test_scipy_sparse_input():
     np.testing.assert_allclose(p_sparse, p_dense, rtol=1e-9)
 
 
+def test_sparse_bins_match_dense_bins():
+    """CSR-direct binning (binning._bin_sparse_matrix — the TPU answer to
+    sparse_bin.hpp:73) must produce bit-identical bins to the dense path,
+    including NaN entries and training equivalence."""
+    sp = pytest.importorskip("scipy.sparse")
+    from lightgbm_tpu.binning import bin_dataset
+
+    rng = np.random.RandomState(4)
+    n, f = 3000, 40
+    dense = np.zeros((n, f))
+    for j in range(f):
+        rows = rng.choice(n, size=n // 20, replace=False)
+        dense[rows, j] = rng.randn(len(rows))
+    nanr = rng.choice(n, size=30, replace=False)
+    dense[nanr, 3] = np.nan
+    X = sp.csr_matrix(dense)
+    b_dense = bin_dataset(dense, max_bin=63)
+    b_sparse = bin_dataset(X, max_bin=63)
+    np.testing.assert_array_equal(b_dense.bins, b_sparse.bins)
+    np.testing.assert_array_equal(b_dense.nan_bins, b_sparse.nan_bins)
+    # training end-to-end equality
+    y = (np.nansum(dense[:, :3], axis=1) > 0).astype(float)
+    p = {"objective": "binary", "num_leaves": 15, "min_data_in_leaf": 5,
+         "verbosity": -1, "deterministic": True, "seed": 1}
+    bd = lgb.train(p, lgb.Dataset(dense, label=y), 8)
+    bs = lgb.train(p, lgb.Dataset(X, label=y), 8)
+    np.testing.assert_allclose(bd.predict(dense), bs.predict(X), rtol=1e-9)
+
+
+def test_sparse_ingestion_memory_bounded():
+    """Constructing a Dataset from a 100k x 2000 / ~1% CSR must stay O(nnz)
+    + the uint8 bin matrix — never the ~1.6 GB dense f64 copy (VERDICT r3
+    missing #4).  Measured as child-process peak RSS."""
+    pytest.importorskip("scipy.sparse")
+    import os
+    import subprocess
+    import sys
+
+    code = r"""
+import resource, sys
+import numpy as np
+import scipy.sparse as sp
+import lightgbm_tpu as lgb
+
+n, f, nnz_per_col = 100_000, 2000, 1000
+rng = np.random.RandomState(0)
+# .copy() matters: choice(replace=False) returns a slice view that pins
+# the full n-permutation buffer, which alone would look like ~1.6 GB
+rows = np.concatenate([rng.choice(n, nnz_per_col, replace=False).copy()
+                       for _ in range(f)])
+cols = np.repeat(np.arange(f), nnz_per_col)
+vals = rng.randn(f * nnz_per_col)
+X = sp.csr_matrix((vals, (rows, cols)), shape=(n, f))
+y = (np.asarray(X[:, 0].todense()).ravel() > 0).astype(float)
+ds = lgb.Dataset(X, label=y)
+ds.construct({"objective": "binary", "verbosity": -1,
+              "enable_bundle": False})
+peak_mb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024
+print("PEAK_MB", peak_mb)
+# bins (100k x 2000 uint8) = 200 MB; jax/numpy baseline ~350 MB.
+# The dense-f64 path would add 1600 MB on top.
+sys.exit(0 if peak_mb < 1000 else 1)
+"""
+    r = subprocess.run([sys.executable, "-u", "-c", code],
+                       capture_output=True, text=True, timeout=600,
+                       env={**os.environ, "LIGHTGBM_TPU_PLATFORM": "cpu"})
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
 def test_pandas_series_label_and_weight():
     rng = np.random.RandomState(2)
     X = rng.randn(300, 4)

@@ -79,11 +79,11 @@ def test_monotone_bounds_enforced():
     assert np.all(np.diff(pred) >= -1e-6), "violation of monotone increase"
 
 
-def test_monotone_method_rejected():
+def test_monotone_method_unknown_rejected():
     X, y = _reg_data(n=300)
     with pytest.raises(ValueError, match="monotone_constraints_method"):
         lgb.train(dict(P, monotone_constraints=[1, 0, 0, 0, 0, 0],
-                       monotone_constraints_method="intermediate"),
+                       monotone_constraints_method="bogus"),
                   lgb.Dataset(X, label=y), 2)
 
 
