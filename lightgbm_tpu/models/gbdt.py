@@ -186,14 +186,14 @@ class GBDT:
                     "selection)")
         from ..parallel.mesh import DATA_AXIS, FEATURE_AXIS
         # Data-only meshes use the sharded permutation layout (shard_map:
-        # per-shard pallas histograms + one psum per wave).  Feature-sharded
-        # meshes stay on the GSPMD mask path, whose einsum the compiler
-        # partitions — the pallas kernel is per-device-only there.
+        # per-shard pallas histograms + one psum per wave).  Feature-only
+        # meshes route to the feature-sharded perm layout when the config
+        # allows (grower.fp_capable_for) — per-shard kernels, so the
+        # default histogram impl stays; only the GSPMD mask fallback needs
+        # the compiler-partitionable einsum impls.
         data_only_mesh = (self.mesh is not None
                           and int(self.mesh.shape[FEATURE_AXIS]) == 1)
         hist_impl = cfg.tpu_histogram_impl
-        if hist_impl == "auto" and self.mesh is not None and not data_only_mesh:
-            hist_impl = "onehot" if jax.default_backend() == "tpu" else "segment"
         voting = cfg.tree_learner == "voting" and data_only_mesh
         if voting and (cfg.extra_trees or cfg.feature_fraction_bynode < 1.0
                        or cfg.interaction_constraints
@@ -285,6 +285,18 @@ class GBDT:
             bundled=self.bundles is not None,
             mono_intermediate=self._mono_intermediate,
         )
+        from .grower import fp_capable_for
+        if (self.mesh is not None and not data_only_mesh
+                and hist_impl == "auto"
+                and not fp_capable_for(self.grower_cfg, self.mesh,
+                                       DATA_AXIS)):
+            # GSPMD mask fallback: the pallas kernel is per-device-only;
+            # use the compiler-partitionable einsum impls
+            import dataclasses as _dc
+            hist_impl = ("onehot" if jax.default_backend() == "tpu"
+                         else "segment")
+            self.grower_cfg = _dc.replace(self.grower_cfg,
+                                          histogram_impl=hist_impl)
         self._quant_key = (jax.random.PRNGKey(cfg.seed)
                            if cfg.use_quantized_grad else None)
         # PRNG for per-node randomness (extra_trees thresholds / bynode
@@ -314,6 +326,15 @@ class GBDT:
                 if pad:
                     self.bins_dev = jnp.pad(self.bins_dev,
                                             ((0, pad), (0, 0)))
+            elif getattr(self.grow, "fp_capable", False):
+                # Feature-sharded perm layout: pad feature columns so the
+                # (data, feature) placement shards evenly; the grower pads
+                # its per-feature metadata to match (grower._grow_fp).
+                padf = (-self.bins_dev.shape[1]) % int(
+                    self.mesh.shape[FEATURE_AXIS])
+                if padf:
+                    self.bins_dev = jnp.pad(self.bins_dev,
+                                            ((0, 0), (0, padf)))
             self.bins_dev = shard_arrays(self.mesh, self.bins_dev)
         self.sample_strategy = SampleStrategy(
             cfg, train.num_data, train.label, train.query_boundaries())

@@ -199,6 +199,36 @@ def _store_best(state: _GrowState, leaf: jnp.ndarray, bs: BestSplit,
     )
 
 
+def _shard_map():
+    """shard_map + version-dependent replication-check kwarg (jax >= 0.8
+    moved it out of experimental and renamed check_rep)."""
+    try:
+        from jax import shard_map
+        return shard_map, {"check_vma": False}
+    except ImportError:                        # pragma: no cover
+        from jax.experimental.shard_map import shard_map
+        return shard_map, {"check_rep": False}
+
+
+def fp_capable_for(cfg: GrowerConfig, mesh, data_axis: str) -> bool:
+    """Static predicate: does this config route a feature-only mesh to the
+    feature-sharded perm layout (vs the GSPMD mask fallback)?  Shared by
+    make_grower's dispatch and GBDT's bins pre-padding / impl selection so
+    they cannot disagree."""
+    if mesh is None or len(mesh.axis_names) < 2:
+        return False
+    others = [a for a in mesh.axis_names if a != data_axis]
+    if len(others) != 1 or int(mesh.shape[others[0]]) <= 1:
+        return False
+    n_forced = len(cfg.forced_splits or ())
+    return (int(mesh.shape[data_axis]) == 1 and cfg.leaf_batch == 1
+            and not cfg.voting and not cfg.split.extra_trees
+            and cfg.feature_fraction_bynode >= 1.0
+            and not cfg.interaction_groups and not cfg.split.use_cegb
+            and not n_forced and not cfg.bundled
+            and not (cfg.mono_intermediate and cfg.split.has_monotone))
+
+
 def _split_buckets(n: int) -> list:
     """Static slice sizes covering leaf row counts 1..n."""
     sizes = []
@@ -368,7 +398,19 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
         raise ValueError(
             "forced splits require leaf_batch=1 and are not supported with "
             "voting-parallel (the wave scheduler would reorder them)")
+    # Feature-parallel capability: a feature-only mesh routes to the
+    # feature-sharded perm layout when every enabled knob supports local
+    # per-shard scans; anything else falls back to the GSPMD mask layout.
+    fp_axis_name = None
+    fp_shards = 1
+    if mesh is not None and len(mesh.axis_names) > 1:
+        others = [a for a in mesh.axis_names if a != data_axis]
+        if len(others) == 1:
+            fp_axis_name = others[0]
+            fp_shards = int(mesh.shape[fp_axis_name])
+
     inter = cfg.mono_intermediate and cfg.split.has_monotone
+    fp_capable = fp_capable_for(cfg, mesh, data_axis)
     if inter and (cfg.leaf_batch > 1 or cfg.voting):
         raise ValueError(
             "monotone_constraints_method=intermediate requires sequential "
@@ -571,7 +613,8 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
 
     def _children_updates(st, leaf, new_leaf, hist_left, hist_right,
                           gl, hl, cl, gr, hr, cr, meta, feature_mask,
-                          cegb=None, groups_mat=None, scale3=None):
+                          cegb=None, groups_mat=None, scale3=None,
+                          sync=None, fp_mono=None):
         """Store child stats + their best splits (both children batched into
         single 2-row scatters to minimize kernel count in the hot loop)."""
         depth = st.leaf_depth[leaf] + 1
@@ -618,7 +661,8 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
                 # monotone feature caps both children at the child-output
                 # midpoint; outputs are always clipped to the leaf's
                 # inherited bounds.
-                mono_t = meta[3][st.best_feature[leaf]]
+                mono_t = (fp_mono(st.best_feature[leaf]) if fp_mono
+                          is not None else meta[3][st.best_feature[leaf]])
                 is_num = ~st.best_is_cat[leaf]
                 mid = (out_l + out_r) / 2.0
                 lo_l = jnp.where((mono_t < 0) & is_num,
@@ -676,6 +720,10 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
         bs2 = _best_for_pair(hist2s, g2, h2, c2, meta, feature_mask,
                              penalty2, jnp.stack([out_l, out_r]), node_key,
                              path2, groups_mat, bounds2, depth2)
+        if sync is not None:
+            # feature-parallel: local scans covered only owned features;
+            # globalize both children's winners before storing
+            bs2 = sync(bs2)
         gain2 = jnp.where(depth_ok, bs2.gain, _NEG_INF)
         return st._replace(
             best_gain=st.best_gain.at[pair].set(gain2),
@@ -770,6 +818,88 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
             return hist
         return hist.astype(jnp.float32) * scale3
 
+    def _fp_sync_best(bs, foffset, faxis, n_shards):
+        """Feature-parallel global best-split sync (reference
+        ``SyncUpGlobalBestSplit``, feature_parallel_tree_learner.cpp:59-77):
+        every shard scanned only its OWN features; the winner's SplitInfo
+        (scalars + cat mask) is broadcast by a one-hot psum.  Local feature
+        indices become global by adding the shard's offset.  Ties break to
+        the lowest shard, like the reference's rank order."""
+        def one(gain, feature, sbin, dl, ic, cmask, gl, hl, cl, gr, hr, cr):
+            win = jax.lax.pmax(gain, faxis)
+            sidx = jax.lax.axis_index(faxis)
+            is_w = (gain >= win) & (win > _NEG_INF)
+            first = jax.lax.pmin(jnp.where(is_w, sidx, n_shards), faxis)
+            mine = sidx == first
+            scal = jnp.stack([
+                (feature + foffset).astype(jnp.float32),
+                sbin.astype(jnp.float32), dl.astype(jnp.float32),
+                ic.astype(jnp.float32), gl, hl, cl, gr, hr, cr])
+            payload = jnp.concatenate([scal, cmask.astype(jnp.float32)])
+            payload = jax.lax.psum(
+                jnp.where(mine, payload, jnp.zeros_like(payload)), faxis)
+            return BestSplit(
+                gain=win,
+                feature=jnp.round(payload[0]).astype(jnp.int32),
+                bin=jnp.round(payload[1]).astype(jnp.int32),
+                default_left=payload[2] > 0.5,
+                is_cat=payload[3] > 0.5,
+                cat_mask=payload[10:] > 0.5,
+                sum_grad_left=payload[4], sum_hess_left=payload[5],
+                count_left=payload[6],
+                sum_grad_right=payload[7], sum_hess_right=payload[8],
+                count_right=payload[9])
+
+        args = (bs.gain, bs.feature, bs.bin, bs.default_left, bs.is_cat,
+                bs.cat_mask, bs.sum_grad_left, bs.sum_hess_left,
+                bs.count_left, bs.sum_grad_right, bs.sum_hess_right,
+                bs.count_right)
+        if bs.gain.ndim == 0:
+            return one(*args)
+        return jax.vmap(one)(*args)
+
+    def _fp_go_left(bins_pad, nan_bins, feat_g, sbin, dleft, scat, cmask,
+                    foffset, fl, faxis):
+        """Row routing for a GLOBAL split feature when each shard holds only
+        its own feature columns: the owner computes the (N+1,) go-left
+        vector, one psum broadcasts it (the reference avoids this by
+        replicating the data; here it costs N bits per split and buys an
+        S-fold bins/histogram memory + compute split)."""
+        lf = feat_g - foffset
+        owns = (lf >= 0) & (lf < fl)
+        col = bins_pad[:, jnp.clip(lf, 0, fl - 1)].astype(jnp.int32)
+        is_nan = col == nan_bins[jnp.clip(lf, 0, fl - 1)]
+        gl = jnp.where(scat, cmask[col], col <= sbin)
+        gl = jnp.where(is_nan & ~scat, dleft, gl)
+        gl = jnp.where(owns, gl, False)
+        return jax.lax.psum(gl.astype(jnp.float32), faxis) > 0.5
+
+    def _partition_scatter(perm, start, seg, valid, go_left, S):
+        """Stable two-way partition of a contiguous perm slice given its
+        go-left predicate — the single copy of the slice/cumsum/scatter
+        kernel shared by every partition-branch flavor."""
+        go_left = go_left & valid
+        go_right = valid & ~go_left
+        nl_phys = jnp.sum(go_left.astype(jnp.int32))
+        lpos = jnp.cumsum(go_left.astype(jnp.int32)) - go_left
+        rpos = nl_phys + jnp.cumsum(go_right.astype(jnp.int32)) - go_right
+        pos = jnp.where(go_left, lpos,
+                        jnp.where(go_right, rpos,
+                                  jnp.arange(S, dtype=jnp.int32)))
+        new_seg = jnp.zeros(S, jnp.int32).at[pos].set(seg)
+        return (jax.lax.dynamic_update_slice(perm, new_seg, (start,)),
+                nl_phys)
+
+    def _part_branch_for_gl(S):
+        """Partition branch over a precomputed row-id-indexed go-left
+        vector (feature-parallel path: the split column lives on one
+        shard; see _fp_go_left)."""
+        def branch(perm, start, cnt, glv):
+            seg = jax.lax.dynamic_slice(perm, (start,), (S,))
+            valid = jnp.arange(S, dtype=jnp.int32) < cnt
+            return _partition_scatter(perm, start, seg, valid, glv[seg], S)
+        return branch
+
     def _part_branch_for(bins_pad, nan_bins, S, meta=None):
         """Partition one leaf's contiguous perm slice of static size S
         (cheap S-ops; no histogram).  Shared by the perm and wave layouts.
@@ -783,17 +913,7 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
             is_nan = col == nan_bins[feat]
             go_left = jnp.where(scat, cmask[col], col <= sbin)
             go_left = jnp.where(is_nan & ~scat, dleft, go_left)
-            go_left = go_left & valid
-            go_right = valid & ~go_left
-            nl_phys = jnp.sum(go_left.astype(jnp.int32))
-            lpos = jnp.cumsum(go_left.astype(jnp.int32)) - go_left
-            rpos = nl_phys + jnp.cumsum(go_right.astype(jnp.int32)) - go_right
-            pos = jnp.where(go_left, lpos,
-                            jnp.where(go_right, rpos,
-                                      jnp.arange(S, dtype=jnp.int32)))
-            new_seg = jnp.zeros(S, jnp.int32).at[pos].set(seg)
-            perm = jax.lax.dynamic_update_slice(perm, new_seg, (start,))
-            return perm, nl_phys
+            return _partition_scatter(perm, start, seg, valid, go_left, S)
         return branch
 
     def _expand_hist(bh, meta, tg, th, tc):
@@ -1012,19 +1132,53 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
 
     # ------------------------------------------------------------------ perm path
     def _grow_perm(bins, vals, scale3, feature_mask, meta, cegb=None,
-                   key=None, axis=None):
+                   key=None, axis=None, faxis=None, fp_shards=1):
         """Permutation-layout growth (single device, or per-shard under
-        ``shard_map`` when ``axis`` names the mesh data axis)."""
+        ``shard_map`` when ``axis`` names the mesh data axis, or
+        feature-sharded when ``faxis`` names the feature axis: rows
+        replicated, each shard histograms/scans only its own feature
+        columns — the reference FeatureParallelTreeLearner layout)."""
         n = bins.shape[0]
         f = meta[0].shape[0]
         nan_bins = meta[1]
         groups_mat = _groups_matrix(f) if use_groups else None
+        foffset = (jax.lax.axis_index(faxis) * f if faxis is not None
+                   else None)
+        fp_sync = (None if faxis is None else
+                   lambda bs: _fp_sync_best(bs, foffset, faxis, fp_shards))
+        fp_mono = None
+        if faxis is not None and cfg.split.has_monotone:
+            def fp_mono(feat_g):
+                # constraint type of a GLOBAL feature: owner shard
+                # broadcasts it (the local meta holds only owned features)
+                lf = feat_g - foffset
+                owns = (lf >= 0) & (lf < f)
+                m = jnp.where(owns, meta[3][jnp.clip(lf, 0, f - 1)], 0)
+                return jax.lax.psum(m, faxis)
         (state, bins_pad, vals_pad, buckets, buckets_arr,
          max_bucket) = _perm_setup(bins, vals, scale3, meta, feature_mask,
                                    cegb, key, groups_mat, axis)
+        if fp_sync is not None:
+            # _perm_setup stored the LOCAL root best; globalize it
+            # (reference SyncUpGlobalBestSplit after the root scan).
+            zero = jnp.zeros((), jnp.float32)
+            bs0 = BestSplit(
+                gain=state.best_gain[0], feature=state.best_feature[0],
+                bin=state.best_bin[0],
+                default_left=state.best_default_left[0],
+                is_cat=state.best_is_cat[0],
+                cat_mask=state.best_cat_mask[0],
+                sum_grad_left=state.best_gl[0],
+                sum_hess_left=state.best_hl[0],
+                count_left=state.best_cl[0],
+                sum_grad_right=zero, sum_hess_right=zero, count_right=zero)
+            state = _store_best(state, jnp.asarray(0), fp_sync(bs0),
+                                jnp.asarray(True))
 
-        part_branches = [_part_branch_for(bins_pad, nan_bins, S, meta)
-                         for S in buckets]
+        part_branches = ([_part_branch_for_gl(S) for S in buckets]
+                         if faxis is not None else
+                         [_part_branch_for(bins_pad, nan_bins, S, meta)
+                          for S in buckets])
         hist_branches = [_hist_branch_for(bins_pad, vals_pad, n, S)
                          for S in buckets]
 
@@ -1050,11 +1204,21 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
             gl, hl, cl = st.best_gl[leaf], st.best_hl[leaf], st.best_cl[leaf]
             gr, hr, cr = pg - gl, ph - hl, pc - cl
 
-            perm, nl_phys = jax.lax.switch(
-                _bucket_of(cnt), part_branches, st.perm, start, cnt,
-                st.best_feature[leaf], st.best_bin[leaf],
-                st.best_default_left[leaf], st.best_is_cat[leaf],
-                st.best_cat_mask[leaf])
+            if faxis is not None:
+                glv = _fp_go_left(
+                    bins_pad, nan_bins, st.best_feature[leaf],
+                    st.best_bin[leaf], st.best_default_left[leaf],
+                    st.best_is_cat[leaf], st.best_cat_mask[leaf],
+                    foffset, f, faxis)
+                perm, nl_phys = jax.lax.switch(
+                    _bucket_of(cnt), part_branches, st.perm, start, cnt,
+                    glv)
+            else:
+                perm, nl_phys = jax.lax.switch(
+                    _bucket_of(cnt), part_branches, st.perm, start, cnt,
+                    st.best_feature[leaf], st.best_bin[leaf],
+                    st.best_default_left[leaf], st.best_is_cat[leaf],
+                    st.best_cat_mask[leaf])
             # Histogram ONLY the physically smaller child's contiguous range
             # (its own, usually much smaller, bucket) — the expensive op scales
             # with the smaller sibling, exactly like the reference's serial
@@ -1090,7 +1254,7 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
             st = _children_updates(st, leaf, new_leaf, hist_left,
                                     hist_right, gl, hl, cl, gr, hr, cr,
                                     meta, feature_mask, cegb, groups_mat,
-                                    scale3)
+                                    scale3, sync=fp_sync, fp_mono=fp_mono)
             if n_forced:
                 st = _record_forced_children(st, use_f, si, leaf, new_leaf)
             if inter:
@@ -1486,6 +1650,67 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
         state, row_leaf = jax.lax.while_loop(cond, body, (state, row_leaf0))
         return _finish(state), row_leaf
 
+    # ----------------------------------------------------- feature-parallel path
+    def _grow_fp(bins, vals, scale3, feature_mask, meta, split_key):
+        """Feature-parallel perm layout (reference
+        ``FeatureParallelTreeLearner``, feature_parallel_tree_learner.cpp):
+        rows replicated, feature columns sharded.  Each shard histograms and
+        scans ONLY its own features (S-fold histogram compute + leaf_hist
+        memory split), the winner SplitInfo syncs via one psum per scan
+        (SyncUpGlobalBestSplit), and row partitions broadcast one (N,)
+        go-left vector per split (the reference replicates data so its
+        partitions are local; ours trades N bits/split for the sharded
+        column store).  Cost per split is O(leaf rows + N), not the mask
+        layout's O(N * num_leaves) full rescan."""
+        from jax.sharding import PartitionSpec as P
+        shard_map, smap_kw = _shard_map()
+
+        S = fp_shards
+        fl = -(-bins.shape[1] // S)
+        fp_width = fl * S
+        nbpf, nanb, iscat, mono = meta[:4]
+        fmask = feature_mask
+        if bins.shape[1] != fp_width:
+            # dummy columns: all-zero bins (callers may pre-pad bins once)
+            bins = jnp.pad(bins, ((0, 0), (0, fp_width - bins.shape[1])))
+        padm = fp_width - nbpf.shape[0]
+        if padm:
+            # pad metadata to the bins width; mask False = never selectable
+            fmask = jnp.pad(fmask, (0, padm))
+            nbpf = jnp.pad(nbpf, (0, padm), constant_values=2)
+            nanb = jnp.pad(nanb, (0, padm), constant_values=HB)
+            iscat = jnp.pad(iscat, (0, padm))
+            mono = jnp.pad(mono, (0, padm))
+        have_scale = scale3 is not None
+        have_key = split_key is not None
+        extras, especs = [], []
+        if have_scale:
+            extras.append(scale3)
+            especs.append(P())
+        if have_key:
+            extras.append(split_key)
+            especs.append(P())
+
+        def body(bins_l, vals_r, fm_l, nb_l, na_l, ic_l, mo_l, *extra):
+            i = 0
+            s3 = sk = None
+            if have_scale:
+                s3 = extra[i]
+                i += 1
+            if have_key:
+                sk = extra[i]
+            return _grow_perm(bins_l, vals_r, s3, fm_l,
+                              (nb_l, na_l, ic_l, mo_l), None, sk,
+                              axis=None, faxis=fp_axis_name, fp_shards=S)
+
+        return shard_map(
+            body, mesh=mesh,
+            in_specs=(P(None, fp_axis_name), P(), P(fp_axis_name),
+                      P(fp_axis_name), P(fp_axis_name), P(fp_axis_name),
+                      P(fp_axis_name)) + tuple(especs),
+            out_specs=(P(), P()),
+            **smap_kw)(bins, vals, fmask, nbpf, nanb, iscat, mono, *extras)
+
     # -------------------------------------------------------------- sharded path
     def _grow_sharded(bins, vals, scale3, feature_mask, meta, cegb,
                       split_key):
@@ -1495,12 +1720,7 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
         All split decisions derive from the replicated psum'd histograms, so
         the tree state is replicated and the while_loop stays in lockstep."""
         from jax.sharding import PartitionSpec as P
-        try:
-            from jax import shard_map          # jax >= 0.8
-            smap_kw = {"check_vma": False}
-        except ImportError:                    # pragma: no cover
-            from jax.experimental.shard_map import shard_map
-            smap_kw = {"check_rep": False}
+        shard_map, smap_kw = _shard_map()
 
         grow_fn = (_grow_wave if (cfg.leaf_batch > 1 or cfg.voting)
                    else _grow_perm)
@@ -1609,7 +1829,16 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
             vals = jnp.pad(vals, ((0, bins.shape[0] - vals.shape[0]), (0, 0)))
         use_sharded = (mesh is not None and cfg.gather_rows
                        and bins.shape[0] // dshards > _MIN_BUCKET)
-        if use_sharded:
+        if fp_capable and bins.shape[1] != meta[0].shape[0] \
+                and bins.shape[0] <= _MIN_BUCKET:
+            # caller pre-padded feature columns for the fp layout but the
+            # row count routes to the mask fallback, which must see the
+            # metadata's width (pad columns are all-zero)
+            bins = bins[:, : meta[0].shape[0]]
+        if fp_capable and bins.shape[0] > _MIN_BUCKET:
+            tree, row_leaf = _grow_fp(bins, vals, scale3, feature_mask,
+                                      meta, split_key)
+        elif use_sharded:
             tree, row_leaf = _grow_sharded(bins, vals, scale3, feature_mask,
                                            meta, cegb, split_key)
         elif (mesh is None and cfg.gather_rows
@@ -1633,4 +1862,6 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
                 leaf_weight=jnp.where(active, h_leaf, 0.0))
         return tree, row_leaf
 
+    # static dispatch facts, inspectable by tests/tools
+    grow.fp_capable = fp_capable
     return grow
