@@ -638,3 +638,390 @@ def booster_feature_importance(handle, num_iteration, importance_type):
     itype = "gain" if importance_type == 1 else "split"
     imp = handle.bst.feature_importance(importance_type=itype)
     return np.ascontiguousarray(imp, np.float64).tobytes()
+
+
+# ----------------------------------------- extended parity surface (round 4)
+# Reference anchors are the matching LGBM_* declarations in
+# include/LightGBM/c_api.h.
+
+def booster_calc_num_predict(handle, num_row, predict_type, start_iteration,
+                             num_iteration):
+    bst = handle.bst
+    k = int(bst.num_model_per_iteration())
+    total_it = int(bst.current_iteration)
+    n_it = total_it - start_iteration
+    if num_iteration > 0:
+        n_it = min(n_it, num_iteration)
+    n_it = max(n_it, 0)
+    if predict_type == C_API_PREDICT_LEAF_INDEX:
+        return num_row * k * n_it
+    if predict_type == C_API_PREDICT_CONTRIB:
+        return num_row * k * (int(bst.num_feature()) + 1)
+    return num_row * k
+
+
+def booster_get_feature_names(handle):
+    return list(handle.bst.feature_name())
+
+
+def booster_validate_feature_names(handle, names):
+    ours = list(handle.bst.feature_name())
+    names = list(names)
+    if names != ours:
+        raise ValueError(
+            f"feature names mismatch: model has {ours}, data has {names} "
+            "(reference LGBM_BoosterValidateFeatureNames)")
+
+
+def booster_get_linear(handle):
+    gbdt = handle.bst._gbdt
+    return int(bool(getattr(getattr(gbdt, "cfg", None), "linear_tree",
+                            False)))
+
+
+def booster_get_loaded_param(handle):
+    import json
+    return json.dumps(dict(handle.bst.params))
+
+
+def booster_number_of_total_model(handle):
+    return int(handle.bst.num_trees())
+
+
+def _booster_trees(handle):
+    """Iteration-major flat tree list (reference tree_idx convention:
+    ``it * num_class + k``)."""
+    gbdt = handle.bst._gbdt
+    if hasattr(gbdt, "models"):
+        k_cls = gbdt.num_class
+        n_it = min(len(m) for m in
+                   (gbdt.models[k] for k in range(k_cls)))
+        return [gbdt.models[k][it] for it in range(n_it)
+                for k in range(k_cls)]
+    return list(gbdt.trees)
+
+
+def booster_get_leaf_value(handle, tree_idx, leaf_idx):
+    trees = _booster_trees(handle)
+    return float(np.asarray(trees[tree_idx].leaf_value)[leaf_idx])
+
+
+def booster_set_leaf_value(handle, tree_idx, leaf_idx, value):
+    gbdt = handle.bst._gbdt
+    if not hasattr(gbdt, "models"):
+        t = gbdt.trees[tree_idx]
+        t.leaf_value = np.asarray(t.leaf_value, np.float64).copy()
+        t.leaf_value[leaf_idx] = value
+        return
+    k_cls = gbdt.num_class
+    k, it = tree_idx % k_cls, tree_idx // k_cls
+    tree = gbdt.models[k][it]
+    tree.leaf_value = np.asarray(tree.leaf_value, np.float64).copy()
+    tree.leaf_value[leaf_idx] = value
+    import jax.numpy as jnp
+    arrays = gbdt.dev_models[k][it]
+    lv = np.asarray(arrays.leaf_value).copy()
+    lv[leaf_idx] = value
+    gbdt.dev_models[k][it] = arrays._replace(leaf_value=jnp.asarray(lv))
+
+
+def booster_get_bound_value(handle, upper):
+    """Sum over trees of each tree's max (or min) leaf value + init score
+    (reference Booster::GetUpperBoundValue / GetLowerBoundValue)."""
+    bst = handle.bst
+    trees = _booster_trees(handle)
+    total = 0.0
+    for t in trees:
+        lv = np.asarray(t.leaf_value)[: max(int(t.num_leaves), 1)]
+        total += float(lv.max() if upper else lv.min())
+    init = getattr(bst._gbdt, "init_scores", None)
+    if init is not None:
+        total += float(np.asarray(init).ravel()[0])
+    return total
+
+
+def booster_get_num_predict(handle, data_idx):
+    import jax
+    gbdt = handle.bst._gbdt
+    sc = gbdt.scores if data_idx == 0 else gbdt.valid_scores[data_idx - 1]
+    return int(np.asarray(jax.device_get(sc)).size)
+
+
+def booster_get_predict(handle, data_idx):
+    """In-training predictions for the train (0) or a valid set (reference
+    LGBM_BoosterGetPredict: transformed scores)."""
+    import jax
+    import jax.numpy as jnp
+    gbdt = handle.bst._gbdt
+    sc = gbdt.scores if data_idx == 0 else gbdt.valid_scores[data_idx - 1]
+    raw = np.asarray(jax.device_get(sc), np.float64)
+    if gbdt.objective is not None:
+        raw = np.asarray(jax.device_get(
+            gbdt.objective.convert_output(jnp.asarray(raw))), np.float64)
+    out = np.ascontiguousarray(raw.reshape(-1), np.float64)
+    return out.tobytes(), out.size
+
+
+def booster_train_num_data(handle):
+    """Gradient-vector length for UpdateOneIterCustom:
+    num_data * num_model_per_iteration (reference c_api.h contract)."""
+    bst = handle.bst
+    return int(bst._gbdt.train_data.num_data
+               * bst.num_model_per_iteration())
+
+
+def booster_update_one_iter_custom(handle, grad_mv, hess_mv, n):
+    grad = np.frombuffer(grad_mv, np.float32, count=n).copy()
+    hess = np.frombuffer(hess_mv, np.float32, count=n).copy()
+    return 1 if handle.bst._gbdt.train_one_iter(grad, hess) else 0
+
+
+def booster_shuffle_models(handle, start, end):
+    """reference LGBM_BoosterShuffleModels (GBDT::ShuffleModels): permute
+    tree order in [start, end)."""
+    gbdt = handle.bst._gbdt
+    if not hasattr(gbdt, "models"):
+        raise ValueError("ShuffleModels needs a trained booster")
+    rng = np.random.RandomState(0)
+    perm = None
+    for k in range(gbdt.num_class):
+        _ = gbdt.models[k]          # materialize host cache
+        lst_h = gbdt._host_cache[k]
+        lst_d = gbdt.dev_models[k]
+        e = len(lst_h) if end <= 0 else min(end, len(lst_h))
+        s = max(start, 0)
+        if e - s > 1:
+            if perm is None:
+                # ONE permutation shared across classes: iteration
+                # alignment must survive the shuffle (reference
+                # GBDT::ShuffleModels permutes whole iterations)
+                perm = rng.permutation(e - s)
+            lst_h[s:e] = [lst_h[s + i] for i in perm]
+            lst_d[s:e] = [lst_d[s + i] for i in perm]
+
+
+def booster_merge(handle, other):
+    """reference LGBM_BoosterMerge: append the other booster's trees."""
+    gbdt = handle.bst._gbdt
+    og = other.bst._gbdt
+    if not hasattr(gbdt, "models") or not hasattr(og, "models"):
+        raise ValueError("merge needs two trained boosters")
+    if gbdt.num_class != og.num_class:
+        raise ValueError("merge needs equal num_class")
+    for k in range(gbdt.num_class):
+        _ = gbdt.models[k]
+        _ = og.models[k]
+        gbdt._host_cache[k].extend(og._host_cache[k])
+        gbdt.dev_models[k].extend(og.dev_models[k])
+    gbdt.iter_ += og.iter_
+
+
+def booster_refit(handle, leaf_preds_mv, nrow, ncol):
+    """reference LGBM_BoosterRefit: refit leaf values on the CURRENT
+    training data with caller-provided per-tree leaf assignments
+    (GBDT::RefitTree, gbdt.cpp:258)."""
+    from ..refit import _init_objective, _refit_pass
+    import copy as _copy
+
+    bst = handle.bst
+    gbdt = bst._gbdt
+    leaf_preds = np.frombuffer(leaf_preds_mv, np.int32,
+                               count=nrow * ncol).reshape(nrow, ncol)
+    if nrow != gbdt.train_data.num_data:
+        raise ValueError("leaf_preds nrow != training rows")
+    k_cls = gbdt.num_class
+    objective = _init_objective(
+        _copy.copy(gbdt.objective), gbdt.train_data.label,
+        gbdt.train_data.weight, gbdt.train_data.group, gbdt.cfg)
+
+    import jax.numpy as jnp
+
+    def route(it, k):
+        tree = gbdt.models[k][it]
+        leaf = leaf_preds[:, it * k_cls + k].astype(np.int64)
+        return (leaf, tree.num_leaves, tree.shrinkage,
+                np.asarray(tree.leaf_value, np.float64))
+
+    def store(it, k, new_leaf, counts, leaf, gk, hk):
+        tree = gbdt._host_cache[k][it]
+        nl = len(new_leaf)
+        tree.leaf_value = np.asarray(tree.leaf_value, np.float64).copy()
+        tree.leaf_value[:nl] = new_leaf
+        arrays = gbdt.dev_models[k][it]
+        lv = np.zeros(arrays.leaf_value.shape[0], np.float32)
+        lv[:nl] = new_leaf
+        gbdt.dev_models[k][it] = arrays._replace(leaf_value=jnp.asarray(lv))
+        return None
+
+    n_iters = min(len(m) for m in gbdt.models) if gbdt.models else 0
+    if ncol != n_iters * k_cls:
+        raise ValueError(
+            f"leaf_preds has {ncol} columns, model has {n_iters * k_cls}")
+    _refit_pass(nrow, k_cls, n_iters, gbdt.init_scores, objective,
+                gbdt.cfg, gbdt.cfg.refit_decay_rate, route, store)
+
+
+def booster_reset_training_data(handle, train_handle):
+    """reference LGBM_BoosterResetTrainingData; supported before the first
+    iteration (our booster binds device state at construction)."""
+    if handle._bst is not None and handle._bst._gbdt.iter_ > 0:
+        raise ValueError(
+            "ResetTrainingData after training started is not supported; "
+            "save the model and continue with init_model instead")
+    handle.train = train_handle
+    handle._bst = None
+
+
+def dataset_get_field(handle, name):
+    ds = handle.dataset
+    if name == "label":
+        v = ds.label
+        dt = 0
+    elif name == "weight":
+        v, dt = ds.weight, 0
+    elif name in ("group", "query"):
+        v, dt = ds.group, 2
+    elif name == "init_score":
+        v, dt = ds.init_score, 1
+    elif name == "position":
+        v, dt = ds.position, 2
+    else:
+        raise ValueError(f"unknown field {name!r}")
+    if v is None:
+        return b"", 0, dt
+    np_t = {0: np.float32, 1: np.float64, 2: np.int32}[dt]
+    out = np.ascontiguousarray(np.asarray(v).reshape(-1), np_t)
+    raw = out.tobytes()
+    # Every fetched field's buffer stays alive for the handle's lifetime
+    # (the reference hands out pointers into the Dataset's own storage, so
+    # fetching a second field must not invalidate the first).
+    if not hasattr(handle, "_field_bufs"):
+        handle._field_bufs = {}
+    handle._field_bufs[name] = raw
+    return raw, out.size, dt
+
+
+def dataset_get_feature_num_bin(handle, feature_idx):
+    td = handle.dataset.construct()
+    return int(np.asarray(td.binned.num_bins_per_feature)[feature_idx])
+
+
+def dataset_get_subset(handle, indices_mv, n_idx, params):
+    idx = np.frombuffer(indices_mv, np.int32, count=n_idx)
+    sub = handle.dataset.subset(idx, params=_parse_params(params))
+    return _CApiDataset(sub)
+
+
+def dataset_add_features_from(handle, other):
+    handle.dataset.add_features_from(other.dataset)
+
+
+def dataset_update_param_checking(old_params, new_params):
+    """reference LGBM_DatasetUpdateParamChecking: error when a
+    dataset-shaping parameter changes."""
+    frozen = ("max_bin", "min_data_in_bin", "bin_construct_sample_cnt",
+              "use_missing", "zero_as_missing", "categorical_feature",
+              "feature_pre_filter", "max_bin_by_feature")
+    old = _parse_params(old_params)
+    new = _parse_params(new_params)
+    for k in frozen:
+        if k in new and new.get(k) != old.get(k):
+            raise ValueError(
+                f"cannot change {k} after Dataset construction (reference "
+                "Dataset::ValidateSampleSize parameter check)")
+
+
+def dataset_dump_text(handle, filename):
+    """reference LGBM_DatasetDumpText: binned values, one row per line."""
+    td = handle.dataset.construct()
+    np.savetxt(filename, np.asarray(td.binned.bins), fmt="%d",
+               delimiter="\t")
+
+
+def dump_param_aliases():
+    import json
+
+    from ..config import _PARAMS
+    out = {}
+    for row in _PARAMS:
+        name, aliases = row[0], row[3]
+        if aliases:
+            out[name] = list(aliases)
+    return json.dumps(out)
+
+
+_max_threads = -1
+
+
+def get_max_threads():
+    return int(_max_threads)
+
+
+def set_max_threads(n):
+    """XLA owns threading on this build; the value is recorded for parity
+    (reference LGBM_SetMaxThreads caps OMP threads)."""
+    global _max_threads
+    _max_threads = int(n)
+
+
+def get_sample_count(num_total_row, params):
+    p = _parse_params(params)
+    cnt = int(p.get("bin_construct_sample_cnt", 200000))
+    return min(cnt, int(num_total_row))
+
+
+def sample_indices(num_total_row, params):
+    p = _parse_params(params)
+    cnt = get_sample_count(num_total_row, params)
+    seed = int(p.get("data_random_seed", 1))
+    rng = np.random.RandomState(seed)
+    if cnt >= num_total_row:
+        idx = np.arange(num_total_row, dtype=np.int32)
+    else:
+        idx = np.sort(rng.choice(num_total_row, size=cnt,
+                                 replace=False).astype(np.int32))
+    return idx.tobytes(), len(idx)
+
+
+def register_log_callback(trampoline):
+    """Route Log output through a C callback (reference
+    LGBM_RegisterLogCallback); ``trampoline`` is a Python callable the C
+    layer builds around the function pointer, or None to restore the
+    default stdout logger."""
+    from ..utils.log import Log
+    Log.reset_callback(trampoline)
+
+
+def network_init(machines, local_listen_port, listen_time_out,
+                 num_machines):
+    """reference LGBM_NetworkInit -> our jax.distributed bootstrap
+    (parallel/distributed.py); no-op for num_machines <= 1."""
+    from ..config import Config
+    from ..parallel.distributed import init_distributed
+    cfg = Config({"machines": machines or "",
+                  "num_machines": int(num_machines),
+                  "local_listen_port": int(local_listen_port)})
+    rank, world = init_distributed(cfg)
+    return rank, world
+
+
+def network_free():
+    from ..parallel.distributed import shutdown
+    shutdown()
+
+
+def booster_predict_for_csc(handle, col_ptr_mv, col_ptr_type, indices_mv,
+                            data_mv, dtype_code, ncol_ptr, nelem, num_row,
+                            predict_type, start_iteration, num_iteration,
+                            params):
+    import scipy.sparse as sp
+    col_ptr = np.frombuffer(col_ptr_mv, dtype=_NP_DTYPES[col_ptr_type],
+                            count=ncol_ptr)
+    indices = np.frombuffer(indices_mv, dtype=np.int32, count=nelem)
+    data = np.frombuffer(data_mv, dtype=_NP_DTYPES[dtype_code],
+                         count=nelem).astype(np.float64)
+    X = sp.csc_matrix((data, indices, col_ptr),
+                      shape=(num_row, ncol_ptr - 1)).tocsr()
+    X = np.asarray(X.todense(), np.float64)
+    return _predict_dispatch(handle, X, predict_type, start_iteration,
+                             num_iteration, params)

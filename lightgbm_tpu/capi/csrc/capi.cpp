@@ -875,6 +875,468 @@ int LGBM_FastConfigFree(FastConfigHandle fastConfig) {
   return 0;
 }
 
+// ------------------------------------------- extended parity surface (r4)
+
+// The GIL must be held BEFORE Py_BuildValue runs (ctypes releases it
+// around foreign calls), so the argument build has to happen inside the
+// locked scope — hence a macro, not a helper taking a built PyObject*.
+#define CALL_VOID_BRIDGE(fn, ...)                                   \
+  do {                                                              \
+    Gil gil_;                                                       \
+    if (!gil_.ok) return -1;                                        \
+    PyObject* r_ = bridge_call(fn, Py_BuildValue(__VA_ARGS__));     \
+    if (r_ == nullptr) return -1;                                   \
+    Py_DECREF(r_);                                                  \
+    return 0;                                                       \
+  } while (0)
+
+int LGBM_BoosterCalcNumPredict(BoosterHandle handle, int num_row,
+                               int predict_type, int start_iteration,
+                               int num_iteration, int64_t* out_len) {
+  Gil g;
+  if (!g.ok) return -1;
+  PyObject* r = bridge_call(
+      "booster_calc_num_predict",
+      Py_BuildValue("(Oiiii)", reinterpret_cast<PyObject*>(handle), num_row,
+                    predict_type, start_iteration, num_iteration));
+  if (r == nullptr) return -1;
+  *out_len = PyLong_AsLongLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_BoosterGetFeatureNames(BoosterHandle handle, const int len,
+                                int* out_len, const size_t buffer_len,
+                                size_t* out_buffer_len, char** out_strs) {
+  Gil g;
+  if (!g.ok) return -1;
+  PyObject* r = bridge_call(
+      "booster_get_feature_names",
+      Py_BuildValue("(O)", reinterpret_cast<PyObject*>(handle)));
+  if (r == nullptr) return -1;
+  int rc = copy_str_list_out(r, len, out_len, buffer_len, out_buffer_len,
+                             out_strs);
+  Py_DECREF(r);
+  return rc;
+}
+
+int LGBM_BoosterValidateFeatureNames(BoosterHandle handle,
+                                     const char** data_names,
+                                     int data_num_features) {
+  Gil g;
+  if (!g.ok) return -1;
+  PyObject* lst = PyList_New(data_num_features);
+  for (int i = 0; i < data_num_features; ++i) {
+    PyList_SetItem(lst, i, PyUnicode_FromString(data_names[i]));
+  }
+  PyObject* r = bridge_call(
+      "booster_validate_feature_names",
+      Py_BuildValue("(ON)", reinterpret_cast<PyObject*>(handle), lst));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_BoosterGetLinear(BoosterHandle handle, int* out) {
+  Gil g;
+  if (!g.ok) return -1;
+  PyObject* r = bridge_call(
+      "booster_get_linear",
+      Py_BuildValue("(O)", reinterpret_cast<PyObject*>(handle)));
+  if (r == nullptr) return -1;
+  *out = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_BoosterGetLoadedParam(BoosterHandle handle, int64_t buffer_len,
+                               int64_t* out_len, char* out_str) {
+  Gil g;
+  if (!g.ok) return -1;
+  PyObject* r = bridge_call(
+      "booster_get_loaded_param",
+      Py_BuildValue("(O)", reinterpret_cast<PyObject*>(handle)));
+  if (r == nullptr) return -1;
+  int rc = copy_str_out(r, buffer_len, out_len, out_str);
+  Py_DECREF(r);
+  return rc;
+}
+
+int LGBM_BoosterNumberOfTotalModel(BoosterHandle handle, int* out_models) {
+  Gil g;
+  if (!g.ok) return -1;
+  PyObject* r = bridge_call(
+      "booster_number_of_total_model",
+      Py_BuildValue("(O)", reinterpret_cast<PyObject*>(handle)));
+  if (r == nullptr) return -1;
+  *out_models = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_BoosterGetLeafValue(BoosterHandle handle, int tree_idx,
+                             int leaf_idx, double* out_val) {
+  Gil g;
+  if (!g.ok) return -1;
+  PyObject* r = bridge_call(
+      "booster_get_leaf_value",
+      Py_BuildValue("(Oii)", reinterpret_cast<PyObject*>(handle), tree_idx,
+                    leaf_idx));
+  if (r == nullptr) return -1;
+  *out_val = PyFloat_AsDouble(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_BoosterSetLeafValue(BoosterHandle handle, int tree_idx,
+                             int leaf_idx, double val) {
+  CALL_VOID_BRIDGE(
+      "booster_set_leaf_value", "(Oiid)", reinterpret_cast<PyObject*>(handle), tree_idx,
+                    leaf_idx, val);
+}
+
+static int bound_value(BoosterHandle handle, int upper, double* out) {
+  Gil g;
+  if (!g.ok) return -1;
+  PyObject* r = bridge_call(
+      "booster_get_bound_value",
+      Py_BuildValue("(Oi)", reinterpret_cast<PyObject*>(handle), upper));
+  if (r == nullptr) return -1;
+  *out = PyFloat_AsDouble(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_BoosterGetUpperBoundValue(BoosterHandle handle,
+                                   double* out_results) {
+  return bound_value(handle, 1, out_results);
+}
+
+int LGBM_BoosterGetLowerBoundValue(BoosterHandle handle,
+                                   double* out_results) {
+  return bound_value(handle, 0, out_results);
+}
+
+int LGBM_BoosterGetNumPredict(BoosterHandle handle, int data_idx,
+                              int64_t* out_len) {
+  Gil g;
+  if (!g.ok) return -1;
+  PyObject* r = bridge_call(
+      "booster_get_num_predict",
+      Py_BuildValue("(Oi)", reinterpret_cast<PyObject*>(handle), data_idx));
+  if (r == nullptr) return -1;
+  *out_len = PyLong_AsLongLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_BoosterGetPredict(BoosterHandle handle, int data_idx,
+                           int64_t* out_len, double* out_result) {
+  Gil g;
+  if (!g.ok) return -1;
+  PyObject* r = bridge_call(
+      "booster_get_predict",
+      Py_BuildValue("(Oi)", reinterpret_cast<PyObject*>(handle), data_idx));
+  if (r == nullptr) return -1;
+  PyObject* raw = PyTuple_GetItem(r, 0);
+  int64_t n = PyLong_AsLongLong(PyTuple_GetItem(r, 1));
+  *out_len = n;
+  char* buf = PyBytes_AsString(raw);
+  if (buf != nullptr && out_result != nullptr) {
+    std::memcpy(out_result, buf, static_cast<size_t>(n) * sizeof(double));
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_BoosterUpdateOneIterCustom(BoosterHandle handle, const float* grad,
+                                    const float* hess, int* is_finished) {
+  Gil g;
+  if (!g.ok) return -1;
+  // row count comes from the bound training data
+  PyObject* nd = bridge_call(
+      "booster_train_num_data",
+      Py_BuildValue("(O)", reinterpret_cast<PyObject*>(handle)));
+  if (nd == nullptr) return -1;
+  long long n = PyLong_AsLongLong(nd);
+  Py_DECREF(nd);
+  PyObject* r = bridge_call(
+      "booster_update_one_iter_custom",
+      Py_BuildValue("(ONNi)", reinterpret_cast<PyObject*>(handle),
+                    mv_from(grad, static_cast<Py_ssize_t>(n) * 4),
+                    mv_from(hess, static_cast<Py_ssize_t>(n) * 4),
+                    static_cast<int>(n)));
+  if (r == nullptr) return -1;
+  *is_finished = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_BoosterShuffleModels(BoosterHandle handle, int start_iter,
+                              int end_iter) {
+  CALL_VOID_BRIDGE(
+      "booster_shuffle_models", "(Oii)", reinterpret_cast<PyObject*>(handle), start_iter,
+                    end_iter);
+}
+
+int LGBM_BoosterMerge(BoosterHandle handle, BoosterHandle other_handle) {
+  CALL_VOID_BRIDGE(
+      "booster_merge", "(OO)", reinterpret_cast<PyObject*>(handle),
+                    reinterpret_cast<PyObject*>(other_handle));
+}
+
+int LGBM_BoosterRefit(BoosterHandle handle, const int32_t* leaf_preds,
+                      int32_t nrow, int32_t ncol) {
+  CALL_VOID_BRIDGE(
+      "booster_refit", "(ONii)", reinterpret_cast<PyObject*>(handle),
+                    mv_from(leaf_preds,
+                            static_cast<Py_ssize_t>(nrow) * ncol * 4),
+                    nrow, ncol);
+}
+
+int LGBM_BoosterResetTrainingData(BoosterHandle handle,
+                                  DatasetHandle train_data) {
+  CALL_VOID_BRIDGE(
+      "booster_reset_training_data", "(OO)", reinterpret_cast<PyObject*>(handle),
+                    reinterpret_cast<PyObject*>(train_data));
+}
+
+int LGBM_DatasetGetField(DatasetHandle handle, const char* field_name,
+                         int* out_len, const void** out_ptr, int* out_type) {
+  Gil g;
+  if (!g.ok) return -1;
+  PyObject* r = bridge_call(
+      "dataset_get_field",
+      Py_BuildValue("(Os)", reinterpret_cast<PyObject*>(handle),
+                    field_name));
+  if (r == nullptr) return -1;
+  // The bridge keeps the bytes object alive on the handle
+  // (handle._field_bufs), so the returned pointer stays valid across
+  // further GetField calls, like the reference's Dataset-owned storage.
+  *out_ptr = PyBytes_AsString(PyTuple_GetItem(r, 0));
+  *out_len = static_cast<int>(PyLong_AsLong(PyTuple_GetItem(r, 1)));
+  *out_type = static_cast<int>(PyLong_AsLong(PyTuple_GetItem(r, 2)));
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_DatasetGetFeatureNumBin(DatasetHandle handle, int feature_idx,
+                                 int* out) {
+  Gil g;
+  if (!g.ok) return -1;
+  PyObject* r = bridge_call(
+      "dataset_get_feature_num_bin",
+      Py_BuildValue("(Oi)", reinterpret_cast<PyObject*>(handle),
+                    feature_idx));
+  if (r == nullptr) return -1;
+  *out = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_DatasetGetSubset(const DatasetHandle handle,
+                          const int32_t* used_row_indices,
+                          int32_t num_used_row_indices,
+                          const char* parameters, DatasetHandle* out) {
+  Gil g;
+  if (!g.ok) return -1;
+  PyObject* r = bridge_call(
+      "dataset_get_subset",
+      Py_BuildValue("(ONis)", reinterpret_cast<PyObject*>(handle),
+                    mv_from(used_row_indices,
+                            static_cast<Py_ssize_t>(num_used_row_indices)
+                                * 4),
+                    num_used_row_indices,
+                    parameters != nullptr ? parameters : ""));
+  if (r == nullptr) return -1;
+  *out = r;
+  return 0;
+}
+
+int LGBM_DatasetAddFeaturesFrom(DatasetHandle target, DatasetHandle source) {
+  CALL_VOID_BRIDGE(
+      "dataset_add_features_from", "(OO)", reinterpret_cast<PyObject*>(target),
+                    reinterpret_cast<PyObject*>(source));
+}
+
+int LGBM_DatasetUpdateParamChecking(const char* old_parameters,
+                                    const char* new_parameters) {
+  CALL_VOID_BRIDGE(
+      "dataset_update_param_checking", "(ss)", old_parameters != nullptr ? old_parameters : "",
+                    new_parameters != nullptr ? new_parameters : "");
+}
+
+int LGBM_DatasetDumpText(DatasetHandle handle, const char* filename) {
+  CALL_VOID_BRIDGE(
+      "dataset_dump_text", "(Os)", reinterpret_cast<PyObject*>(handle), filename);
+}
+
+int LGBM_DumpParamAliases(int64_t buffer_len, int64_t* out_len,
+                          char* out_str) {
+  Gil g;
+  if (!g.ok) return -1;
+  PyObject* r = bridge_call("dump_param_aliases", Py_BuildValue("()"));
+  if (r == nullptr) return -1;
+  int rc = copy_str_out(r, buffer_len, out_len, out_str);
+  Py_DECREF(r);
+  return rc;
+}
+
+int LGBM_GetMaxThreads(int* out) {
+  Gil g;
+  if (!g.ok) return -1;
+  PyObject* r = bridge_call("get_max_threads", Py_BuildValue("()"));
+  if (r == nullptr) return -1;
+  *out = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_SetMaxThreads(int num_threads) {
+  CALL_VOID_BRIDGE("set_max_threads", "(i)", num_threads);
+}
+
+int LGBM_GetSampleCount(int32_t num_total_row, const char* parameters,
+                        int* out) {
+  Gil g;
+  if (!g.ok) return -1;
+  PyObject* r = bridge_call(
+      "get_sample_count",
+      Py_BuildValue("(is)", num_total_row,
+                    parameters != nullptr ? parameters : ""));
+  if (r == nullptr) return -1;
+  *out = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_SampleIndices(int32_t num_total_row, const char* parameters,
+                       void* out, int32_t* out_len) {
+  Gil g;
+  if (!g.ok) return -1;
+  PyObject* r = bridge_call(
+      "sample_indices",
+      Py_BuildValue("(is)", num_total_row,
+                    parameters != nullptr ? parameters : ""));
+  if (r == nullptr) return -1;
+  PyObject* raw = PyTuple_GetItem(r, 0);
+  int64_t n = PyLong_AsLongLong(PyTuple_GetItem(r, 1));
+  *out_len = static_cast<int32_t>(n);
+  char* buf = PyBytes_AsString(raw);
+  if (buf != nullptr && out != nullptr) {
+    std::memcpy(out, buf, static_cast<size_t>(n) * 4);
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_SetLastError(const char* msg) {
+  g_last_error = msg != nullptr ? msg : "";
+  return 0;
+}
+
+// Log callback: the C function pointer is wrapped in a Python trampoline
+// via a tiny C-implemented callable.
+typedef void (*lgbm_log_cb)(const char*);
+static lgbm_log_cb g_log_cb = nullptr;
+
+static PyObject* log_trampoline(PyObject*, PyObject* args) {
+  const char* msg = nullptr;
+  if (!PyArg_ParseTuple(args, "s", &msg)) return nullptr;
+  if (g_log_cb != nullptr) g_log_cb(msg);
+  Py_RETURN_NONE;
+}
+
+static PyMethodDef g_log_def = {"lgbm_log_trampoline", log_trampoline,
+                                METH_VARARGS, nullptr};
+
+int LGBM_RegisterLogCallback(void (*callback)(const char*)) {
+  Gil g;
+  if (!g.ok) return -1;
+  g_log_cb = callback;
+  PyObject* fn;
+  if (callback == nullptr) {
+    // null restores the default stdout logger (reference behavior)
+    fn = Py_None;
+    Py_INCREF(fn);
+  } else {
+    fn = PyCFunction_New(&g_log_def, nullptr);
+    if (fn == nullptr) { set_error_from_python(); return -1; }
+  }
+  PyObject* r = bridge_call("register_log_callback",
+                            Py_BuildValue("(N)", fn));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_NetworkInit(const char* machines, int local_listen_port,
+                     int listen_time_out, int num_machines) {
+  CALL_VOID_BRIDGE(
+      "network_init", "(siii)", machines != nullptr ? machines : "",
+                    local_listen_port, listen_time_out, num_machines);
+}
+
+int LGBM_NetworkFree() {
+  CALL_VOID_BRIDGE("network_free", "()");
+}
+
+int LGBM_BoosterPredictForCSC(BoosterHandle handle, const void* col_ptr,
+                              int col_ptr_type, const int32_t* indices,
+                              const void* data, int data_type,
+                              int64_t ncol_ptr, int64_t nelem,
+                              int64_t num_row, int predict_type,
+                              int start_iteration, int num_iteration,
+                              const char* parameter, int64_t* out_len,
+                              double* out_result) {
+  Gil g;
+  if (!g.ok) return -1;
+  PyObject* r = bridge_call(
+      "booster_predict_for_csc",
+      Py_BuildValue(
+          "(ONiNNiLLLiiis)", reinterpret_cast<PyObject*>(handle),
+          mv_from(col_ptr, ncol_ptr * dtype_size(col_ptr_type)),
+          col_ptr_type, mv_from(indices, nelem * 4),
+          mv_from(data, nelem * dtype_size(data_type)), data_type,
+          static_cast<long long>(ncol_ptr), static_cast<long long>(nelem),
+          static_cast<long long>(num_row), predict_type, start_iteration,
+          num_iteration, parameter != nullptr ? parameter : ""));
+  if (r == nullptr) return -1;
+  PyObject* raw = PyTuple_GetItem(r, 0);
+  int64_t n = PyLong_AsLongLong(PyTuple_GetItem(r, 1));
+  *out_len = n;
+  char* buf = PyBytes_AsString(raw);
+  if (buf != nullptr && out_result != nullptr) {
+    std::memcpy(out_result, buf, static_cast<size_t>(n) * sizeof(double));
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_BoosterPredictForMatSingleRow(
+    BoosterHandle handle, const void* data, int data_type, int ncol,
+    int is_row_major, int predict_type, int start_iteration,
+    int num_iteration, const char* parameter, int64_t* out_len,
+    double* out_result) {
+  return LGBM_BoosterPredictForMat(handle, data, data_type, 1, ncol,
+                                   is_row_major, predict_type,
+                                   start_iteration, num_iteration, parameter,
+                                   out_len, out_result);
+}
+
+int LGBM_BoosterPredictForCSRSingleRow(
+    BoosterHandle handle, const void* indptr, int indptr_type,
+    const int32_t* indices, const void* data, int data_type, int64_t nindptr,
+    int64_t nelem, int64_t num_col, int predict_type, int start_iteration,
+    int num_iteration, const char* parameter, int64_t* out_len,
+    double* out_result) {
+  return LGBM_BoosterPredictForCSR(handle, indptr, indptr_type, indices,
+                                   data, data_type, nindptr, nelem, num_col,
+                                   predict_type, start_iteration,
+                                   num_iteration, parameter, out_len,
+                                   out_result);
+}
+
 int LGBM_CAPIVersion() { return 1; }
 
 }  // extern "C"

@@ -68,6 +68,31 @@ int LGBM_DatasetPushRowsByCSRWithMetadata(
     const double* init_score, const int32_t* query, int32_t tid);
 int LGBM_DatasetSetWaitForManualFinish(DatasetHandle dataset, int wait);
 int LGBM_DatasetMarkFinished(DatasetHandle dataset);
+int LGBM_DatasetGetField(DatasetHandle handle, const char* field_name,
+                         int* out_len, const void** out_ptr, int* out_type);
+int LGBM_DatasetGetFeatureNumBin(DatasetHandle handle, int feature_idx,
+                                 int* out);
+int LGBM_DatasetGetSubset(const DatasetHandle handle,
+                          const int32_t* used_row_indices,
+                          int32_t num_used_row_indices,
+                          const char* parameters, DatasetHandle* out);
+int LGBM_DatasetAddFeaturesFrom(DatasetHandle target, DatasetHandle source);
+int LGBM_DatasetUpdateParamChecking(const char* old_parameters,
+                                    const char* new_parameters);
+int LGBM_DatasetDumpText(DatasetHandle handle, const char* filename);
+int LGBM_DumpParamAliases(int64_t buffer_len, int64_t* out_len,
+                          char* out_str);
+int LGBM_GetMaxThreads(int* out);
+int LGBM_SetMaxThreads(int num_threads);
+int LGBM_GetSampleCount(int32_t num_total_row, const char* parameters,
+                        int* out);
+int LGBM_SampleIndices(int32_t num_total_row, const char* parameters,
+                       void* out, int32_t* out_len);
+int LGBM_SetLastError(const char* msg);
+int LGBM_RegisterLogCallback(void (*callback)(const char*));
+int LGBM_NetworkInit(const char* machines, int local_listen_port,
+                     int listen_time_out, int num_machines);
+int LGBM_NetworkFree(void);
 int LGBM_DatasetSetField(DatasetHandle handle, const char* field_name,
                          const void* field_data, int num_element, int type);
 int LGBM_DatasetSetFeatureNames(DatasetHandle handle,
@@ -117,6 +142,59 @@ int LGBM_BoosterPredictForMat(BoosterHandle handle, const void* data,
                               int start_iteration, int num_iteration,
                               const char* parameter, int64_t* out_len,
                               double* out_result);
+int LGBM_BoosterCalcNumPredict(BoosterHandle handle, int num_row,
+                               int predict_type, int start_iteration,
+                               int num_iteration, int64_t* out_len);
+int LGBM_BoosterGetFeatureNames(BoosterHandle handle, const int len,
+                                int* out_len, const size_t buffer_len,
+                                size_t* out_buffer_len, char** out_strs);
+int LGBM_BoosterValidateFeatureNames(BoosterHandle handle,
+                                     const char** data_names,
+                                     int data_num_features);
+int LGBM_BoosterGetLinear(BoosterHandle handle, int* out);
+int LGBM_BoosterGetLoadedParam(BoosterHandle handle, int64_t buffer_len,
+                               int64_t* out_len, char* out_str);
+int LGBM_BoosterNumberOfTotalModel(BoosterHandle handle, int* out_models);
+int LGBM_BoosterGetLeafValue(BoosterHandle handle, int tree_idx,
+                             int leaf_idx, double* out_val);
+int LGBM_BoosterSetLeafValue(BoosterHandle handle, int tree_idx,
+                             int leaf_idx, double val);
+int LGBM_BoosterGetUpperBoundValue(BoosterHandle handle,
+                                   double* out_results);
+int LGBM_BoosterGetLowerBoundValue(BoosterHandle handle,
+                                   double* out_results);
+int LGBM_BoosterGetNumPredict(BoosterHandle handle, int data_idx,
+                              int64_t* out_len);
+int LGBM_BoosterGetPredict(BoosterHandle handle, int data_idx,
+                           int64_t* out_len, double* out_result);
+int LGBM_BoosterUpdateOneIterCustom(BoosterHandle handle, const float* grad,
+                                    const float* hess, int* is_finished);
+int LGBM_BoosterShuffleModels(BoosterHandle handle, int start_iter,
+                              int end_iter);
+int LGBM_BoosterMerge(BoosterHandle handle, BoosterHandle other_handle);
+int LGBM_BoosterRefit(BoosterHandle handle, const int32_t* leaf_preds,
+                      int32_t nrow, int32_t ncol);
+int LGBM_BoosterResetTrainingData(BoosterHandle handle,
+                                  DatasetHandle train_data);
+int LGBM_BoosterPredictForCSC(BoosterHandle handle, const void* col_ptr,
+                              int col_ptr_type, const int32_t* indices,
+                              const void* data, int data_type,
+                              int64_t ncol_ptr, int64_t nelem,
+                              int64_t num_row, int predict_type,
+                              int start_iteration, int num_iteration,
+                              const char* parameter, int64_t* out_len,
+                              double* out_result);
+int LGBM_BoosterPredictForMatSingleRow(
+    BoosterHandle handle, const void* data, int data_type, int ncol,
+    int is_row_major, int predict_type, int start_iteration,
+    int num_iteration, const char* parameter, int64_t* out_len,
+    double* out_result);
+int LGBM_BoosterPredictForCSRSingleRow(
+    BoosterHandle handle, const void* indptr, int indptr_type,
+    const int32_t* indices, const void* data, int data_type, int64_t nindptr,
+    int64_t nelem, int64_t num_col, int predict_type, int start_iteration,
+    int num_iteration, const char* parameter, int64_t* out_len,
+    double* out_result);
 typedef void* FastConfigHandle;
 int LGBM_BoosterPredictForMatSingleRowFastInit(
     BoosterHandle handle, const int predict_type, const int start_iteration,

@@ -488,3 +488,325 @@ def test_capi_single_row_fast_predict():
     per_call_ms = (time.perf_counter() - t0) / 200 * 1e3
     assert per_call_ms < 1.0, f"{per_call_ms:.3f} ms/call"
     _check(lib, lib.LGBM_FastConfigFree(fast))
+
+
+def test_capi_extended_surface(tmp_path):
+    """Round-4 parity batch: metadata getters, leaf get/set, bounds, merge,
+    shuffle, refit, custom objective, subset, param aliases, sampling,
+    log callback (reference c_api.h declarations of the same names)."""
+    lib = _load()
+    rng = np.random.RandomState(11)
+    n, f = 900, 6
+    X = rng.randn(n, f)
+    y = (X[:, 0] + 0.4 * X[:, 1] > 0).astype(np.float64)
+    ds = _dataset_from_mat(lib, X, y)
+    bst = ctypes.c_void_p()
+    _check(lib, lib.LGBM_BoosterCreate(
+        ds, b"objective=binary num_leaves=15 verbosity=-1",
+        ctypes.byref(bst)))
+    fin = ctypes.c_int()
+    for _ in range(6):
+        _check(lib, lib.LGBM_BoosterUpdateOneIter(bst, ctypes.byref(fin)))
+
+    # CalcNumPredict / NumberOfTotalModel / GetLinear
+    n_pred = ctypes.c_int64()
+    _check(lib, lib.LGBM_BoosterCalcNumPredict(
+        bst, ctypes.c_int(50), ctypes.c_int(0), ctypes.c_int(0),
+        ctypes.c_int(-1), ctypes.byref(n_pred)))
+    assert n_pred.value == 50
+    _check(lib, lib.LGBM_BoosterCalcNumPredict(
+        bst, ctypes.c_int(50), ctypes.c_int(3), ctypes.c_int(0),
+        ctypes.c_int(-1), ctypes.byref(n_pred)))
+    assert n_pred.value == 50 * (f + 1)
+    total = ctypes.c_int()
+    _check(lib, lib.LGBM_BoosterNumberOfTotalModel(bst, ctypes.byref(total)))
+    assert total.value == 6
+    lin = ctypes.c_int()
+    _check(lib, lib.LGBM_BoosterGetLinear(bst, ctypes.byref(lin)))
+    assert lin.value == 0
+
+    # bounds bracket every prediction
+    lo, hi = ctypes.c_double(), ctypes.c_double()
+    _check(lib, lib.LGBM_BoosterGetLowerBoundValue(bst, ctypes.byref(lo)))
+    _check(lib, lib.LGBM_BoosterGetUpperBoundValue(bst, ctypes.byref(hi)))
+    Xp = np.ascontiguousarray(X[:100], np.float64)
+    out = (ctypes.c_double * 100)()
+    out_n = ctypes.c_int64()
+    _check(lib, lib.LGBM_BoosterPredictForMat(
+        bst, Xp.ctypes.data_as(ctypes.c_void_p), 1, ctypes.c_int32(100),
+        ctypes.c_int32(f), ctypes.c_int(1), ctypes.c_int(1), ctypes.c_int(0),
+        ctypes.c_int(-1), b"", ctypes.byref(out_n), out))
+    preds = np.array(out[:])
+    assert lo.value <= preds.min() + 1e-9
+    assert hi.value >= preds.max() - 1e-9
+
+    # leaf get/set round trip changes predictions
+    v = ctypes.c_double()
+    _check(lib, lib.LGBM_BoosterGetLeafValue(
+        bst, ctypes.c_int(0), ctypes.c_int(0), ctypes.byref(v)))
+    _check(lib, lib.LGBM_BoosterSetLeafValue(
+        bst, ctypes.c_int(0), ctypes.c_int(0),
+        ctypes.c_double(v.value + 1.0)))
+    v2 = ctypes.c_double()
+    _check(lib, lib.LGBM_BoosterGetLeafValue(
+        bst, ctypes.c_int(0), ctypes.c_int(0), ctypes.byref(v2)))
+    assert abs(v2.value - v.value - 1.0) < 1e-12
+    _check(lib, lib.LGBM_BoosterSetLeafValue(
+        bst, ctypes.c_int(0), ctypes.c_int(0), v))
+
+    # GetPredict over the training data matches batch predict
+    npred = ctypes.c_int64()
+    _check(lib, lib.LGBM_BoosterGetNumPredict(bst, ctypes.c_int(0),
+                                              ctypes.byref(npred)))
+    assert npred.value == n
+    trainp = (ctypes.c_double * n)()
+    _check(lib, lib.LGBM_BoosterGetPredict(bst, ctypes.c_int(0),
+                                           ctypes.byref(npred), trainp))
+    full = (ctypes.c_double * n)()
+    Xa = np.ascontiguousarray(X, np.float64)
+    _check(lib, lib.LGBM_BoosterPredictForMat(
+        bst, Xa.ctypes.data_as(ctypes.c_void_p), 1, ctypes.c_int32(n),
+        ctypes.c_int32(f), ctypes.c_int(1), ctypes.c_int(0), ctypes.c_int(0),
+        ctypes.c_int(-1), b"", ctypes.byref(out_n), full))
+    np.testing.assert_allclose(np.array(trainp[:]), np.array(full[:]),
+                               rtol=2e-3, atol=2e-3)
+
+    # refit with the model's own leaf assignments at decay 1 is a no-op
+    nleaf = (ctypes.c_double * (n * 6))()
+    _check(lib, lib.LGBM_BoosterPredictForMat(
+        bst, Xa.ctypes.data_as(ctypes.c_void_p), 1, ctypes.c_int32(n),
+        ctypes.c_int32(f), ctypes.c_int(1), ctypes.c_int(2), ctypes.c_int(0),
+        ctypes.c_int(-1), b"", ctypes.byref(out_n), nleaf))
+    leaf_preds = np.ascontiguousarray(
+        np.array(nleaf[: n * 6]).reshape(n, 6), np.int32)
+    _check(lib, lib.LGBM_BoosterRefit(
+        bst, leaf_preds.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        ctypes.c_int32(n), ctypes.c_int32(6)))
+
+    # shuffle + merge keep model count consistent
+    _check(lib, lib.LGBM_BoosterShuffleModels(bst, ctypes.c_int(0),
+                                              ctypes.c_int(-1)))
+    bst2 = ctypes.c_void_p()
+    _check(lib, lib.LGBM_BoosterCreate(
+        ds, b"objective=binary num_leaves=7 verbosity=-1",
+        ctypes.byref(bst2)))
+    for _ in range(2):
+        _check(lib, lib.LGBM_BoosterUpdateOneIter(bst2, ctypes.byref(fin)))
+    _check(lib, lib.LGBM_BoosterMerge(bst, bst2))
+    _check(lib, lib.LGBM_BoosterNumberOfTotalModel(bst, ctypes.byref(total)))
+    assert total.value == 8
+
+    # custom objective iteration
+    bst3 = ctypes.c_void_p()
+    _check(lib, lib.LGBM_BoosterCreate(
+        ds, b"objective=custom num_leaves=7 verbosity=-1",
+        ctypes.byref(bst3)))
+    grad = np.ascontiguousarray(rng.randn(n), np.float32)
+    hess = np.ascontiguousarray(np.ones(n), np.float32)
+    _check(lib, lib.LGBM_BoosterUpdateOneIterCustom(
+        bst3, grad.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        hess.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        ctypes.byref(fin)))
+    it = ctypes.c_int()
+    _check(lib, lib.LGBM_BoosterGetCurrentIteration(bst3, ctypes.byref(it)))
+    assert it.value == 1
+
+    # dataset helpers
+    nb = ctypes.c_int()
+    _check(lib, lib.LGBM_DatasetGetFeatureNumBin(ds, ctypes.c_int(0),
+                                                 ctypes.byref(nb)))
+    assert nb.value > 1
+    fl = ctypes.c_int()
+    ptr = ctypes.c_void_p()
+    ftype = ctypes.c_int()
+    _check(lib, lib.LGBM_DatasetGetField(
+        ds, b"label", ctypes.byref(fl), ctypes.byref(ptr),
+        ctypes.byref(ftype)))
+    assert fl.value == n and ftype.value == 0
+    # a second GetField must not invalidate the first pointer
+    w32 = np.ascontiguousarray(np.ones(n), np.float32)
+    _check(lib, lib.LGBM_DatasetSetField(
+        ds, b"weight", w32.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_int(n), 0))
+    wl = ctypes.c_int()
+    wptr = ctypes.c_void_p()
+    _check(lib, lib.LGBM_DatasetGetField(
+        ds, b"weight", ctypes.byref(wl), ctypes.byref(wptr),
+        ctypes.byref(ftype)))
+    lab = np.ctypeslib.as_array(
+        ctypes.cast(ptr, ctypes.POINTER(ctypes.c_float)), shape=(n,))
+    np.testing.assert_allclose(lab, y.astype(np.float32))
+    idx = np.ascontiguousarray(np.arange(0, n, 2), np.int32)
+    sub = ctypes.c_void_p()
+    _check(lib, lib.LGBM_DatasetGetSubset(
+        ds, idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        ctypes.c_int32(len(idx)), b"", ctypes.byref(sub)))
+    nd = ctypes.c_int32()
+    _check(lib, lib.LGBM_DatasetGetNumData(sub, ctypes.byref(nd)))
+    assert nd.value == len(idx)
+    rc = lib.LGBM_DatasetUpdateParamChecking(b"max_bin=255", b"max_bin=63")
+    assert rc == -1
+    _check(lib, lib.LGBM_DatasetUpdateParamChecking(
+        b"max_bin=255", b"learning_rate=0.2"))
+    txt = str(tmp_path / "dump.tsv")
+    _check(lib, lib.LGBM_DatasetDumpText(ds, txt.encode()))
+    assert len(open(txt).readlines()) == n
+
+    # param aliases / threads / sampling
+    buf = ctypes.create_string_buffer(1 << 20)
+    blen = ctypes.c_int64()
+    _check(lib, lib.LGBM_DumpParamAliases(
+        ctypes.c_int64(1 << 20), ctypes.byref(blen), buf))
+    import json
+    aliases = json.loads(buf.value.decode())
+    assert "num_leaves" in aliases
+    _check(lib, lib.LGBM_SetMaxThreads(4))
+    mt = ctypes.c_int()
+    _check(lib, lib.LGBM_GetMaxThreads(ctypes.byref(mt)))
+    assert mt.value == 4
+    sc = ctypes.c_int()
+    _check(lib, lib.LGBM_GetSampleCount(
+        ctypes.c_int32(10 ** 7), b"bin_construct_sample_cnt=5000",
+        ctypes.byref(sc)))
+    assert sc.value == 5000
+    sidx = (ctypes.c_int32 * 5000)()
+    slen = ctypes.c_int32()
+    _check(lib, lib.LGBM_SampleIndices(
+        ctypes.c_int32(10 ** 7), b"bin_construct_sample_cnt=5000",
+        sidx, ctypes.byref(slen)))
+    assert slen.value == 5000
+    arr = np.array(sidx[:])
+    assert (np.diff(arr) > 0).all() and arr.max() < 10 ** 7
+
+    # feature names + validation + loaded params
+    name_bufs = [ctypes.create_string_buffer(64) for _ in range(f)]
+    names = (ctypes.c_char_p * f)(*[
+        ctypes.cast(b, ctypes.c_char_p) for b in name_bufs])
+    nn = ctypes.c_int()
+    bl = ctypes.c_size_t()
+    _check(lib, lib.LGBM_BoosterGetFeatureNames(
+        bst, ctypes.c_int(f), ctypes.byref(nn), ctypes.c_size_t(64),
+        ctypes.byref(bl), names))
+    assert nn.value == f
+    _check(lib, lib.LGBM_BoosterValidateFeatureNames(bst, names,
+                                                     ctypes.c_int(f)))
+    rc = lib.LGBM_BoosterValidateFeatureNames(
+        bst, (ctypes.c_char_p * 1)(b"bogus"), ctypes.c_int(1))
+    assert rc == -1
+    pbuf = ctypes.create_string_buffer(1 << 16)
+    plen = ctypes.c_int64()
+    _check(lib, lib.LGBM_BoosterGetLoadedParam(
+        bst, ctypes.c_int64(1 << 16), ctypes.byref(plen), pbuf))
+    assert "num_leaves" in pbuf.value.decode()
+
+    # error report helpers + log callback
+    _check(lib, lib.LGBM_SetLastError(b"custom error"))
+    assert lib.LGBM_GetLastError().decode() == "custom error"
+    seen = []
+    CB = ctypes.CFUNCTYPE(None, ctypes.c_char_p)
+    cb = CB(lambda m: seen.append(m))
+    _check(lib, lib.LGBM_RegisterLogCallback(cb))
+    bst4 = ctypes.c_void_p()
+    # num_threads triggers a deterministic warning through Log
+    _check(lib, lib.LGBM_BoosterCreate(
+        ds, b"objective=binary num_leaves=7 verbosity=2 num_threads=4",
+        ctypes.byref(bst4)))
+    _check(lib, lib.LGBM_BoosterUpdateOneIter(bst4, ctypes.byref(fin)))
+    assert seen, "log callback never fired"
+    CB0 = ctypes.CFUNCTYPE(None, ctypes.c_char_p)
+    lib.LGBM_RegisterLogCallback(ctypes.cast(None, CB0))
+
+    # network facade: single-machine init is a no-op success
+    _check(lib, lib.LGBM_NetworkInit(b"", ctypes.c_int(0), ctypes.c_int(0),
+                                     ctypes.c_int(1)))
+    _check(lib, lib.LGBM_NetworkFree())
+
+
+def test_capi_predict_csc_and_single_row():
+    sp = pytest.importorskip("scipy.sparse")
+    lib = _load()
+    rng = np.random.RandomState(12)
+    n, f = 700, 7
+    X = rng.randn(n, f)
+    y = (X[:, 0] > 0).astype(np.float64)
+    ds = _dataset_from_mat(lib, X, y)
+    bst = ctypes.c_void_p()
+    _check(lib, lib.LGBM_BoosterCreate(
+        ds, b"objective=binary num_leaves=15 verbosity=-1",
+        ctypes.byref(bst)))
+    fin = ctypes.c_int()
+    for _ in range(5):
+        _check(lib, lib.LGBM_BoosterUpdateOneIter(bst, ctypes.byref(fin)))
+    Xp = np.ascontiguousarray(X[:40], np.float64)
+    ref = (ctypes.c_double * 40)()
+    out_n = ctypes.c_int64()
+    _check(lib, lib.LGBM_BoosterPredictForMat(
+        bst, Xp.ctypes.data_as(ctypes.c_void_p), 1, ctypes.c_int32(40),
+        ctypes.c_int32(f), ctypes.c_int(1), ctypes.c_int(0), ctypes.c_int(0),
+        ctypes.c_int(-1), b"", ctypes.byref(out_n), ref))
+
+    # CSC batch predict
+    csc = sp.csc_matrix(Xp)
+    ip = np.ascontiguousarray(csc.indptr, np.int32)
+    ind = np.ascontiguousarray(csc.indices, np.int32)
+    vals = np.ascontiguousarray(csc.data, np.float64)
+    out = (ctypes.c_double * 40)()
+    _check(lib, lib.LGBM_BoosterPredictForCSC(
+        bst, ip.ctypes.data_as(ctypes.c_void_p), 2,
+        ind.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        vals.ctypes.data_as(ctypes.c_void_p), 1,
+        ctypes.c_int64(len(ip)), ctypes.c_int64(csc.nnz),
+        ctypes.c_int64(40), ctypes.c_int(0), ctypes.c_int(0),
+        ctypes.c_int(-1), b"", ctypes.byref(out_n), out))
+    np.testing.assert_allclose(np.array(out[:]), np.array(ref[:]),
+                               rtol=1e-9)
+
+    # single-row variants
+    one = ctypes.c_double()
+    row = np.ascontiguousarray(Xp[3], np.float64)
+    _check(lib, lib.LGBM_BoosterPredictForMatSingleRow(
+        bst, row.ctypes.data_as(ctypes.c_void_p), 1, ctypes.c_int(f),
+        ctypes.c_int(1), ctypes.c_int(0), ctypes.c_int(0), ctypes.c_int(-1),
+        b"", ctypes.byref(out_n), ctypes.byref(one)))
+    np.testing.assert_allclose(one.value, ref[3], rtol=1e-9)
+    csr = sp.csr_matrix(Xp[3:4])
+    rip = np.ascontiguousarray(csr.indptr, np.int32)
+    rind = np.ascontiguousarray(csr.indices, np.int32)
+    rval = np.ascontiguousarray(csr.data, np.float64)
+    _check(lib, lib.LGBM_BoosterPredictForCSRSingleRow(
+        bst, rip.ctypes.data_as(ctypes.c_void_p), 2,
+        rind.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        rval.ctypes.data_as(ctypes.c_void_p), 1,
+        ctypes.c_int64(len(rip)), ctypes.c_int64(csr.nnz),
+        ctypes.c_int64(f), ctypes.c_int(0), ctypes.c_int(0),
+        ctypes.c_int(-1), b"", ctypes.byref(out_n), ctypes.byref(one)))
+    np.testing.assert_allclose(one.value, ref[3], rtol=1e-9)
+
+
+def test_capi_multiclass_tree_index_convention():
+    """tree_idx is iteration-major (it*num_class + k, reference c_api):
+    a get/set round trip must address the SAME tree."""
+    lib = _load()
+    rng = np.random.RandomState(13)
+    n, f = 600, 5
+    X = rng.randn(n, f)
+    y = rng.randint(0, 3, n).astype(np.float64)
+    ds = _dataset_from_mat(lib, X, y)
+    bst = ctypes.c_void_p()
+    _check(lib, lib.LGBM_BoosterCreate(
+        ds, b"objective=multiclass num_class=3 num_leaves=7 verbosity=-1",
+        ctypes.byref(bst)))
+    fin = ctypes.c_int()
+    for _ in range(2):
+        _check(lib, lib.LGBM_BoosterUpdateOneIter(bst, ctypes.byref(fin)))
+    for tree_idx in range(6):
+        v = ctypes.c_double()
+        _check(lib, lib.LGBM_BoosterGetLeafValue(
+            bst, ctypes.c_int(tree_idx), ctypes.c_int(0), ctypes.byref(v)))
+        _check(lib, lib.LGBM_BoosterSetLeafValue(
+            bst, ctypes.c_int(tree_idx), ctypes.c_int(0),
+            ctypes.c_double(v.value + 0.125)))
+        v2 = ctypes.c_double()
+        _check(lib, lib.LGBM_BoosterGetLeafValue(
+            bst, ctypes.c_int(tree_idx), ctypes.c_int(0), ctypes.byref(v2)))
+        assert abs(v2.value - v.value - 0.125) < 1e-12, tree_idx
