@@ -307,10 +307,9 @@ def dataset_get_feature_names(handle):
     return handle.dataset._feature_names()
 
 
-def dataset_set_field(handle, name, mv, dtype_code, num_element):
-    arr = np.frombuffer(mv, dtype=_NP_DTYPES[dtype_code],
-                        count=num_element).copy()
-    ds = handle.dataset
+def _set_field(ds, name, arr):
+    """Single field-name dispatch shared by the memoryview and Arrow
+    setters (reference Dataset::SetField)."""
     if name == "label":
         ds.set_label(arr)
     elif name == "weight":
@@ -318,12 +317,18 @@ def dataset_set_field(handle, name, mv, dtype_code, num_element):
     elif name in ("group", "query"):
         ds.set_group(arr)
     elif name == "init_score":
-        ds.init_score = arr
+        ds.init_score = np.asarray(arr, np.float64)
         ds._train_data = None  # invalidate like the other setters
     elif name == "position":
         ds.set_position(arr)
     else:
         raise ValueError(f"unknown field {name!r}")
+
+
+def dataset_set_field(handle, name, mv, dtype_code, num_element):
+    arr = np.frombuffer(mv, dtype=_NP_DTYPES[dtype_code],
+                        count=num_element).copy()
+    _set_field(handle.dataset, name, arr)
 
 
 def dataset_get_num_data(handle):
@@ -1023,5 +1028,49 @@ def booster_predict_for_csc(handle, col_ptr_mv, col_ptr_type, indices_mv,
     X = sp.csc_matrix((data, indices, col_ptr),
                       shape=(num_row, ncol_ptr - 1)).tocsr()
     X = np.asarray(X.todense(), np.float64)
+    return _predict_dispatch(handle, X, predict_type, start_iteration,
+                             num_iteration, params)
+
+
+# ------------------------------------------------- Arrow C data interface
+# (reference include/LightGBM/arrow.h + LGBM_DatasetCreateFromArrow /
+# LGBM_DatasetSetFieldFromArrow / LGBM_BoosterPredictForArrow).  The C
+# layer hands us addresses of SHALLOW COPIES with a no-op release, so
+# pyarrow's move-import never releases the caller's structures.
+
+def _arrow_batches_from_c(chunk_addrs, schema_addrs):
+    import pyarrow as pa
+    return [pa.RecordBatch._import_from_c(int(a), int(s))
+            for a, s in zip(chunk_addrs, schema_addrs)]
+
+
+def dataset_create_from_arrow(chunk_addrs, schema_addrs, params, reference):
+    import pyarrow as pa
+
+    from ..basic import Dataset
+    table = pa.Table.from_batches(
+        _arrow_batches_from_c(chunk_addrs, schema_addrs))
+    ref = reference.dataset if reference is not None else None
+    return _CApiDataset(Dataset(table, params=_parse_params(params),
+                                reference=ref))
+
+
+def dataset_set_field_from_arrow(handle, name, chunk_addrs, schema_addrs):
+    import pyarrow as pa
+    arrs = [pa.Array._import_from_c(int(a), int(s))
+            for a, s in zip(chunk_addrs, schema_addrs)]
+    vals = pa.chunked_array(arrs).to_numpy(zero_copy_only=False)
+    _set_field(handle.dataset, name, vals)
+
+
+def booster_predict_for_arrow(handle, chunk_addrs, schema_addrs,
+                              predict_type, start_iteration, num_iteration,
+                              params):
+    import pyarrow as pa
+
+    from ..basic import _arrow_to_mat
+    table = pa.Table.from_batches(
+        _arrow_batches_from_c(chunk_addrs, schema_addrs))
+    X = _arrow_to_mat(table)
     return _predict_dispatch(handle, X, predict_type, start_iteration,
                              num_iteration, params)

@@ -23,6 +23,7 @@
 #include <cstring>
 #include <mutex>
 #include <string>
+#include <vector>
 
 #ifndef LTPU_PKG_DIR
 #define LTPU_PKG_DIR ""
@@ -1335,6 +1336,172 @@ int LGBM_BoosterPredictForCSRSingleRow(
                                    predict_type, start_iteration,
                                    num_iteration, parameter, out_len,
                                    out_result);
+}
+
+// ------------------------------------------------ Arrow C data interface
+// Struct layouts are the stable Arrow C ABI (reference vendors the same
+// definitions in include/LightGBM/arrow.h).
+
+struct ArrowSchema {
+  const char* format;
+  const char* name;
+  const char* metadata;
+  int64_t flags;
+  int64_t n_children;
+  struct ArrowSchema** children;
+  struct ArrowSchema* dictionary;
+  void (*release)(struct ArrowSchema*);
+  void* private_data;
+};
+
+struct ArrowArray {
+  int64_t length;
+  int64_t null_count;
+  int64_t offset;
+  int64_t n_buffers;
+  int64_t n_children;
+  const void** buffers;
+  struct ArrowArray** children;
+  struct ArrowArray* dictionary;
+  void (*release)(struct ArrowArray*);
+  void* private_data;
+};
+
+namespace {
+
+// pyarrow's _import_from_c MOVES (it releases the source struct when the
+// imported object dies).  The LightGBM Arrow contract leaves ownership
+// with the caller, so each import gets a heap shallow copy with a no-op
+// release; the caller's buffers are only read during the call.
+void nop_release_array(struct ArrowArray* a) { a->release = nullptr; }
+void nop_release_schema(struct ArrowSchema* s) { s->release = nullptr; }
+
+ArrowArray* shallow_array(const ArrowArray* src) {
+  ArrowArray* c = new ArrowArray(*src);
+  c->release = nop_release_array;
+  c->private_data = nullptr;
+  return c;
+}
+
+ArrowSchema* shallow_schema(const ArrowSchema* src) {
+  ArrowSchema* c = new ArrowSchema(*src);
+  c->release = nop_release_schema;
+  c->private_data = nullptr;
+  return c;
+}
+
+// (addr_chunk_list, addr_schema_list) as Python lists of ints.  The
+// shells are tracked by the holder and deleted after the bridge call
+// returns — pyarrow imports (moves) them synchronously inside the call,
+// so by then the shells are dead husks (release already nulled).
+struct ArrowShells {
+  std::vector<ArrowArray*> arrays;
+  std::vector<ArrowSchema*> schemas;
+  ~ArrowShells() {
+    for (ArrowArray* a : arrays) delete a;
+    for (ArrowSchema* s : schemas) delete s;
+  }
+};
+
+int build_arrow_addr_lists(int64_t n_chunks, const ArrowArray* chunks,
+                           const ArrowSchema* schema, PyObject** out_arrs,
+                           PyObject** out_schemas, ArrowShells* shells) {
+  PyObject* arrs = PyList_New(n_chunks);
+  PyObject* schemas = PyList_New(n_chunks);
+  if (arrs == nullptr || schemas == nullptr) {
+    set_error_from_python();
+    Py_XDECREF(arrs);
+    Py_XDECREF(schemas);
+    return -1;
+  }
+  for (int64_t i = 0; i < n_chunks; ++i) {
+    ArrowArray* a = shallow_array(&chunks[i]);
+    ArrowSchema* s = shallow_schema(schema);
+    shells->arrays.push_back(a);
+    shells->schemas.push_back(s);
+    PyList_SetItem(arrs, i, PyLong_FromVoidPtr(a));
+    PyList_SetItem(schemas, i, PyLong_FromVoidPtr(s));
+  }
+  *out_arrs = arrs;
+  *out_schemas = schemas;
+  return 0;
+}
+
+}  // namespace
+
+int LGBM_DatasetCreateFromArrow(int64_t n_chunks, const ArrowArray* chunks,
+                                const ArrowSchema* schema,
+                                const char* parameters,
+                                const DatasetHandle reference,
+                                DatasetHandle* out) {
+  Gil g;
+  if (!g.ok) return -1;
+  PyObject *arrs, *schemas;
+  ArrowShells shells;
+  if (build_arrow_addr_lists(n_chunks, chunks, schema, &arrs, &schemas,
+                             &shells))
+    return -1;
+  PyObject* ref = reference != nullptr
+                      ? reinterpret_cast<PyObject*>(reference)
+                      : Py_None;
+  Py_INCREF(ref);
+  PyObject* r = bridge_call(
+      "dataset_create_from_arrow",
+      Py_BuildValue("(NNsN)", arrs, schemas,
+                    parameters != nullptr ? parameters : "", ref));
+  if (r == nullptr) return -1;
+  *out = r;
+  return 0;
+}
+
+int LGBM_DatasetSetFieldFromArrow(DatasetHandle handle,
+                                  const char* field_name, int64_t n_chunks,
+                                  const ArrowArray* chunks,
+                                  const ArrowSchema* schema) {
+  Gil g;
+  if (!g.ok) return -1;
+  PyObject *arrs, *schemas;
+  ArrowShells shells;
+  if (build_arrow_addr_lists(n_chunks, chunks, schema, &arrs, &schemas,
+                             &shells))
+    return -1;
+  PyObject* r = bridge_call(
+      "dataset_set_field_from_arrow",
+      Py_BuildValue("(OsNN)", reinterpret_cast<PyObject*>(handle),
+                    field_name, arrs, schemas));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_BoosterPredictForArrow(BoosterHandle handle, int64_t n_chunks,
+                                const ArrowArray* chunks,
+                                const ArrowSchema* schema, int predict_type,
+                                int start_iteration, int num_iteration,
+                                const char* parameter, int64_t* out_len,
+                                double* out_result) {
+  Gil g;
+  if (!g.ok) return -1;
+  PyObject *arrs, *schemas;
+  ArrowShells shells;
+  if (build_arrow_addr_lists(n_chunks, chunks, schema, &arrs, &schemas,
+                             &shells))
+    return -1;
+  PyObject* r = bridge_call(
+      "booster_predict_for_arrow",
+      Py_BuildValue("(ONNiiis)", reinterpret_cast<PyObject*>(handle), arrs,
+                    schemas, predict_type, start_iteration, num_iteration,
+                    parameter != nullptr ? parameter : ""));
+  if (r == nullptr) return -1;
+  PyObject* raw = PyTuple_GetItem(r, 0);
+  int64_t n = PyLong_AsLongLong(PyTuple_GetItem(r, 1));
+  *out_len = n;
+  char* buf = PyBytes_AsString(raw);
+  if (buf != nullptr && out_result != nullptr) {
+    std::memcpy(out_result, buf, static_cast<size_t>(n) * sizeof(double));
+  }
+  Py_DECREF(r);
+  return 0;
 }
 
 int LGBM_CAPIVersion() { return 1; }

@@ -93,6 +93,26 @@ int LGBM_RegisterLogCallback(void (*callback)(const char*));
 int LGBM_NetworkInit(const char* machines, int local_listen_port,
                      int listen_time_out, int num_machines);
 int LGBM_NetworkFree(void);
+
+/* Arrow C data interface (stable ABI struct layouts) */
+struct ArrowSchema;
+struct ArrowArray;
+int LGBM_DatasetCreateFromArrow(int64_t n_chunks,
+                                const struct ArrowArray* chunks,
+                                const struct ArrowSchema* schema,
+                                const char* parameters,
+                                const DatasetHandle reference,
+                                DatasetHandle* out);
+int LGBM_DatasetSetFieldFromArrow(DatasetHandle handle,
+                                  const char* field_name, int64_t n_chunks,
+                                  const struct ArrowArray* chunks,
+                                  const struct ArrowSchema* schema);
+int LGBM_BoosterPredictForArrow(BoosterHandle handle, int64_t n_chunks,
+                                const struct ArrowArray* chunks,
+                                const struct ArrowSchema* schema,
+                                int predict_type, int start_iteration,
+                                int num_iteration, const char* parameter,
+                                int64_t* out_len, double* out_result);
 int LGBM_DatasetSetField(DatasetHandle handle, const char* field_name,
                          const void* field_data, int num_element, int type);
 int LGBM_DatasetSetFeatureNames(DatasetHandle handle,

@@ -810,3 +810,99 @@ def test_capi_multiclass_tree_index_convention():
         _check(lib, lib.LGBM_BoosterGetLeafValue(
             bst, ctypes.c_int(tree_idx), ctypes.c_int(0), ctypes.byref(v2)))
         assert abs(v2.value - v.value - 0.125) < 1e-12, tree_idx
+
+
+def test_capi_arrow_interface():
+    """Arrow C data interface (reference arrow.h + the three LGBM_*Arrow
+    entry points): export pyarrow batches to C structs, create a dataset,
+    set a field, train, and predict — all through raw Arrow pointers.
+    Caller keeps struct ownership (shallow copies with no-op release)."""
+    pa = pytest.importorskip("pyarrow")
+    lib = _load()
+    rng = np.random.RandomState(14)
+    n, f = 800, 5
+    X = rng.randn(n, f)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float64)
+    table = pa.table({f"f{j}": X[:, j] for j in range(f)})
+    batches = table.to_batches(max_chunksize=300)
+
+    class ArrowArray(ctypes.Structure):
+        _fields_ = [("length", ctypes.c_int64),
+                    ("null_count", ctypes.c_int64),
+                    ("offset", ctypes.c_int64),
+                    ("n_buffers", ctypes.c_int64),
+                    ("n_children", ctypes.c_int64),
+                    ("buffers", ctypes.c_void_p),
+                    ("children", ctypes.c_void_p),
+                    ("dictionary", ctypes.c_void_p),
+                    ("release", ctypes.c_void_p),
+                    ("private_data", ctypes.c_void_p)]
+
+    class ArrowSchema(ctypes.Structure):
+        _fields_ = [("format", ctypes.c_char_p),
+                    ("name", ctypes.c_char_p),
+                    ("metadata", ctypes.c_char_p),
+                    ("flags", ctypes.c_int64),
+                    ("n_children", ctypes.c_int64),
+                    ("children", ctypes.c_void_p),
+                    ("dictionary", ctypes.c_void_p),
+                    ("release", ctypes.c_void_p),
+                    ("private_data", ctypes.c_void_p)]
+
+    n_chunks = len(batches)
+    chunk_arr = (ArrowArray * n_chunks)()
+    schema = ArrowSchema()
+    # export schema once and every batch
+    batches[0]._export_to_c(ctypes.addressof(chunk_arr[0]),
+                            ctypes.addressof(schema))
+    for i in range(1, n_chunks):
+        batches[i]._export_to_c(ctypes.addressof(chunk_arr[i]))
+
+    ds = ctypes.c_void_p()
+    _check(lib, lib.LGBM_DatasetCreateFromArrow(
+        ctypes.c_int64(n_chunks), chunk_arr, ctypes.byref(schema),
+        b"max_bin=63", ctypes.c_void_p(), ctypes.byref(ds)))
+    nd = ctypes.c_int32()
+    _check(lib, lib.LGBM_DatasetGetNumData(ds, ctypes.byref(nd)))
+    assert nd.value == n
+
+    # label via Arrow
+    lab = pa.array(y.astype(np.float32))
+    lab_arr = ArrowArray()
+    lab_schema = ArrowSchema()
+    lab._export_to_c(ctypes.addressof(lab_arr),
+                     ctypes.addressof(lab_schema))
+    _check(lib, lib.LGBM_DatasetSetFieldFromArrow(
+        ds, b"label", ctypes.c_int64(1), ctypes.byref(lab_arr),
+        ctypes.byref(lab_schema)))
+
+    bst = ctypes.c_void_p()
+    _check(lib, lib.LGBM_BoosterCreate(
+        ds, b"objective=binary num_leaves=15 verbosity=-1 max_bin=63",
+        ctypes.byref(bst)))
+    fin = ctypes.c_int()
+    for _ in range(5):
+        _check(lib, lib.LGBM_BoosterUpdateOneIter(bst, ctypes.byref(fin)))
+
+    # predict through Arrow, compare against the Mat path
+    p_arrow = (ctypes.c_double * n)()
+    out_n = ctypes.c_int64()
+    chunk_arr2 = (ArrowArray * n_chunks)()
+    schema2 = ArrowSchema()
+    batches[0]._export_to_c(ctypes.addressof(chunk_arr2[0]),
+                            ctypes.addressof(schema2))
+    for i in range(1, n_chunks):
+        batches[i]._export_to_c(ctypes.addressof(chunk_arr2[i]))
+    _check(lib, lib.LGBM_BoosterPredictForArrow(
+        bst, ctypes.c_int64(n_chunks), chunk_arr2, ctypes.byref(schema2),
+        ctypes.c_int(0), ctypes.c_int(0), ctypes.c_int(-1), b"",
+        ctypes.byref(out_n), p_arrow))
+    assert out_n.value == n
+    Xa = np.ascontiguousarray(X, np.float64)
+    p_mat = (ctypes.c_double * n)()
+    _check(lib, lib.LGBM_BoosterPredictForMat(
+        bst, Xa.ctypes.data_as(ctypes.c_void_p), 1, ctypes.c_int32(n),
+        ctypes.c_int32(f), ctypes.c_int(1), ctypes.c_int(0), ctypes.c_int(0),
+        ctypes.c_int(-1), b"", ctypes.byref(out_n), p_mat))
+    np.testing.assert_allclose(np.array(p_arrow[:]), np.array(p_mat[:]),
+                               rtol=1e-9)

@@ -169,3 +169,85 @@ def test_two_process_data_parallel_matches_serial(tmp_path):
     np.testing.assert_allclose(got["leaf_value"],
                                np.asarray(tree.leaf_value),
                                rtol=1e-4, atol=1e-6)
+
+
+def _launcher_worker(rank, world, n, f):
+    """Train one data-parallel tree over the global mesh and return the
+    replicated split features (module-level: must pickle under spawn)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import lightgbm_tpu.models.grower as G
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.dataset import TrainData
+    from lightgbm_tpu.models.gbdt import _split_config
+    from lightgbm_tpu.parallel.distributed import global_mesh
+    from lightgbm_tpu.parallel.mesh import DATA_AXIS
+
+    mesh = global_mesh()
+    rng = np.random.RandomState(7)
+    X = rng.randn(n, f)
+    y = (X[:, 0] + 0.7 * X[:, 1] * X[:, 2] > 0).astype(np.float64)
+    cfg = Config({"objective": "binary", "num_leaves": 15,
+                  "min_data_in_leaf": 20, "verbosity": -1})
+    td = TrainData.build(X, y, cfg)
+    meta = td.feature_meta_device()
+    grow = G.make_grower(
+        G.GrowerConfig(num_leaves=15, num_bins=td.binned.max_num_bins,
+                       split=_split_config(cfg)),
+        mesh=mesh, data_axis=DATA_AXIS)
+    row = NamedSharding(mesh, P(DATA_AXIS))
+    rep = NamedSharding(mesh, P())
+    tree, _rl = grow(
+        jax.device_put(np.asarray(td.binned.bins),
+                       NamedSharding(mesh, P(DATA_AXIS, None))),
+        jax.device_put((0.5 - y).astype(np.float32), row),
+        jax.device_put(np.full(n, 0.25, np.float32), row),
+        jax.device_put(np.ones(n, np.float32), row),
+        jax.device_put(np.ones(f, bool), rep),
+        *[jax.device_put(np.asarray(meta[k]), rep)
+          for k in ("num_bins_per_feature", "nan_bins", "is_categorical",
+                    "monotone")])
+    return (int(tree.num_leaves),
+            np.asarray(tree.split_feature).tolist())
+
+
+def test_launcher_two_workers_match_serial():
+    """The dask-style launcher (reference dask.py _train: machine list +
+    per-worker jobs) runs the whole bootstrap + train + collect cycle."""
+    from lightgbm_tpu.parallel.launcher import launch
+
+    n, f = 8 * 2304, 10
+    results = launch(_launcher_worker, 2, args=(n, f),
+                     devices_per_worker=4, timeout=600)
+    assert len(results) == 2
+    assert results[0] == results[1]          # replicated tree state
+    nl, feats = results[0]
+    assert nl == 15
+
+    # single-process serial tree on the same data
+    import jax.numpy as jnp
+
+    import lightgbm_tpu.models.grower as G
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.dataset import TrainData
+    from lightgbm_tpu.models.gbdt import _split_config
+
+    rng = np.random.RandomState(7)
+    X = rng.randn(n, f)
+    y = (X[:, 0] + 0.7 * X[:, 1] * X[:, 2] > 0).astype(np.float64)
+    cfg = Config({"objective": "binary", "num_leaves": 15,
+                  "min_data_in_leaf": 20, "verbosity": -1})
+    td = TrainData.build(X, y, cfg)
+    meta = td.feature_meta_device()
+    tree, _ = G.make_grower(
+        G.GrowerConfig(num_leaves=15, num_bins=td.binned.max_num_bins,
+                       split=_split_config(cfg)))(
+        jnp.asarray(td.binned.bins),
+        jnp.asarray((0.5 - y).astype(np.float32)),
+        jnp.full(n, 0.25, jnp.float32), jnp.ones(n, jnp.float32),
+        jnp.ones(f, bool), meta["num_bins_per_feature"], meta["nan_bins"],
+        meta["is_categorical"], meta["monotone"])
+    assert feats == np.asarray(tree.split_feature).tolist()
