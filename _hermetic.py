@@ -1,51 +1,19 @@
-"""Force JAX onto the hermetic CPU platform with N virtual devices.
+"""Repo-root shim: the canonical implementation lives in the package
+(``lightgbm_tpu/utils/hermetic.py``) so library code — e.g. the
+multi-process launcher — can use it when installed.  Loaded here by FILE
+PATH, not package import: bench.py's outer watchdog process must be able
+to build child environments without importing lightgbm_tpu (whose
+package __init__ pulls in jax)."""
 
-Single canonical implementation shared by ``tests/conftest.py``,
-``__graft_entry__.py`` (multichip dry run) and ``bench.py`` (CPU fallback) —
-mirrors the reference's localhost mock-cluster pattern
-(``tests/distributed/_test_distributed.py:168-196``): sharding code is
-exercised on virtual CPU devices, no accelerator required.
+import importlib.util as _ilu
+import os as _os
 
-Two layers of override are needed because the environment's PJRT plugin boot
-hook (sitecustomize) force-sets ``jax_platforms`` to the accelerator:
-env vars (read by XLA at backend init) AND a ``jax.config.update`` after
-import (beats the hook's config write).
-"""
+_spec = _ilu.spec_from_file_location(
+    "lightgbm_tpu_hermetic_impl",
+    _os.path.join(_os.path.dirname(_os.path.abspath(__file__)),
+                  "lightgbm_tpu", "utils", "hermetic.py"))
+_mod = _ilu.module_from_spec(_spec)
+_spec.loader.exec_module(_mod)
 
-import os
-import re
-
-_COUNT_RE = re.compile(r"--xla_force_host_platform_device_count=\d+")
-
-
-def cpu_env(n_devices, env=None):
-    """Env-var dict forcing ``n_devices`` virtual CPU devices.
-
-    Pure (never imports jax) so a watchdog parent process can build a child
-    environment without touching the accelerator stack.  Replaces any existing
-    device-count flag instead of skipping, so an inherited XLA_FLAGS value
-    cannot pin the count to a stale number.
-    """
-    env = dict(os.environ if env is None else env)
-    env["JAX_PLATFORMS"] = "cpu"
-    flag = f"--xla_force_host_platform_device_count={n_devices}"
-    flags = env.get("XLA_FLAGS", "")
-    flags = _COUNT_RE.sub(flag, flags) if _COUNT_RE.search(flags) \
-        else (flags + " " + flag).strip()
-    env["XLA_FLAGS"] = flags
-    return env
-
-
-def force_cpu(n_devices):
-    """Force THIS process onto the hermetic CPU platform; returns jax.
-
-    Must run before jax's backend initializes (XLA_FLAGS is read exactly once
-    at backend init); importing jax beforehand is fine.
-    """
-    for key, val in cpu_env(n_devices).items():
-        os.environ[key] = val
-
-    import jax
-
-    jax.config.update("jax_platforms", "cpu")
-    return jax
+cpu_env = _mod.cpu_env
+force_cpu = _mod.force_cpu

@@ -371,14 +371,22 @@ class BinnedData:
 
     @classmethod
     def from_mappers(cls, X: np.ndarray, mappers: List[BinMapper]) -> "BinnedData":
-        n, f = X.shape
         max_b = max(max(m.num_bins for m in mappers), 2)
         dtype = np.uint8 if max_b <= 256 else np.uint16
+        return cls.from_prebinned(_bin_full_matrix(X, mappers, dtype),
+                                  mappers)
+
+    @classmethod
+    def from_prebinned(cls, bins: np.ndarray,
+                       mappers: List[BinMapper]) -> "BinnedData":
+        """Wrap an ALREADY-binned matrix (two-round streaming load bins
+        chunk-by-chunk; binary-cache reload stores bins directly)."""
+        f = len(mappers)
+        max_b = max(max(m.num_bins for m in mappers), 2)
         ub = np.full((f, max_b), np.inf, dtype=np.float32)
         nan_bins = np.full(f, max_b, dtype=np.int32)
         nbpf = np.empty(f, dtype=np.int32)
         is_cat = np.zeros(f, dtype=bool)
-        bins = _bin_full_matrix(X, mappers, dtype)
         for j, m in enumerate(mappers):
             nbpf[j] = m.num_bins
             is_cat[j] = m.is_categorical

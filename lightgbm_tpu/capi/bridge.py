@@ -1059,7 +1059,10 @@ def dataset_set_field_from_arrow(handle, name, chunk_addrs, schema_addrs):
     import pyarrow as pa
     arrs = [pa.Array._import_from_c(int(a), int(s))
             for a, s in zip(chunk_addrs, schema_addrs)]
-    vals = pa.chunked_array(arrs).to_numpy(zero_copy_only=False)
+    # copy=True matters: to_numpy can return a zero-copy VIEW into the
+    # caller's Arrow buffer, which is only guaranteed alive for this call
+    vals = np.array(pa.chunked_array(arrs).to_numpy(zero_copy_only=False),
+                    copy=True)
     _set_field(handle.dataset, name, vals)
 
 

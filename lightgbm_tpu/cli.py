@@ -52,6 +52,14 @@ def run(argv: List[str]) -> int:
         from .dataset import is_binary_dataset_file
         if is_binary_dataset_file(data_path):
             ds = Dataset(data_path, params=params)
+        elif cfg.two_round:
+            # two-round streaming load (reference two_round=true): never
+            # materializes the raw f64 matrix
+            from .dataset import load_train_data_two_round
+            td = load_train_data_two_round(data_path, cfg)
+            ds = Dataset(np.zeros((0, td.num_features)), label=td.label,
+                         params=params)
+            ds._train_data = td
         else:
             X, y, w, g = load_data_file(data_path, cfg.label_column,
                                         cfg.header)

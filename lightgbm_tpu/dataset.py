@@ -221,3 +221,103 @@ def is_binary_dataset_file(path) -> bool:
             return "magic" in d and int(d["magic"][0]) == 0x4C47424D
     except Exception:  # noqa: BLE001
         return False
+
+
+def load_train_data_two_round(path: str, cfg: Config, *,
+                              block_lines: int = 65536) -> TrainData:
+    """Two-round streaming text load (reference ``two_round=true``,
+    ``DatasetLoader::LoadFromFile`` -> ``SampleTextDataFromFile``,
+    ``dataset_loader.cpp:203,1022``): pass 1 reservoir-samples rows for
+    the bin mappers (uniform over the WHOLE file, like the reference's
+    stream sampler) and collects labels; pass 2 re-reads the file in
+    chunks and bins each chunk straight into the (N, F) bin matrix.  Peak
+    memory is the bin matrix + the sample + one f64 chunk — the raw
+    matrix never materializes.
+    """
+    from .binning import BinnedData, find_bin
+    from .io.parser import _side_files, iter_file_blocks
+
+    sample_cnt = cfg.bin_construct_sample_cnt
+    rng = np.random.RandomState(cfg.data_random_seed)
+
+    # ---- pass 1: count rows, collect labels + a uniform reservoir sample
+    n_total = 0
+    labels = []
+    reservoir: Optional[np.ndarray] = None
+    n_in_res = 0
+    max_f = 0
+    for Xb, yb in iter_file_blocks(path, cfg.label_column, cfg.header,
+                                   block_lines=block_lines):
+        labels.append(yb)
+        nb, fb = Xb.shape
+        if fb > max_f:                       # libsvm blocks can widen
+            if reservoir is not None:
+                reservoir = np.pad(reservoir,
+                                   ((0, 0), (0, fb - reservoir.shape[1])))
+            max_f = fb
+        if reservoir is None:
+            reservoir = np.zeros((sample_cnt, max_f))
+        Xp = (np.pad(Xb, ((0, 0), (0, max_f - fb))) if fb < max_f else Xb)
+        # vectorized Algorithm R: row with global index i replaces a
+        # random reservoir slot with probability sample_cnt / (i + 1)
+        fill = min(max(sample_cnt - n_in_res, 0), nb)
+        if fill:
+            reservoir[n_in_res: n_in_res + fill] = Xp[:fill]
+            n_in_res += fill
+        if fill < nb:
+            gidx = n_total + np.arange(fill, nb)
+            slots = (rng.rand(nb - fill) * (gidx + 1)).astype(np.int64)
+            keep = slots < sample_cnt
+            reservoir[slots[keep]] = Xp[fill:][keep]
+        n_total += nb
+    if n_total == 0:
+        raise ValueError(f"{path!r} contains no data rows")
+    sample = reservoir[:n_in_res]
+
+    cats = []
+    if cfg.categorical_feature:
+        cats = [int(c) for c in str(cfg.categorical_feature).split(",")
+                if str(c).strip().lstrip("-").isdigit()]
+    mbf = cfg.max_bin_by_feature
+    if mbf is not None and len(mbf) != max_f:
+        raise ValueError(
+            f"max_bin_by_feature has {len(mbf)} entries for {max_f} "
+            "features (reference requires an exact match)")
+    mappers = [find_bin(sample[:, j],
+                        int(mbf[j]) if mbf is not None else cfg.max_bin,
+                        cfg.min_data_in_bin,
+                        is_categorical=(j in set(cats)),
+                        use_missing=cfg.use_missing,
+                        zero_as_missing=cfg.zero_as_missing)
+               for j in range(max_f)]
+    del sample, reservoir
+    max_b = max(max(m.num_bins for m in mappers), 2)
+    dtype = np.uint8 if max_b <= 256 else np.uint16
+
+    # ---- pass 2: bin chunk-by-chunk into the final matrix
+    from .binning import _bin_full_matrix
+    bins = np.empty((n_total, max_f), dtype=dtype)
+    r0 = 0
+    for Xb, _yb in iter_file_blocks(path, cfg.label_column, cfg.header,
+                                    num_features=max_f,
+                                    block_lines=block_lines):
+        if Xb.shape[1] < max_f:
+            Xb = np.pad(Xb, ((0, 0), (0, max_f - Xb.shape[1])))
+        bins[r0: r0 + Xb.shape[0]] = _bin_full_matrix(Xb, mappers, dtype)
+        r0 += Xb.shape[0]
+
+    weight, group = _side_files(path)
+    mono = None
+    if cfg.monotone_constraints:
+        mono = np.zeros(max_f, np.int32)
+        mc = np.asarray(cfg.monotone_constraints, np.int32)
+        mono[: len(mc)] = mc
+    td = TrainData(
+        binned=BinnedData.from_prebinned(bins, mappers),
+        label=np.concatenate(labels),
+        weight=None if weight is None else np.asarray(weight, np.float32),
+        group=None if group is None else np.asarray(group, np.int64),
+        monotone_constraints=mono,
+    )
+    td._two_round_loaded = True
+    return td

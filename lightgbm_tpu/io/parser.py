@@ -74,23 +74,14 @@ def load_data_file(
     with open(path) as fh:
         lines = fh.read().splitlines()
     start = 1 if header else 0
-    fmt = _sniff_format(lines[start: start + 10])
+    fmt, sep, label_idx = _resolve_format_and_label(lines[:11], label_column,
+                                                    header)
     if fmt == "libsvm":
         X, y = _parse_libsvm(lines[start:], num_features)
     else:
-        sep = "\t" if fmt == "tsv" else ","
         data = np.asarray(
             [[_atof(v) for v in line.split(sep)]
              for line in lines[start:] if line.strip()])
-        label_idx = 0
-        if label_column.startswith("name:") and header:
-            names = lines[0].split(sep)
-            label_idx = names.index(label_column[5:])
-        elif label_column:
-            try:
-                label_idx = int(label_column)
-            except ValueError:
-                label_idx = 0
         y = data[:, label_idx]
         X = np.delete(data, label_idx, axis=1)
     return (X, y) + _side_files(path)
@@ -110,3 +101,60 @@ def _atof(tok: str) -> float:
     if tok == "" or tok.lower() in ("na", "nan", "null", "none"):
         return np.nan
     return float(tok)
+
+
+def _resolve_format_and_label(first_lines, label_column: str,
+                              header: bool):
+    """Shared sniff + label-column resolution for the one-shot and
+    two-round loaders (keeps their semantics identical by construction)."""
+    start = 1 if header else 0
+    fmt = _sniff_format(first_lines[start: start + 10])
+    sep = "\t" if fmt == "tsv" else ","
+    label_idx = 0
+    if label_column.startswith("name:") and header:
+        label_idx = first_lines[0].split(sep).index(label_column[5:])
+    elif label_column:
+        try:
+            label_idx = int(label_column)
+        except ValueError:
+            label_idx = 0
+    return fmt, sep, label_idx
+
+
+def iter_file_blocks(path: str, label_column: str = "", header: bool = False,
+                     num_features: Optional[int] = None,
+                     block_lines: int = 65536):
+    """Yield ``(X_block, y_block)`` f64 chunks without ever materializing
+    the full matrix (reference two-round loading,
+    ``DatasetLoader::LoadFromFile`` with ``two_round=true``,
+    ``dataset_loader.cpp:203``)."""
+    with open(path) as fh:
+        first = []
+        for _ in range(11):
+            ln = fh.readline()
+            if not ln:
+                break
+            first.append(ln.rstrip("\n"))
+    fmt, sep, label_idx = _resolve_format_and_label(first, label_column,
+                                                    header)
+
+    def parse_block(lines):
+        if fmt == "libsvm":
+            return _parse_libsvm(lines, num_features)
+        data = np.asarray([[_atof(v) for v in ln.split(sep)]
+                           for ln in lines if ln.strip()])
+        if data.size == 0:
+            return np.zeros((0, 0)), np.zeros(0)
+        return np.delete(data, label_idx, axis=1), data[:, label_idx]
+
+    with open(path) as fh:
+        if header:
+            fh.readline()
+        block = []
+        for ln in fh:
+            block.append(ln.rstrip("\n"))
+            if len(block) >= block_lines:
+                yield parse_block(block)
+                block = []
+        if block:
+            yield parse_block(block)

@@ -161,17 +161,23 @@ class GBDT:
                 "monotone_constraints_method=intermediate does not compose "
                 "with extra_trees / feature_fraction_bynode; use "
                 "monotone_constraints_method=basic")
-        # Storage-layout knobs with no TPU analog: two-pass text loading has
-        # no dense-HBM equivalent, and is_enable_sparse is subsumed by EFB
-        # (enable_bundle), which covers the sparse-column win here — say so
-        # loudly instead of silently ignoring them.
+        # is_enable_sparse is subsumed by EFB (enable_bundle), which covers
+        # the sparse-column win here — say so loudly instead of silently
+        # ignoring it.
         from ..utils.log import Log
-        for pname in ("is_enable_sparse", "two_round"):
+        for pname in ("is_enable_sparse",):
             if pname in cfg.raw_params:
                 Log.warning(
                     f"{pname} has no effect on the TPU build: bins are "
                     "stored as one dense (rows, features) device array and "
                     "sparse columns are handled by EFB (enable_bundle)")
+        if (cfg.two_round
+                and not getattr(train, "_two_round_loaded", False)):
+            Log.warning(
+                "two_round streaming applies to FILE input (CLI "
+                "data=<file> or dataset.load_train_data_two_round); this "
+                "dataset came from in-memory arrays, which are already "
+                "materialized")
         # Host-threading / histogram-memory / GPU-device knobs have no TPU
         # analog (XLA owns threading and fusion; leaf histograms live in
         # HBM; the device is the jax backend) — warn instead of silently

@@ -42,8 +42,8 @@ def _worker_main(rank: int, machines: str, num_machines: int,
         os.environ["LIGHTGBM_TPU_RANK"] = str(rank)
         if devices_per_worker:
             # must precede jax's backend init in this fresh process
-            import _hermetic
-            _hermetic.force_cpu(devices_per_worker)
+            from ..utils.hermetic import force_cpu
+            force_cpu(devices_per_worker)
         from ..config import Config
         from .distributed import init_distributed, shutdown
         got_rank, world = init_distributed(

@@ -114,9 +114,10 @@ ds.construct({"objective": "binary", "verbosity": -1,
               "enable_bundle": False})
 peak_mb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024
 print("PEAK_MB", peak_mb)
-# bins (100k x 2000 uint8) = 200 MB; jax/numpy baseline ~350 MB.
-# The dense-f64 path would add 1600 MB on top.
-sys.exit(0 if peak_mb < 1000 else 1)
+# bins (100k x 2000 uint8) = 200 MB; jax/numpy baseline ~350 MB; head-
+# room for allocator noise under concurrent test load.  The dense-f64
+# path would add 1600 MB on top of the baseline, far beyond the bound.
+sys.exit(0 if peak_mb < 1200 else 1)
 """
     r = subprocess.run([sys.executable, "-u", "-c", code],
                        capture_output=True, text=True, timeout=600,

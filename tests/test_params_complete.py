@@ -172,11 +172,10 @@ def test_cli_save_binary_and_train_from_bin(tmp_path):
 
 def test_inert_layout_params_warn(capsys):
     X, y = _reg_data(n=300)
-    lgb.train(dict(P, is_enable_sparse=False, two_round=True),
-              lgb.Dataset(X, label=y), 1)
+    lgb.train(dict(P, is_enable_sparse=False), lgb.Dataset(X, label=y), 1)
     err = capsys.readouterr()
     text = err.out + err.err
-    assert "is_enable_sparse" in text and "two_round" in text
+    assert "is_enable_sparse" in text
 
 
 def test_max_bin_by_feature_caps_per_feature():
@@ -250,3 +249,51 @@ def test_predict_shape_check_and_start_iteration_predict():
     a = bst.predict(X[:20], raw_score=True, start_iteration=3)
     b = bst.predict(X[:20], raw_score=True, start_iteration_predict=3)
     np.testing.assert_allclose(a, b)
+
+
+def test_two_round_loading_matches_direct(tmp_path):
+    """two_round=true streams the text file in chunks (pass 1: sample +
+    labels; pass 2: bin chunk-by-chunk) and must produce the same model as
+    the direct in-memory load (reference dataset_loader.cpp:203,1022)."""
+    import subprocess
+    import sys
+
+    rng = np.random.RandomState(6)
+    n, f = 9000, 8
+    X = rng.randn(n, f)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float64)
+    data = str(tmp_path / "tr.csv")
+    np.savetxt(data, np.column_stack([y, X]), delimiter=",", fmt="%.7g")
+
+    # loader-level equality: bins identical to the one-shot path
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.dataset import TrainData, load_train_data_two_round
+    from lightgbm_tpu.io.parser import load_data_file
+
+    cfg = Config({"objective": "binary", "verbosity": -1, "max_bin": 63})
+    td2 = load_train_data_two_round(data, cfg, block_lines=1000)
+    Xd, yd, _w, _g = load_data_file(data)
+    td1 = TrainData.build(Xd, yd, cfg)
+    np.testing.assert_array_equal(td1.binned.bins, td2.binned.bins)
+    np.testing.assert_allclose(td1.label, td2.label)
+
+    # CLI end-to-end with two_round=true
+    model = str(tmp_path / "m2r.txt")
+    r = subprocess.run(
+        [sys.executable, "-u", "-m", "lightgbm_tpu", "task=train",
+         f"data={data}", "objective=binary", "num_leaves=15",
+         "num_iterations=5", "two_round=true", "verbosity=-1",
+         "max_bin=63", f"output_model={model}"],
+        capture_output=True, text=True, timeout=600,
+        env={**os.environ, "LIGHTGBM_TPU_PLATFORM": "cpu",
+             "PYTHONPATH": os.pathsep.join(
+                 [os.path.dirname(os.path.dirname(os.path.abspath(
+                     __file__)))] + os.environ.get(
+                     "PYTHONPATH", "").split(os.pathsep))})
+    assert r.returncode == 0, r.stdout + r.stderr
+    loaded = lgb.Booster(model_file=model)
+    direct = lgb.train({"objective": "binary", "num_leaves": 15,
+                        "verbosity": -1, "max_bin": 63},
+                       lgb.Dataset(Xd, label=yd), 5)
+    np.testing.assert_allclose(loaded.predict(Xd), direct.predict(Xd),
+                               rtol=1e-5, atol=1e-6)
