@@ -1077,3 +1077,101 @@ def booster_predict_for_arrow(handle, chunk_addrs, schema_addrs,
     X = _arrow_to_mat(table)
     return _predict_dispatch(handle, X, predict_type, start_iteration,
                              num_iteration, params)
+
+
+# ------------------------------------ serialized reference + streaming init
+# (reference c_api.h:162-215: SerializeReferenceToBinary / ByteBuffer /
+# CreateFromSerializedReference / CreateFromSampledColumn / InitStreaming)
+
+def dataset_serialize_reference(handle):
+    """Serialize ONLY what a streaming consumer needs to align with this
+    dataset — the bin mappers + feature metadata, no rows."""
+    import io
+
+    from ..binning import mappers_to_arrays
+    td = handle.dataset.construct()
+    buf = io.BytesIO()
+    np.savez_compressed(buf, magic=np.asarray([0x4C475246]),  # 'LGRF'
+                        **mappers_to_arrays(td.binned.mappers))
+    return buf.getvalue()
+
+
+def dataset_create_from_serialized_reference(mv, buffer_size, num_row,
+                                             num_classes, params):
+    import io
+
+    from ..basic import Dataset
+    from ..binning import BinnedData, mappers_from_arrays
+    raw = bytes(mv[:buffer_size])
+    d = dict(np.load(io.BytesIO(raw), allow_pickle=False))
+    if int(d.pop("magic")[0]) != 0x4C475246:
+        raise ValueError("not a serialized lightgbm_tpu dataset reference")
+    mappers = mappers_from_arrays(d)
+    max_b = max(max(m.num_bins for m in mappers), 2)
+    dtype = np.uint8 if max_b <= 256 else np.uint16
+    skeleton = BinnedData.from_prebinned(
+        np.zeros((0, len(mappers)), dtype), mappers)
+    ref_ds = Dataset(np.zeros((0, len(mappers))))
+    from ..dataset import TrainData
+    ref_ds._train_data = TrainData(binned=skeleton, label=np.zeros(0))
+    ref_wrap = _CApiDataset(ref_ds)
+    w = dataset_create_by_reference(ref_wrap, num_row)
+    w.pending["params"] = _parse_params(params)
+    return w
+
+
+def dataset_create_from_sampled_column(col_vals_mvs, col_idx_mvs,
+                                       num_per_col, num_sample_row,
+                                       num_local_row, num_dist_row, params):
+    """reference LGBM_DatasetCreateFromSampledColumn: bin mappers from
+    per-column sampled (values, row-indices); rows arrive via PushRows."""
+    from ..basic import Dataset
+    from ..binning import BinnedData, find_bin
+    from ..config import Config
+    from ..dataset import TrainData
+
+    p = _parse_params(params)
+    cfg = Config(dict(p))
+    ncol = len(col_vals_mvs)
+    mappers = []
+    for j in range(ncol):
+        k = int(num_per_col[j])
+        vals = np.frombuffer(col_vals_mvs[j], np.float64, count=k)
+        col = np.zeros(num_sample_row, np.float64)
+        col[:k] = vals                        # order-invariant for find_bin
+        mappers.append(find_bin(col, cfg.max_bin, cfg.min_data_in_bin,
+                                use_missing=cfg.use_missing,
+                                zero_as_missing=cfg.zero_as_missing))
+    max_b = max(max(m.num_bins for m in mappers), 2)
+    dtype = np.uint8 if max_b <= 256 else np.uint16
+    skeleton = BinnedData.from_prebinned(
+        np.zeros((0, ncol), dtype), mappers)
+    ref_ds = Dataset(np.zeros((0, ncol)))
+    ref_ds._train_data = TrainData(binned=skeleton, label=np.zeros(0))
+    w = dataset_create_by_reference(_CApiDataset(ref_ds), num_local_row)
+    w.pending["params"] = p
+    return w
+
+
+def dataset_init_streaming(handle, has_weights, has_init_scores,
+                           has_queries, nclasses, nthreads,
+                           omp_max_threads):
+    """Metadata pre-allocation hints; push allocates lazily here, so this
+    validates the handle and records nothing (reference pre-sizes its
+    metadata buffers per thread)."""
+    if handle.pending is None:
+        raise RuntimeError("InitStreaming on a non-streaming dataset")
+
+
+def dataset_create_from_mats(mv_list, dtype_code, nrows, ncol,
+                             row_major_list, params, reference):
+    """reference LGBM_DatasetCreateFromMats: concatenate blocks."""
+    from ..basic import Dataset
+    blocks = [
+        _mat_from_memory(mv, dtype_code, int(nrows[i]), ncol,
+                         int(row_major_list[i]))
+        for i, mv in enumerate(mv_list)]
+    X = np.concatenate(blocks, axis=0) if blocks else np.zeros((0, ncol))
+    ref = reference.dataset if reference is not None else None
+    return _CApiDataset(Dataset(X, params=_parse_params(params),
+                                reference=ref))

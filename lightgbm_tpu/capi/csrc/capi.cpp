@@ -1504,6 +1504,156 @@ int LGBM_BoosterPredictForArrow(BoosterHandle handle, int64_t n_chunks,
   return 0;
 }
 
+// ---------------------------- serialized reference + mats + byte buffer
+
+typedef void* ByteBufferHandle;
+
+int LGBM_DatasetSerializeReferenceToBinary(DatasetHandle handle,
+                                           ByteBufferHandle* out,
+                                           int32_t* out_len) {
+  Gil g;
+  if (!g.ok) return -1;
+  PyObject* r = bridge_call(
+      "dataset_serialize_reference",
+      Py_BuildValue("(O)", reinterpret_cast<PyObject*>(handle)));
+  if (r == nullptr) return -1;
+  *out = r;  // the bytes object IS the buffer handle
+  *out_len = static_cast<int32_t>(PyBytes_Size(r));
+  return 0;
+}
+
+int LGBM_ByteBufferGetAt(ByteBufferHandle handle, int32_t index,
+                         uint8_t* out_val) {
+  Gil g;
+  if (!g.ok) return -1;
+  PyObject* b = reinterpret_cast<PyObject*>(handle);
+  if (index < 0 || index >= PyBytes_Size(b)) {
+    g_last_error = "ByteBufferGetAt index out of range";
+    return -1;
+  }
+  *out_val = static_cast<uint8_t>(PyBytes_AsString(b)[index]);
+  return 0;
+}
+
+int LGBM_ByteBufferFree(ByteBufferHandle handle) {
+  Gil g;
+  if (!g.ok) return -1;
+  Py_XDECREF(reinterpret_cast<PyObject*>(handle));
+  return 0;
+}
+
+int LGBM_DatasetCreateFromSerializedReference(const void* ref_buffer,
+                                              int32_t ref_buffer_size,
+                                              int64_t num_row,
+                                              int32_t num_classes,
+                                              const char* parameters,
+                                              DatasetHandle* out) {
+  Gil g;
+  if (!g.ok) return -1;
+  PyObject* r = bridge_call(
+      "dataset_create_from_serialized_reference",
+      Py_BuildValue("(NiLis)", mv_from(ref_buffer, ref_buffer_size),
+                    ref_buffer_size, static_cast<long long>(num_row),
+                    num_classes, parameters != nullptr ? parameters : ""));
+  if (r == nullptr) return -1;
+  *out = r;
+  return 0;
+}
+
+int LGBM_DatasetInitStreaming(DatasetHandle dataset, int32_t has_weights,
+                              int32_t has_init_scores, int32_t has_queries,
+                              int32_t nclasses, int32_t nthreads,
+                              int32_t omp_max_threads) {
+  CALL_VOID_BRIDGE(
+      "dataset_init_streaming", "(Oiiiiii)",
+      reinterpret_cast<PyObject*>(dataset), has_weights, has_init_scores,
+      has_queries, nclasses, nthreads, omp_max_threads);
+}
+
+int LGBM_DatasetCreateFromSampledColumn(double** sample_data,
+                                        int** sample_indices, int32_t ncol,
+                                        const int* num_per_col,
+                                        int32_t num_sample_row,
+                                        int32_t num_local_row,
+                                        int64_t num_dist_row,
+                                        const char* parameters,
+                                        DatasetHandle* out) {
+  Gil g;
+  if (!g.ok) return -1;
+  PyObject* vals = PyList_New(ncol);
+  PyObject* idxs = PyList_New(ncol);
+  PyObject* counts = PyList_New(ncol);
+  if (vals == nullptr || idxs == nullptr || counts == nullptr) {
+    set_error_from_python();
+    Py_XDECREF(vals);
+    Py_XDECREF(idxs);
+    Py_XDECREF(counts);
+    return -1;
+  }
+  for (int32_t j = 0; j < ncol; ++j) {
+    Py_ssize_t k = num_per_col[j];
+    PyList_SetItem(vals, j, mv_from(sample_data[j], k * 8));
+    PyList_SetItem(idxs, j, mv_from(sample_indices[j], k * 4));
+    PyList_SetItem(counts, j, PyLong_FromLong(num_per_col[j]));
+  }
+  PyObject* r = bridge_call(
+      "dataset_create_from_sampled_column",
+      Py_BuildValue("(NNNiiLs)", vals, idxs, counts, num_sample_row,
+                    num_local_row, static_cast<long long>(num_dist_row),
+                    parameters != nullptr ? parameters : ""));
+  if (r == nullptr) return -1;
+  *out = r;
+  return 0;
+}
+
+int LGBM_DatasetCreateFromMats(int32_t nmat, const void** data,
+                               int data_type, int32_t* nrow, int32_t ncol,
+                               int* is_row_major, const char* parameters,
+                               const DatasetHandle reference,
+                               DatasetHandle* out) {
+  Gil g;
+  if (!g.ok) return -1;
+  PyObject* mvs = PyList_New(nmat);
+  PyObject* nrows = PyList_New(nmat);
+  PyObject* majors = PyList_New(nmat);
+  for (int32_t i = 0; i < nmat; ++i) {
+    PyList_SetItem(mvs, i,
+                   mv_from(data[i], static_cast<Py_ssize_t>(nrow[i]) * ncol *
+                                        dtype_size(data_type)));
+    PyList_SetItem(nrows, i, PyLong_FromLong(nrow[i]));
+    PyList_SetItem(majors, i, PyLong_FromLong(is_row_major[i]));
+  }
+  PyObject* ref = reference != nullptr
+                      ? reinterpret_cast<PyObject*>(reference)
+                      : Py_None;
+  Py_INCREF(ref);
+  PyObject* r = bridge_call(
+      "dataset_create_from_mats",
+      Py_BuildValue("(NiNiNsN)", mvs, data_type, nrows, ncol, majors,
+                    parameters != nullptr ? parameters : "", ref));
+  if (r == nullptr) return -1;
+  *out = r;
+  return 0;
+}
+
+int LGBM_BoosterPredictForMats(BoosterHandle handle, const void** data,
+                               int data_type, int32_t nrow, int32_t ncol,
+                               int predict_type, int start_iteration,
+                               int num_iteration, const char* parameter,
+                               int64_t* out_len, double* out_result) {
+  // array of ROW pointers -> one contiguous copy, then the Mat path
+  Py_ssize_t esz = dtype_size(data_type);
+  std::vector<char> flat(static_cast<size_t>(nrow) * ncol * esz);
+  for (int32_t i = 0; i < nrow; ++i) {
+    std::memcpy(flat.data() + static_cast<size_t>(i) * ncol * esz, data[i],
+                static_cast<size_t>(ncol) * esz);
+  }
+  return LGBM_BoosterPredictForMat(handle, flat.data(), data_type, nrow,
+                                   ncol, 1, predict_type, start_iteration,
+                                   num_iteration, parameter, out_len,
+                                   out_result);
+}
+
 int LGBM_CAPIVersion() { return 1; }
 
 }  // extern "C"

@@ -94,6 +94,43 @@ int LGBM_NetworkInit(const char* machines, int local_listen_port,
                      int listen_time_out, int num_machines);
 int LGBM_NetworkFree(void);
 
+/* serialized dataset reference + byte buffer + multi-block creation */
+typedef void* ByteBufferHandle;
+int LGBM_DatasetSerializeReferenceToBinary(DatasetHandle handle,
+                                           ByteBufferHandle* out,
+                                           int32_t* out_len);
+int LGBM_ByteBufferGetAt(ByteBufferHandle handle, int32_t index,
+                         uint8_t* out_val);
+int LGBM_ByteBufferFree(ByteBufferHandle handle);
+int LGBM_DatasetCreateFromSerializedReference(const void* ref_buffer,
+                                              int32_t ref_buffer_size,
+                                              int64_t num_row,
+                                              int32_t num_classes,
+                                              const char* parameters,
+                                              DatasetHandle* out);
+int LGBM_DatasetInitStreaming(DatasetHandle dataset, int32_t has_weights,
+                              int32_t has_init_scores, int32_t has_queries,
+                              int32_t nclasses, int32_t nthreads,
+                              int32_t omp_max_threads);
+int LGBM_DatasetCreateFromSampledColumn(double** sample_data,
+                                        int** sample_indices, int32_t ncol,
+                                        const int* num_per_col,
+                                        int32_t num_sample_row,
+                                        int32_t num_local_row,
+                                        int64_t num_dist_row,
+                                        const char* parameters,
+                                        DatasetHandle* out);
+int LGBM_DatasetCreateFromMats(int32_t nmat, const void** data,
+                               int data_type, int32_t* nrow, int32_t ncol,
+                               int* is_row_major, const char* parameters,
+                               const DatasetHandle reference,
+                               DatasetHandle* out);
+int LGBM_BoosterPredictForMats(BoosterHandle handle, const void** data,
+                               int data_type, int32_t nrow, int32_t ncol,
+                               int predict_type, int start_iteration,
+                               int num_iteration, const char* parameter,
+                               int64_t* out_len, double* out_result);
+
 /* Arrow C data interface (stable ABI struct layouts) */
 struct ArrowSchema;
 struct ArrowArray;

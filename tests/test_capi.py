@@ -906,3 +906,99 @@ def test_capi_arrow_interface():
         ctypes.c_int(-1), b"", ctypes.byref(out_n), p_mat))
     np.testing.assert_allclose(np.array(p_arrow[:]), np.array(p_mat[:]),
                                rtol=1e-9)
+
+
+def test_capi_serialized_reference_and_mats():
+    """ByteBuffer reference serialization (c_api.h:162-215): serialize a
+    dataset's bin mappers, rebuild an aligned streaming dataset from the
+    buffer in a 'fresh worker', push rows, train — bins align with the
+    original.  Plus CreateFromMats and PredictForMats."""
+    lib = _load()
+    rng = np.random.RandomState(15)
+    n, f = 700, 6
+    X = rng.randn(n, f)
+    y = (X[:, 0] > 0).astype(np.float64)
+    ds = _dataset_from_mat(lib, X, y, params=b"max_bin=31")
+
+    buf = ctypes.c_void_p()
+    blen = ctypes.c_int32()
+    _check(lib, lib.LGBM_DatasetSerializeReferenceToBinary(
+        ds, ctypes.byref(buf), ctypes.byref(blen)))
+    assert blen.value > 64
+    # spot-check GetAt, then read the full buffer byte-by-byte (the
+    # reference's consumption pattern for shipping the buffer elsewhere)
+    one = ctypes.c_uint8()
+    full = bytearray(blen.value)
+    for i in range(blen.value):
+        _check(lib, lib.LGBM_ByteBufferGetAt(buf, ctypes.c_int32(i),
+                                             ctypes.byref(one)))
+        full[i] = one.value
+    full = bytes(full)
+    rc = lib.LGBM_ByteBufferGetAt(buf, ctypes.c_int32(blen.value),
+                                  ctypes.byref(one))
+    assert rc == -1                      # out-of-range errors, not crashes
+
+    stream = ctypes.c_void_p()
+    cbuf = (ctypes.c_char * len(full)).from_buffer_copy(full)
+    _check(lib, lib.LGBM_DatasetCreateFromSerializedReference(
+        cbuf, ctypes.c_int32(len(full)), ctypes.c_int64(n),
+        ctypes.c_int32(1), b"max_bin=31", ctypes.byref(stream)))
+    _check(lib, lib.LGBM_DatasetInitStreaming(
+        stream, 0, 0, 0, ctypes.c_int32(1), ctypes.c_int32(1),
+        ctypes.c_int32(1)))
+    Xa = np.ascontiguousarray(X, np.float64)
+    lab = np.ascontiguousarray(y, np.float32)
+    _check(lib, lib.LGBM_DatasetPushRowsWithMetadata(
+        stream, Xa.ctypes.data_as(ctypes.c_void_p), 1, ctypes.c_int32(n),
+        ctypes.c_int32(f), ctypes.c_int32(0),
+        lab.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), None, None,
+        None, ctypes.c_int32(0)))
+    _check(lib, lib.LGBM_DatasetMarkFinished(stream))
+    nb1 = ctypes.c_int()
+    nb2 = ctypes.c_int()
+    _check(lib, lib.LGBM_DatasetGetFeatureNumBin(ds, 0, ctypes.byref(nb1)))
+    _check(lib, lib.LGBM_DatasetGetFeatureNumBin(stream, 0,
+                                                 ctypes.byref(nb2)))
+    assert nb1.value == nb2.value
+    _check(lib, lib.LGBM_ByteBufferFree(buf))
+
+    # CreateFromMats: two blocks == one matrix
+    half = n // 2
+    b1 = np.ascontiguousarray(X[:half], np.float64)
+    b2 = np.ascontiguousarray(X[half:], np.float64)
+    ptrs = (ctypes.c_void_p * 2)(b1.ctypes.data_as(ctypes.c_void_p),
+                                 b2.ctypes.data_as(ctypes.c_void_p))
+    nrows = (ctypes.c_int32 * 2)(half, n - half)
+    majors = (ctypes.c_int * 2)(1, 1)
+    dmats = ctypes.c_void_p()
+    _check(lib, lib.LGBM_DatasetCreateFromMats(
+        ctypes.c_int32(2), ptrs, 1, nrows, ctypes.c_int32(f), majors,
+        b"max_bin=31", ctypes.c_void_p(), ctypes.byref(dmats)))
+    ndm = ctypes.c_int32()
+    _check(lib, lib.LGBM_DatasetGetNumData(dmats, ctypes.byref(ndm)))
+    assert ndm.value == n
+
+    # PredictForMats row-pointer batch == contiguous batch
+    bst = ctypes.c_void_p()
+    _check(lib, lib.LGBM_BoosterCreate(
+        ds, b"objective=binary num_leaves=7 verbosity=-1 max_bin=31",
+        ctypes.byref(bst)))
+    fin = ctypes.c_int()
+    for _ in range(3):
+        _check(lib, lib.LGBM_BoosterUpdateOneIter(bst, ctypes.byref(fin)))
+    rows = np.ascontiguousarray(X[:20], np.float64)
+    rptrs = (ctypes.c_void_p * 20)(*[
+        rows[i:i + 1].ctypes.data_as(ctypes.c_void_p) for i in range(20)])
+    outm = (ctypes.c_double * 20)()
+    out_n = ctypes.c_int64()
+    _check(lib, lib.LGBM_BoosterPredictForMats(
+        bst, rptrs, 1, ctypes.c_int32(20), ctypes.c_int32(f),
+        ctypes.c_int(0), ctypes.c_int(0), ctypes.c_int(-1), b"",
+        ctypes.byref(out_n), outm))
+    ref = (ctypes.c_double * 20)()
+    _check(lib, lib.LGBM_BoosterPredictForMat(
+        bst, rows.ctypes.data_as(ctypes.c_void_p), 1, ctypes.c_int32(20),
+        ctypes.c_int32(f), ctypes.c_int(1), ctypes.c_int(0), ctypes.c_int(0),
+        ctypes.c_int(-1), b"", ctypes.byref(out_n), ref))
+    np.testing.assert_allclose(np.array(outm[:]), np.array(ref[:]),
+                               rtol=1e-9)
