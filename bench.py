@@ -61,6 +61,10 @@ def _probe_backend():
 
     def probe():
         try:
+            if (os.environ.get("_BENCH_SIMULATE_WEDGE") == "1"
+                    and os.environ.get("_BENCH_FORCE_CPU") != "1"):
+                raise RuntimeError(
+                    "accelerator plugin wedged (simulated, test knob)")
             if os.environ.get("_BENCH_FORCE_CPU") == "1":
                 import _hermetic
                 jax = _hermetic.force_cpu(1)
@@ -233,6 +237,27 @@ def _run_child(env_extra, rows, iters, timeout):
     return None, f"child rc={proc.returncode}: {tail}"
 
 
+RESULT_FILE = os.environ.get(
+    "BENCH_RESULT_FILE",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                 "bench_result.json"))
+
+
+def _record(json_line, attempts_log):
+    """Persist the (current best) result + attempt log to a side file so the
+    measurement survives even if the driver's stream capture mangles stdout."""
+    try:
+        with open(RESULT_FILE, "w") as f:
+            json.dump({
+                "result": None if json_line is None else json.loads(json_line),
+                "attempts": attempts_log,
+                "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                             time.gmtime()),
+            }, f, indent=1)
+    except OSError:
+        pass
+
+
 def main():
     if os.environ.get("_BENCH_INNER") == "1":
         run_bench(ROWS, ITERS)
@@ -262,20 +287,28 @@ def main():
         prev_wedged = diag is not None and ("timed out" in diag
                                             or "wedged" in diag)
         if json_line is not None:
-            print(json_line)
-            sys.stdout.flush()
+            _record(json_line, errors)
+            # Diagnostics FIRST (flushed), then the metric JSON as the very
+            # last line: a merged stdout+stderr capture must end with the
+            # JSON (r04's result was lost to the reverse ordering).
             if errors:
                 print(f"bench: attempt(s) failed before success: {errors}",
                       file=sys.stderr)
+                sys.stderr.flush()
+            print(json_line)
+            sys.stdout.flush()
             return
         errors[name] = diag
-    print(json.dumps({
+        _record(None, errors)
+    fail_line = json.dumps({
         "metric": "binary_255leaves_row_iters_per_sec",
         "value": 0.0,
         "unit": "rows*iters/s",
         "vs_baseline": 0.0,
         "detail": {"error": "all bench attempts failed", "attempts": errors},
-    }))
+    })
+    _record(fail_line, errors)
+    print(fail_line)
     sys.stdout.flush()
     sys.exit(1)
 

@@ -763,6 +763,11 @@ def booster_get_predict(handle, data_idx):
     if gbdt.objective is not None:
         raw = np.asarray(jax.device_get(
             gbdt.objective.convert_output(jnp.asarray(raw))), np.float64)
+    # Reference layout is class-major: out[class*num_data + row]
+    # (GBDT::GetPredictAt, gbdt.cpp:665) — transpose the row-major (n, k)
+    # score matrix before flattening.
+    if raw.ndim == 2 and raw.shape[1] > 1:
+        raw = raw.T
     out = np.ascontiguousarray(raw.reshape(-1), np.float64)
     return out.tobytes(), out.size
 
@@ -778,6 +783,13 @@ def booster_train_num_data(handle):
 def booster_update_one_iter_custom(handle, grad_mv, hess_mv, n):
     grad = np.frombuffer(grad_mv, np.float32, count=n).copy()
     hess = np.frombuffer(hess_mv, np.float32, count=n).copy()
+    # The C contract is class-major: grad[class*num_data + row] (reference
+    # c_api.cpp LGBM_BoosterUpdateOneIterCustom -> GBDT::TrainOneIter).  Our
+    # trainer consumes row-major (num_data, num_class); transpose when k>1.
+    k = handle.bst.num_model_per_iteration()
+    if k > 1:
+        grad = np.ascontiguousarray(grad.reshape(k, -1).T)
+        hess = np.ascontiguousarray(hess.reshape(k, -1).T)
     return 1 if handle.bst._gbdt.train_one_iter(grad, hess) else 0
 
 
@@ -885,7 +897,10 @@ def dataset_get_field(handle, name):
     elif name == "weight":
         v, dt = ds.weight, 0
     elif name in ("group", "query"):
-        v, dt = ds.group, 2
+        # Reference LGBM_DatasetGetField returns CUMULATIVE query boundaries
+        # (num_queries+1 int32, query_boundaries_), not per-query sizes.
+        from ..dataset import query_boundaries
+        v, dt = query_boundaries(ds.group), 2
     elif name == "init_score":
         v, dt = ds.init_score, 1
     elif name == "position":

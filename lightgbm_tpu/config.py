@@ -314,7 +314,10 @@ class Config:
 
     @property
     def num_model_per_iteration(self) -> int:
-        if self.objective in ("multiclass", "multiclassova"):
+        # "custom" matches reference GBDT::Init: with a null objective the
+        # boosting order is num_class trees per iteration (gbdt.cpp), so a
+        # custom multiclass objective trains k trees from class-major grads.
+        if self.objective in ("multiclass", "multiclassova", "custom"):
             return self.num_class
         return 1
 

@@ -22,6 +22,14 @@ from .binning import BinnedData, bin_dataset
 from .config import Config
 
 
+def query_boundaries(group) -> Optional[np.ndarray]:
+    """Per-query sizes -> cumulative boundaries (len num_queries+1), the
+    reference's ``Metadata::query_boundaries_`` layout."""
+    if group is None:
+        return None
+    return np.concatenate([[0], np.cumsum(group)])
+
+
 @dataclasses.dataclass
 class TrainData:
     """Device-ready dataset (reference ``Dataset`` + ``CUDARowData``)."""
@@ -149,9 +157,7 @@ class TrainData:
         return self._meta_dev
 
     def query_boundaries(self) -> Optional[np.ndarray]:
-        if self.group is None:
-            return None
-        return np.concatenate([[0], np.cumsum(self.group)])
+        return query_boundaries(self.group)
 
     # ------------------------------------------------------------ binary cache
     def save_binary(self, path: str) -> None:

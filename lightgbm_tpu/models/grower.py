@@ -824,7 +824,17 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
         every shard scanned only its OWN features; the winner's SplitInfo
         (scalars + cat mask) is broadcast by a one-hot psum.  Local feature
         indices become global by adding the shard's offset.  Ties break to
-        the lowest shard, like the reference's rank order."""
+        the lowest shard, like the reference's rank order.
+
+        Precision note: the f32 payload transports counts/sums losslessly —
+        the psum has exactly one non-zero contributor per element, so the
+        received value bit-equals the sender's.  Counts are f32 BEFORE the
+        payload in every path (f32 histogram count channel, f32 cumsum in
+        the split scan, f32 GrowthState.leaf_count; the quantized path
+        converts int32→f32 in _scale_hist before scanning), so serial and
+        feature-parallel share the same >2^24 representation limit and
+        cannot drift apart at this sync.  The feature index rides exactly
+        up to 2^24 features."""
         def one(gain, feature, sbin, dl, ic, cmask, gl, hl, cl, gr, hr, cr):
             win = jax.lax.pmax(gain, faxis)
             sidx = jax.lax.axis_index(faxis)
@@ -1258,6 +1268,11 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
             if n_forced:
                 st = _record_forced_children(st, use_f, si, leaf, new_leaf)
             if inter:
+                # Safe with forced splits: this overwrites best_* for ALL
+                # leaves, but _apply_forced re-pins the pending forced
+                # directive at the START of the next step, so a forced
+                # split is never lost (test_forced_splits_survive_
+                # intermediate_monotone).
                 st = _inter_refresh(st, scale3, meta, feature_mask, cegb,
                                     groups_mat)
             return st

@@ -63,3 +63,30 @@ def test_forced_splits_reject_wave_config(tmp_path):
                      "forcedsplits_filename": str(path)},
                     lgb.Dataset(X, label=y), 2)
     assert bst._gbdt.models[0][0].split_feature[0] == 0
+
+
+def test_forced_splits_survive_intermediate_monotone(tmp_path):
+    """_inter_refresh overwrites best_* for all leaves at the end of each
+    growth step, but _apply_forced re-pins the pending forced directive at
+    the START of every step (grower.py body), so forced splits must still
+    land under monotone_constraints_method=intermediate."""
+    rng = np.random.RandomState(0)
+    n = 4000
+    X = rng.rand(n, 4).astype(np.float32)
+    y = 2 * X[:, 0] + np.sin(5 * X[:, 1]) + 0.5 * X[:, 2] \
+        + 0.1 * rng.randn(n)
+    path = tmp_path / "forced.json"
+    path.write_text(json.dumps({
+        "feature": 3, "threshold": 0.5,
+        "left": {"feature": 3, "threshold": 0.25}}))
+    for method in ("basic", "intermediate"):
+        params = {"objective": "regression", "num_leaves": 15,
+                  "monotone_constraints": [1, 0, 0, 0],
+                  "monotone_constraints_method": method,
+                  "forcedsplits_filename": str(path),
+                  "min_data_in_leaf": 5, "verbosity": -1}
+        bst = lgb.train(params, lgb.Dataset(X, label=y), 2)
+        for tree in bst._gbdt.models[0]:
+            assert tree.split_feature[0] == 3
+            assert tree.left_child[0] == 1
+            assert tree.split_feature[1] == 3
