@@ -144,22 +144,13 @@ class GBDT:
             raise ValueError(
                 f"unknown monotone_constraints_method={mono_method}; "
                 "expected basic, intermediate or advanced")
-        if has_mono and mono_method == "advanced":
-            # Reference advanced mode adds per-threshold constraint slices
-            # (AdvancedLeafConstraints, monotone_constraints.hpp:583) on
-            # top of intermediate; the per-leaf machinery here is the
-            # intermediate one, which is its superset-accuracy baseline.
-            _Log.warning(
-                "monotone_constraints_method=advanced: per-threshold "
-                "constraint slicing is not implemented; using the "
-                "intermediate per-leaf recomputation (its baseline)")
-            mono_method = "intermediate"
+        self._mono_advanced = has_mono and mono_method == "advanced"
         self._mono_intermediate = has_mono and mono_method == "intermediate"
-        if self._mono_intermediate and (cfg.extra_trees
-                                        or cfg.feature_fraction_bynode < 1.0):
+        if ((self._mono_intermediate or self._mono_advanced)
+                and (cfg.extra_trees or cfg.feature_fraction_bynode < 1.0)):
             raise ValueError(
-                "monotone_constraints_method=intermediate does not compose "
-                "with extra_trees / feature_fraction_bynode; use "
+                f"monotone_constraints_method={mono_method} does not "
+                "compose with extra_trees / feature_fraction_bynode; use "
                 "monotone_constraints_method=basic")
         # is_enable_sparse is subsumed by EFB (enable_bundle), which covers
         # the sparse-column win here — say so loudly instead of silently
@@ -258,16 +249,21 @@ class GBDT:
         if self.bundles is not None:
             Log.info(f"EFB: bundled {train.num_features} features into "
                      f"{self.bundles.num_groups} columns")
-        if self._mono_intermediate and leaf_batch > 1:
-            Log.warning("monotone_constraints_method=intermediate requires "
-                        "sequential leaf-wise growth; disabling wave "
-                        "batching (tpu_leaf_batch=1)")
+        mono_refresh = self._mono_intermediate or self._mono_advanced
+        if mono_refresh and leaf_batch > 1:
+            Log.warning("monotone_constraints_method=intermediate/advanced "
+                        "requires sequential leaf-wise growth; disabling "
+                        "wave batching (tpu_leaf_batch=1)")
             leaf_batch = 1
-        if self._mono_intermediate and voting:
+        if mono_refresh and voting:
             Log.warning("tree_learner=voting does not compose with "
-                        "monotone_constraints_method=intermediate; falling "
-                        "back to data-parallel")
+                        "monotone_constraints_method=intermediate/advanced; "
+                        "falling back to data-parallel")
             voting = False
+        if self._mono_advanced and forced:
+            raise ValueError(
+                "monotone_constraints_method=advanced does not compose "
+                "with forced_splits; use intermediate")
         self.grower_cfg = GrowerConfig(
             num_leaves=cfg.num_leaves,
             max_depth=cfg.max_depth,
@@ -290,6 +286,9 @@ class GBDT:
             vote_top_k=cfg.top_k,
             bundled=self.bundles is not None,
             mono_intermediate=self._mono_intermediate,
+            mono_advanced=self._mono_advanced,
+            mono_static=(tuple(int(m) for m in train.monotone_constraints)
+                         if self._mono_advanced else None),
         )
         from .grower import fp_capable_for
         if (self.mesh is not None and not data_only_mesh

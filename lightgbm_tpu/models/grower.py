@@ -121,6 +121,19 @@ class GrowerConfig:
     # growth only (leaf_batch=1): simultaneous wave splits of adjacent
     # leaves could violate each other's freshly-derived bounds.
     mono_intermediate: bool = False
+    # Advanced monotone mode (reference AdvancedLeafConstraints,
+    # monotone_constraints.hpp:583): on top of the intermediate per-step
+    # refresh, the split scan sees PER-THRESHOLD child output bounds — a
+    # neighbour's output only constrains the slice of the leaf's range that
+    # is actually adjacent to it.  The reference realises this with
+    # per-feature (threshold, constraint) slice lists plus cumulative
+    # min/max arrays; the TPU shape is dense (L, F, B) bound tensors built
+    # by vectorized scatter-min/max + cummin/cummax along the bin axis.
+    mono_advanced: bool = False
+    # Static per-feature monotone constraint vector (e.g. (-1, 0, 1, ...)),
+    # required by mono_advanced to unroll its per-monotone-feature
+    # constraint pass at trace time.
+    mono_static: Optional[Tuple[int, ...]] = None
 
 
 class TreeArrays(NamedTuple):
@@ -180,6 +193,10 @@ class _GrowState(NamedTuple):
     forced_leaf: jnp.ndarray     # (K,) i32 leaf of each pending forced split
     leaf_bin_lo: jnp.ndarray     # (L, F) i32 bin-rectangle bounds, or (1, 1)
     leaf_bin_hi: jnp.ndarray     #   dummies when mono_intermediate is off
+    adv_llo: jnp.ndarray         # (L,) advanced mode: output bounds of each
+    adv_lhi: jnp.ndarray         #   leaf's STORED best split's left/right
+    adv_rlo: jnp.ndarray         #   children, gathered at (feature, bin)
+    adv_rhi: jnp.ndarray         #   during refresh; (1,) dummies when off
     tree: TreeArrays
 
 
@@ -226,7 +243,8 @@ def fp_capable_for(cfg: GrowerConfig, mesh, data_axis: str) -> bool:
             and cfg.feature_fraction_bynode >= 1.0
             and not cfg.interaction_groups and not cfg.split.use_cegb
             and not n_forced and not cfg.bundled
-            and not (cfg.mono_intermediate and cfg.split.has_monotone))
+            and not ((cfg.mono_intermediate or cfg.mono_advanced)
+                     and cfg.split.has_monotone))
 
 
 def _split_buckets(n: int) -> list:
@@ -339,7 +357,7 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
     def _best_for_batch(histk, pgk, phk, pck, meta, feature_mask,
                         penaltyk=None, parent_outk=None, key=None,
                         pathk=None, groups_mat=None, boundsk=None,
-                        depthk=None):
+                        depthk=None, advk=None):
         """All k children's split searches in one vmapped program — one
         kernel set per wave instead of per child."""
         nbpf, nan_bins, is_cat, monotone = meta[:4]
@@ -359,7 +377,7 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
             depthk = jnp.zeros(k, jnp.int32)
 
         def one(hist, pg, ph, pc, penalty, pout, fmask, rand_bins, lo, hi,
-                dep):
+                dep, adv=None):
             return best_split(
                 hist, pg, ph, pc,
                 num_bins_per_feature=nbpf, nan_bins=nan_bins,
@@ -369,9 +387,27 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
                 rand_bins=rand_bins,
                 out_lo=lo if use_b else None,
                 out_hi=hi if use_b else None,
+                adv_bounds=adv,
                 leaf_depth=dep,
             )
 
+        if advk is not None:
+            # Advanced monotone refresh: per-leaf (F, B) child-bound slices
+            # ride along the vmap.  randk is statically None on this path
+            # (extra_trees / bynode are rejected by the inter/adv checks).
+            if penaltyk is None:
+                return jax.vmap(
+                    lambda h, g, hh, c, po, fm, lo, hi, dep, al, ah, bl, bh:
+                    one(h, g, hh, c, None, po, fm, None, lo, hi, dep,
+                        (al, ah, bl, bh)))(
+                    histk, pgk, phk, pck, parent_outk, fmaskk, lok, hik,
+                    depthk, *advk)
+            return jax.vmap(
+                lambda h, g, hh, c, pe, po, fm, lo, hi, dep, al, ah, bl, bh:
+                one(h, g, hh, c, pe, po, fm, None, lo, hi, dep,
+                    (al, ah, bl, bh)))(
+                histk, pgk, phk, pck, penaltyk, parent_outk, fmaskk, lok,
+                hik, depthk, *advk)
         if penaltyk is None and randk is None:
             return jax.vmap(
                 lambda h, g, hh, c, po, fm, lo, hi, dep: one(
@@ -409,18 +445,29 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
             fp_axis_name = others[0]
             fp_shards = int(mesh.shape[fp_axis_name])
 
-    inter = cfg.mono_intermediate and cfg.split.has_monotone
+    adv = cfg.mono_advanced and cfg.split.has_monotone
+    inter = (cfg.mono_intermediate or adv) and cfg.split.has_monotone
     fp_capable = fp_capable_for(cfg, mesh, data_axis)
     if inter and (cfg.leaf_batch > 1 or cfg.voting):
         raise ValueError(
-            "monotone_constraints_method=intermediate requires sequential "
-            "growth (leaf_batch=1, non-voting): simultaneous splits of "
-            "adjacent leaves could violate each other's fresh bounds")
+            "monotone_constraints_method=intermediate/advanced requires "
+            "sequential growth (leaf_batch=1, non-voting): simultaneous "
+            "splits of adjacent leaves could violate each other's fresh "
+            "bounds")
     if inter and need_key:
         raise ValueError(
-            "monotone_constraints_method=intermediate does not compose with "
-            "extra_trees / feature_fraction_bynode (the per-step best-split "
-            "refresh would re-draw their per-node randomness)")
+            "monotone_constraints_method=intermediate/advanced does not "
+            "compose with extra_trees / feature_fraction_bynode (the "
+            "per-step best-split refresh would re-draw their per-node "
+            "randomness)")
+    if adv and cfg.mono_static is None:
+        raise ValueError("mono_advanced requires the static "
+                         "monotone-constraint vector (mono_static)")
+    if adv and n_forced:
+        raise ValueError(
+            "monotone_constraints_method=advanced does not compose with "
+            "forced splits (the refresh-gathered child bounds would not "
+            "match a force-overwritten split); use intermediate")
     if cfg.voting and (use_rand or use_bynode or use_groups
                        or cfg.split.use_cegb):
         raise ValueError(
@@ -571,6 +618,10 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
             leaf_bin_lo=jnp.zeros((L, f) if inter else (1, 1), jnp.int32),
             leaf_bin_hi=(jnp.full((L, f), B, jnp.int32) if inter
                          else jnp.ones((1, 1), jnp.int32)),
+            adv_llo=jnp.full(L if adv else 1, -jnp.inf, jnp.float32),
+            adv_lhi=jnp.full(L if adv else 1, jnp.inf, jnp.float32),
+            adv_rlo=jnp.full(L if adv else 1, -jnp.inf, jnp.float32),
+            adv_rhi=jnp.full(L if adv else 1, jnp.inf, jnp.float32),
             tree=tree,
         )
 
@@ -628,8 +679,15 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
                             st.leaf_depth[leaf] + 1])
         if cfg.split.has_monotone:
             plo, phi = st.leaf_lo[leaf], st.leaf_hi[leaf]
-            out_l = jnp.clip(out_l, plo, phi)
-            out_r = jnp.clip(out_r, plo, phi)
+            if adv:
+                # Advanced mode: the executed split IS the stored best split,
+                # so clip each child to its refresh-gathered per-threshold
+                # bound (looser-or-equal than the whole-leaf scalar).
+                out_l = jnp.clip(out_l, st.adv_llo[leaf], st.adv_lhi[leaf])
+                out_r = jnp.clip(out_r, st.adv_rlo[leaf], st.adv_rhi[leaf])
+            else:
+                out_l = jnp.clip(out_l, plo, phi)
+                out_r = jnp.clip(out_r, plo, phi)
             if inter:
                 # Intermediate mode: children inherit the parent's bounds
                 # verbatim; the real bounds (and every leaf's refreshed
@@ -738,6 +796,151 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
             best_cl=st.best_cl.at[pair].set(bs2.count_left),
         )
 
+    def _adv_threshold_bounds(st):
+        """Advanced monotone mode: dense per-threshold child output bounds.
+
+        Reference ``AdvancedLeafConstraints`` (monotone_constraints.hpp:583)
+        keeps per-(leaf, feature) lists of (threshold, constraint) slices
+        with cumulative min/max arrays (``CumulativeFeatureConstraint``) so
+        each candidate threshold sees only the constraints of neighbours
+        actually adjacent to the would-be child.  The TPU shape: four dense
+        (L, F, B) tensors — lower/upper output bounds for the left/right
+        child at every (leaf, split feature, threshold) — built from the
+        leaf bin-rectangles by scatter-min/max keyed on neighbour edges plus
+        cummin/cummax along the bin axis (the cumulative-extremum arrays).
+
+        Soundness: a bound slice accounts for EVERY alive leaf wholly on the
+        child's output-increasing (resp. decreasing) side along some
+        monotone feature g while overlapping the child's rectangle in all
+        other features.  Distinct leaves are disjoint, so threshold
+        dependence enters only through the child's extent in the split
+        dimension: for the edge that moves with the threshold the
+        constraint set grows monotonically in t (a prefix/suffix extremum);
+        for the fixed edge it is threshold-independent."""
+        lo, hi = st.leaf_bin_lo, st.leaf_bin_hi     # (L, F) i32
+        out = st.leaf_out
+        f = lo.shape[1]
+        iL = jnp.arange(L)
+        alive = iL < st.num_leaves
+        ov = ((lo[:, None, :] < hi[None, :, :])
+              & (lo[None, :, :] < hi[:, None, :]))  # (L, L, F)
+        ovi = ov.astype(jnp.int32)
+        n_ov = jnp.sum(ovi, axis=-1)                # (L, L)
+        pairm = alive[:, None] & alive[None, :] & (iL[:, None] != iL[None, :])
+        outJ = jnp.broadcast_to(out[None, :], (L, L))
+        INF = jnp.inf
+        LLO = jnp.full((L, f, B), -INF, jnp.float32)
+        LHI = jnp.full((L, f, B), INF, jnp.float32)
+        RLO = jnp.full((L, f, B), -INF, jnp.float32)
+        RHI = jnp.full((L, f, B), INF, jnp.float32)
+
+        def sufmin(x):
+            return jnp.flip(jax.lax.cummin(jnp.flip(x, -1), axis=x.ndim - 1), -1)
+
+        def sufmax(x):
+            return jnp.flip(jax.lax.cummax(jnp.flip(x, -1), axis=x.ndim - 1), -1)
+
+        def shift_next(x, fill):
+            # y[..., t] = x[..., t+1]; the last column gets ``fill``
+            pad = jnp.full(x.shape[:-1] + (1,), fill, x.dtype)
+            return jnp.concatenate([x[..., 1:], pad], axis=-1)
+
+        I2 = jnp.broadcast_to(iL[:, None], (L, L))
+
+        def scat2_min(key_j, vals):
+            # S[i, b] = min over j with key_j[j] == b of vals[i, j]
+            K = jnp.broadcast_to(key_j[None, :], (L, L))
+            return jnp.full((L, B), INF, jnp.float32).at[I2, K].min(vals)
+
+        def scat2_max(key_j, vals):
+            K = jnp.broadcast_to(key_j[None, :], (L, L))
+            return jnp.full((L, B), -INF, jnp.float32).at[I2, K].max(vals)
+
+        sh3 = (L, L, f)
+        I3 = jnp.broadcast_to(iL[:, None, None], sh3)
+        S3 = jnp.broadcast_to(jnp.arange(f)[None, None, :], sh3)
+
+        def scat3_min(key_js, vals):
+            # S[i, s, b] = min over j with key_js[j, s] == b of vals[i, j, s]
+            K = jnp.broadcast_to(key_js[None, :, :], sh3)
+            return jnp.full((L, f, B), INF, jnp.float32).at[I3, S3, K] \
+                .min(vals)
+
+        def scat3_max(key_js, vals):
+            K = jnp.broadcast_to(key_js[None, :, :], sh3)
+            return jnp.full((L, f, B), -INF, jnp.float32).at[I3, S3, K] \
+                .max(vals)
+
+        key_lo = jnp.clip(lo, 0, B - 1)             # per-j edge keys (L, F)
+        key_hi = jnp.clip(hi - 1, 0, B - 1)
+
+        for g, mg in enumerate(cfg.mono_static):
+            if mg == 0:
+                continue
+            # j wholly above / below leaf i along g (spatially)
+            j_above = hi[:, None, g] <= lo[None, :, g]          # (L, L)
+            j_below = hi[None, :, g] <= lo[:, None, g]
+
+            # ---- split feature s == g: the child's extent along g moves
+            # with the threshold.  Disjointness makes the keyed scatters
+            # subsume the whole-leaf case for the moving edge; the fixed
+            # edge contributes a threshold-independent extremum.
+            othersA = pairm & ((n_ov - ovi[:, :, g]) == f - 1)
+            vminA = jnp.where(othersA, outJ, INF)
+            vmaxA = jnp.where(othersA, outJ, -INF)
+            if mg > 0:
+                # LEFT child [lo_i, t+1): j with lo_j >= t+1 upper-bounds it
+                LHI = LHI.at[:, g, :].min(
+                    shift_next(sufmin(scat2_min(key_lo[:, g], vminA)), INF))
+                # RIGHT child [t+1, hi_i): j with hi_j <= t+1 lower-bounds it
+                RLO = RLO.at[:, g, :].max(
+                    jax.lax.cummax(scat2_max(key_hi[:, g], vmaxA), axis=1))
+                # fixed edges: j above the whole leaf caps the right child;
+                # j below floors the left child
+                up_c = jnp.where(othersA & j_above, outJ, INF).min(axis=1)
+                dn_c = jnp.where(othersA & j_below, outJ, -INF).max(axis=1)
+                RHI = RHI.at[:, g, :].min(up_c[:, None])
+                LLO = LLO.at[:, g, :].max(dn_c[:, None])
+            else:
+                # mg < 0: j above lower-bounds, j below upper-bounds
+                LLO = LLO.at[:, g, :].max(
+                    shift_next(sufmax(scat2_max(key_lo[:, g], vmaxA)), -INF))
+                RHI = RHI.at[:, g, :].min(
+                    jax.lax.cummin(scat2_min(key_hi[:, g], vminA), axis=1))
+                dn_c = jnp.where(othersA & j_above, outJ, -INF).max(axis=1)
+                up_c = jnp.where(othersA & j_below, outJ, INF).min(axis=1)
+                RLO = RLO.at[:, g, :].max(dn_c[:, None])
+                LHI = LHI.at[:, g, :].min(up_c[:, None])
+
+            # ---- split feature s != g: the side along g is fixed (the
+            # child keeps the leaf's g-extent); the threshold only governs
+            # whether j still overlaps the child's s-extent.
+            upJ = (j_above if mg > 0 else j_below)[:, :, None]
+            dnJ = (j_below if mg > 0 else j_above)[:, :, None]
+            othersB = (n_ov[:, :, None] - ovi[:, :, g][:, :, None]
+                       - ovi) == f - 2                          # (L, L, F)
+            smask = (jnp.arange(f) != g)[None, None, :]
+            baseB = pairm[:, :, None] & othersB & smask
+            # LEFT child keeps [lo_i_s, t+1): j needs hi_j_s > lo_i_s
+            # (t-independent) and lo_j_s <= t (prefix along the bin axis)
+            qual_l = baseB & (hi[None, :, :] > lo[:, None, :])
+            # RIGHT child keeps [t+1, hi_i_s): j needs lo_j_s < hi_i_s and
+            # hi_j_s >= t+2 (suffix)
+            qual_r = baseB & (lo[None, :, :] < hi[:, None, :])
+            o3 = outJ[:, :, None]
+            LHI = jnp.minimum(LHI, jax.lax.cummin(
+                scat3_min(key_lo, jnp.where(qual_l & upJ, o3, INF)),
+                axis=2))
+            RHI = jnp.minimum(RHI, shift_next(sufmin(
+                scat3_min(key_hi, jnp.where(qual_r & upJ, o3, INF))), INF))
+            LLO = jnp.maximum(LLO, jax.lax.cummax(
+                scat3_max(key_lo, jnp.where(qual_l & dnJ, o3, -INF)),
+                axis=2))
+            RLO = jnp.maximum(RLO, shift_next(sufmax(
+                scat3_max(key_hi, jnp.where(qual_r & dnJ, o3, -INF))),
+                -INF))
+        return LLO, LHI, RLO, RHI
+
     def _inter_refresh(st, scale3, meta, feature_mask, cegb=None,
                        groups_mat=None):
         """Intermediate monotone mode, per-step bound + best-split refresh.
@@ -791,11 +994,28 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
                 lambda c, p: _cegb_penalty(c, st.feat_used, p, coupled,
                                            lazy))(st.leaf_count,
                                                   st.leaf_path)
+        advk = _adv_threshold_bounds(st) if adv else None
         bs = _best_for_batch(
             histL, st.leaf_sum_grad, st.leaf_sum_hess, st.leaf_count, meta,
             feature_mask, penaltyL, st.leaf_out, None,
             st.leaf_path if track_path else None, groups_mat,
-            (new_lo, new_hi), st.leaf_depth)
+            (new_lo, new_hi), st.leaf_depth, advk=advk)
+        if adv:
+            # Record the refreshed best split's child bounds so the split
+            # execution (_children_updates) clips each child to its
+            # per-threshold slice; categorical winners fall back to the
+            # scalar leaf bounds.
+            gi = jnp.arange(L)
+
+            def _at_best(arr, scalar_fb):
+                return jnp.where(bs.is_cat, scalar_fb,
+                                 arr[gi, bs.feature, bs.bin])
+
+            st = st._replace(
+                adv_llo=_at_best(advk[0], new_lo),
+                adv_lhi=_at_best(advk[1], new_hi),
+                adv_rlo=_at_best(advk[2], new_lo),
+                adv_rhi=_at_best(advk[3], new_hi))
         depth_ok = (jnp.ones(L, bool) if cfg.max_depth <= 0
                     else st.leaf_depth < cfg.max_depth)
         gain = jnp.where(alive & depth_ok, bs.gain, _NEG_INF)

@@ -242,6 +242,12 @@ def best_split(
                                                # feature (extra_trees)
     out_lo: jnp.ndarray | None = None,         # scalar monotone lower bound
     out_hi: jnp.ndarray | None = None,         # scalar monotone upper bound
+    adv_bounds: tuple | None = None,           # advanced monotone mode:
+                                               # (LLO, LHI, RLO, RHI) each
+                                               # (F, B) — per-threshold child
+                                               # output bounds (reference
+                                               # AdvancedLeafConstraints
+                                               # cumulative slices)
     leaf_depth: jnp.ndarray | None = None,     # scalar (monotone_penalty)
     with_feature_gains: bool = False,          # also return (F,) best gain per
                                                # feature (voting-parallel)
@@ -284,8 +290,25 @@ def best_split(
                    and cfg.has_monotone)
     blo = out_lo if mono_bounds else None
     bhi = out_hi if mono_bounds else None
+    # Advanced monotone mode (reference AdvancedLeafConstraints,
+    # monotone_constraints.hpp:583): numerical candidates clip each child to
+    # its PER-THRESHOLD bound slice instead of the whole-leaf scalar;
+    # categorical columns (not covered by the reference's slice machinery
+    # either) fall back to the scalar leaf bounds.
+    use_adv = adv_bounds is not None and cfg.has_monotone
+    if use_adv:
+        icc0 = is_categorical[:, None]
+        s_lo = blo if mono_bounds else -jnp.inf
+        s_hi = bhi if mono_bounds else jnp.inf
+        a_llo = jnp.where(icc0, s_lo, adv_bounds[0])
+        a_lhi = jnp.where(icc0, s_hi, adv_bounds[1])
+        a_rlo = jnp.where(icc0, s_lo, adv_bounds[2])
+        a_rhi = jnp.where(icc0, s_hi, adv_bounds[3])
+        num_lb, num_rb = (a_llo, a_lhi), (a_rlo, a_rhi)
+    else:
+        num_lb = num_rb = None
 
-    def eval_dir(GL, HL, CL, l2_extra=0.0):
+    def eval_dir(GL, HL, CL, l2_extra=0.0, lb=None, rb=None):
         GR = parent_grad - GL
         HR = parent_hess - HL
         CR = parent_count - CL
@@ -295,18 +318,22 @@ def best_split(
             & (HL >= cfg.min_sum_hessian_in_leaf)
             & (HR >= cfg.min_sum_hessian_in_leaf)
         )
-        gain = (child_gain(GL, HL, CL, parent_output, cfg, l2_extra, blo, bhi)
+        llo, lhi = lb if lb is not None else (blo, bhi)
+        rlo, rhi = rb if rb is not None else (blo, bhi)
+        gain = (child_gain(GL, HL, CL, parent_output, cfg, l2_extra, llo, lhi)
                 + child_gain(GR, HR, CR, parent_output, cfg, l2_extra,
-                             blo, bhi)
+                             rlo, rhi)
                 - parent_gain)
         gain = jnp.where(valid & (gain > cfg.min_gain_to_split + _EPS), gain, -jnp.inf)
         return gain, (GL, HL, CL, GR, HR, CR)
 
     # Numerical: threshold t means "value-bin <= t goes left".
-    gain_mr, stats_mr = eval_dir(cumG, cumH, cumC)                    # NaN -> right
+    gain_mr, stats_mr = eval_dir(cumG, cumH, cumC,
+                                 lb=num_lb, rb=num_rb)                # NaN -> right
     if cfg.has_nan:
         gain_ml, stats_ml = eval_dir(cumG + Gn[:, None], cumH + Hn[:, None],
-                                     cumC + Cn[:, None])              # NaN -> left
+                                     cumC + Cn[:, None],
+                                     lb=num_lb, rb=num_rb)            # NaN -> left
         # Without a NaN bin both directions coincide; keep missing-right.
         has_nan = (nan_bins < b)[:, None]
         gain_ml = jnp.where(has_nan, gain_ml, -jnp.inf)
@@ -353,7 +380,10 @@ def best_split(
         HRm = parent_hess - HLm
         out_l = leaf_output(GLm, HLm, cfg)
         out_r = leaf_output(GRm, HRm, cfg)
-        if mono_bounds:
+        if use_adv:
+            out_l = jnp.clip(out_l, a_llo, a_lhi)
+            out_r = jnp.clip(out_r, a_rlo, a_rhi)
+        elif mono_bounds:
             out_l = jnp.clip(out_l, blo, bhi)
             out_r = jnp.clip(out_r, blo, bhi)
         mono = monotone[:, None]

@@ -113,3 +113,60 @@ def test_monotone_with_missing_values():
     bst = lgb.train(dict(P, monotone_constraints_method="intermediate"),
                     lgb.Dataset(X, label=y), 20)
     assert _is_monotone(bst)
+
+
+def test_advanced_runs_native_not_downgraded(capsys):
+    """`advanced` must run its own per-threshold machinery (no downgrade
+    warning) and produce monotone predictions."""
+    X, y = _mono_data(n=2500)
+    bst = lgb.train(dict(P, monotone_constraints_method="advanced",
+                         verbosity=1), lgb.Dataset(X, label=y), 5)
+    out = capsys.readouterr()
+    assert "falling back" not in (out.out + out.err).lower()
+    assert "not implemented" not in (out.out + out.err).lower()
+    assert bst._gbdt.grower_cfg.mono_advanced
+    assert _is_monotone(bst)
+
+
+def test_advanced_tightens_intermediate():
+    """Advanced's per-threshold constraint slices only apply a neighbour's
+    output to the part of a leaf's range actually adjacent to it, so its
+    effective constraints are a strict subset of intermediate's whole-leaf
+    bounds — training loss must improve strictly on a constructed case
+    (reference AdvancedLeafConstraints, monotone_constraints.hpp:583:
+    'monotone precise mode')."""
+    rng = np.random.RandomState(0)
+    n = 3000
+    X = rng.rand(n, 3).astype(np.float32)
+    y = 3 * X[:, 0] + np.sin(6 * X[:, 1]) + 0.3 * rng.randn(n)
+    p = {"objective": "regression", "num_leaves": 31,
+         "monotone_constraints": [1, 0, 0], "min_data_in_leaf": 5,
+         "verbosity": -1}
+    mse = {}
+    for method in ("intermediate", "advanced"):
+        bst = lgb.train(dict(p, monotone_constraints_method=method),
+                        lgb.Dataset(X, label=y), 10)
+        assert _is_monotone_1feat(bst)
+        mse[method] = float(np.mean((bst.predict(X) - y) ** 2))
+    assert mse["advanced"] < mse["intermediate"], mse
+
+
+def _is_monotone_1feat(bst, n_probe=30, n_grid=40, seed=7):
+    rng = np.random.RandomState(seed)
+    base = rng.rand(n_probe, 3)
+    grid = np.linspace(0, 1, n_grid)
+    Xg = np.repeat(base, n_grid, axis=0)
+    Xg[:, 0] = np.tile(grid, n_probe)
+    pred = bst.predict(Xg).reshape(n_probe, n_grid)
+    return np.diff(pred, axis=1).min() >= -1e-10
+
+
+def test_advanced_rejects_forced_splits(tmp_path):
+    import json
+    X, y = _mono_data(n=1500)
+    path = tmp_path / "forced.json"
+    path.write_text(json.dumps({"feature": 2, "threshold": 0.5}))
+    with pytest.raises(ValueError, match="forced"):
+        lgb.train(dict(P, monotone_constraints_method="advanced",
+                       forcedsplits_filename=str(path)),
+                  lgb.Dataset(X, label=y), 2)
