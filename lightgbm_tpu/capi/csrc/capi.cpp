@@ -20,6 +20,9 @@
 #include <Python.h>
 
 #include <cstdint>
+#include <cstdlib>
+#include <functional>
+#include <utility>
 #include <cstring>
 #include <mutex>
 #include <string>
@@ -1654,6 +1657,172 @@ int LGBM_BoosterPredictForMats(BoosterHandle handle, const void** data,
                                    out_result);
 }
 
+
+// --------------------------------------------- r5 parity: sparse predict
+// outputs, CSR single-row fast pair, CSR-by-callback dataset, external
+// collective injection (the last 5 LGBM_ surface gaps)
+
+int LGBM_BoosterPredictSparseOutput(
+    BoosterHandle handle, const void* indptr, int indptr_type,
+    const int32_t* indices, const void* data, int data_type, int64_t nindptr,
+    int64_t nelem, int64_t num_col_or_row, int predict_type,
+    int start_iteration, int num_iteration, const char* parameter,
+    int matrix_type, int64_t* out_len, void** out_indptr,
+    int32_t** out_indices, void** out_data) {
+  Gil g;
+  if (!g.ok) return -1;
+  PyObject* r = bridge_call(
+      "booster_predict_sparse_output",
+      Py_BuildValue(
+          "(ONiNNiLLLiiisi)", reinterpret_cast<PyObject*>(handle),
+          mv_from(indptr, nindptr * dtype_size(indptr_type)), indptr_type,
+          mv_from(indices, nelem * 4),
+          mv_from(data, nelem * dtype_size(data_type)), data_type,
+          static_cast<long long>(nindptr), static_cast<long long>(nelem),
+          static_cast<long long>(num_col_or_row), predict_type,
+          start_iteration, num_iteration,
+          parameter != nullptr ? parameter : "", matrix_type));
+  if (r == nullptr) return -1;
+  int64_t ip_len = PyLong_AsLongLong(PyTuple_GetItem(r, 3));
+  int64_t nnz = PyLong_AsLongLong(PyTuple_GetItem(r, 4));
+  size_t ip_bytes = static_cast<size_t>(ip_len) * dtype_size(indptr_type);
+  size_t dt_bytes = static_cast<size_t>(nnz) * dtype_size(data_type);
+  void* ip = std::malloc(ip_bytes > 0 ? ip_bytes : 1);
+  int32_t* ix =
+      static_cast<int32_t*>(std::malloc(nnz > 0 ? nnz * 4 : 1));
+  void* dp = std::malloc(dt_bytes > 0 ? dt_bytes : 1);
+  if (ip == nullptr || ix == nullptr || dp == nullptr) {
+    std::free(ip);
+    std::free(ix);
+    std::free(dp);
+    Py_DECREF(r);
+    g_last_error = "sparse predict output allocation failed";
+    return -1;
+  }
+  std::memcpy(ip, PyBytes_AsString(PyTuple_GetItem(r, 0)), ip_bytes);
+  std::memcpy(ix, PyBytes_AsString(PyTuple_GetItem(r, 1)),
+              static_cast<size_t>(nnz) * 4);
+  std::memcpy(dp, PyBytes_AsString(PyTuple_GetItem(r, 2)), dt_bytes);
+  out_len[0] = nnz;
+  out_len[1] = ip_len;
+  *out_indptr = ip;
+  *out_indices = ix;
+  *out_data = dp;
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_BoosterFreePredictSparse(void* indptr, int32_t* indices, void* data,
+                                  int indptr_type, int data_type) {
+  (void)indptr_type;
+  (void)data_type;
+  std::free(indptr);
+  std::free(indices);
+  std::free(data);
+  return 0;
+}
+
+int LGBM_BoosterPredictForCSRSingleRowFastInit(
+    BoosterHandle handle, const int predict_type, const int start_iteration,
+    const int num_iteration, const int data_type, const int64_t num_col,
+    const char* parameter, FastConfigHandle* out_fastConfig) {
+  Gil g;
+  if (!g.ok) return -1;
+  PyObject* r = bridge_call(
+      "booster_predict_csr_fast_init",
+      Py_BuildValue("(OiiiiLs)", reinterpret_cast<PyObject*>(handle),
+                    predict_type, start_iteration, num_iteration, data_type,
+                    static_cast<long long>(num_col),
+                    parameter != nullptr ? parameter : ""));
+  if (r == nullptr) return -1;
+  *out_fastConfig = r;
+  return 0;
+}
+
+int LGBM_BoosterPredictForCSRSingleRowFast(
+    FastConfigHandle fastConfig_handle, const void* indptr,
+    const int indptr_type, const int32_t* indices, const void* data,
+    const int64_t nindptr, const int64_t nelem, int64_t* out_len,
+    double* out_result) {
+  Gil g;
+  if (!g.ok) return -1;
+  PyObject* fast = reinterpret_cast<PyObject*>(fastConfig_handle);
+  PyObject* dt = PyObject_GetAttrString(fast, "dtype_size_bytes");
+  if (dt == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  Py_ssize_t esz = PyLong_AsSsize_t(dt);
+  Py_DECREF(dt);
+  PyObject* r = bridge_call(
+      "booster_predict_csr_fast",
+      Py_BuildValue("(ONiNNLL)", fast,
+                    mv_from(indptr, nindptr * dtype_size(indptr_type)),
+                    indptr_type, mv_from(indices, nelem * 4),
+                    mv_from(data, nelem * esz),
+                    static_cast<long long>(nindptr),
+                    static_cast<long long>(nelem)));
+  if (r == nullptr) return -1;
+  PyObject* raw = PyTuple_GetItem(r, 0);
+  int64_t n = PyLong_AsLongLong(PyTuple_GetItem(r, 1));
+  *out_len = n;
+  char* buf = PyBytes_AsString(raw);
+  if (buf != nullptr && out_result != nullptr) {
+    std::memcpy(out_result, buf, static_cast<size_t>(n) * sizeof(double));
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_DatasetCreateFromCSRFunc(void* get_row_funptr, int num_rows,
+                                  int64_t num_col, const char* parameters,
+                                  DatasetHandle reference,
+                                  DatasetHandle* out) {
+  // reference c_api.cpp: the pointer is a
+  // std::function<void(int, std::vector<std::pair<int, double>>&)>*
+  // (the SynapseML/Spark row callback).  Materialize CSR on the C++ side
+  // without the GIL, then reuse the CSR entry point.
+  auto* fn = reinterpret_cast<
+      std::function<void(int, std::vector<std::pair<int, double>>&)>*>(
+      get_row_funptr);
+  std::vector<int32_t> indptr;
+  indptr.reserve(static_cast<size_t>(num_rows) + 1);
+  indptr.push_back(0);
+  std::vector<int32_t> idx;
+  std::vector<double> vals;
+  std::vector<std::pair<int, double>> row;
+  for (int i = 0; i < num_rows; ++i) {
+    row.clear();
+    (*fn)(i, row);
+    for (const auto& kv : row) {
+      idx.push_back(kv.first);
+      vals.push_back(kv.second);
+    }
+    indptr.push_back(static_cast<int32_t>(idx.size()));
+  }
+  const int64_t n_elem = static_cast<int64_t>(idx.size());
+  if (idx.empty()) {  // keep the buffer pointers valid for nelem == 0
+    idx.push_back(0);
+    vals.push_back(0.0);
+  }
+  return LGBM_DatasetCreateFromCSR(
+      indptr.data(), 2 /* C_API_DTYPE_INT32 */, idx.data(), vals.data(),
+      1 /* C_API_DTYPE_FLOAT64 */, static_cast<int64_t>(num_rows) + 1,
+      n_elem, num_col, parameters, reference, out);
+}
+
+int LGBM_NetworkInitWithFunctions(int num_machines, int rank,
+                                  void* reduce_scatter_ext_fun,
+                                  void* allgather_ext_fun) {
+  CALL_VOID_BRIDGE(
+      "network_init_with_functions", "(iiKK)", num_machines, rank,
+      static_cast<unsigned long long>(
+          reinterpret_cast<uintptr_t>(reduce_scatter_ext_fun)),
+      static_cast<unsigned long long>(
+          reinterpret_cast<uintptr_t>(allgather_ext_fun)));
+}
+
 int LGBM_CAPIVersion() { return 1; }
+
 
 }  // extern "C"

@@ -281,6 +281,34 @@ int LGBM_BoosterDumpModel(BoosterHandle handle, int start_iteration,
 int LGBM_BoosterFeatureImportance(BoosterHandle handle, int num_iteration,
                                   int importance_type, double* out_results);
 
+/* r5 parity: sparse predict outputs, CSR single-row fast pair,
+   CSR-by-callback dataset, external collective injection */
+int LGBM_BoosterPredictSparseOutput(
+    BoosterHandle handle, const void* indptr, int indptr_type,
+    const int32_t* indices, const void* data, int data_type, int64_t nindptr,
+    int64_t nelem, int64_t num_col_or_row, int predict_type,
+    int start_iteration, int num_iteration, const char* parameter,
+    int matrix_type, int64_t* out_len, void** out_indptr,
+    int32_t** out_indices, void** out_data);
+int LGBM_BoosterFreePredictSparse(void* indptr, int32_t* indices, void* data,
+                                  int indptr_type, int data_type);
+int LGBM_BoosterPredictForCSRSingleRowFastInit(
+    BoosterHandle handle, const int predict_type, const int start_iteration,
+    const int num_iteration, const int data_type, const int64_t num_col,
+    const char* parameter, FastConfigHandle* out_fastConfig);
+int LGBM_BoosterPredictForCSRSingleRowFast(
+    FastConfigHandle fastConfig_handle, const void* indptr,
+    const int indptr_type, const int32_t* indices, const void* data,
+    const int64_t nindptr, const int64_t nelem, int64_t* out_len,
+    double* out_result);
+int LGBM_DatasetCreateFromCSRFunc(void* get_row_funptr, int num_rows,
+                                  int64_t num_col, const char* parameters,
+                                  DatasetHandle reference,
+                                  DatasetHandle* out);
+int LGBM_NetworkInitWithFunctions(int num_machines, int rank,
+                                  void* reduce_scatter_ext_fun,
+                                  void* allgather_ext_fun);
+
 #ifdef __cplusplus
 }
 #endif

@@ -1062,3 +1062,258 @@ def test_capi_multiclass_custom_objective_layout():
 
     a, b = _raw_predict(bst_a), _raw_predict(bst_b)
     np.testing.assert_allclose(b, a, rtol=2e-3, atol=2e-3)
+
+
+def test_capi_sparse_predict_output():
+    """LGBM_BoosterPredictSparseOutput returns num_class stacked CSR
+    matrices of non-zero SHAP contributions with one shared data buffer
+    (reference Booster::PredictSparseCSR, c_api.cpp); parity against the
+    dense contrib path, then LGBM_BoosterFreePredictSparse releases it."""
+    import scipy.sparse as sp
+
+    lib = _load()
+    rng = np.random.RandomState(3)
+    n, f = 300, 6
+    X = rng.randn(n, f)
+    X[rng.rand(n, f) < 0.3] = 0.0
+    y = (X[:, 0] + X[:, 1] > 0).astype(float)
+    ds = _dataset_from_mat(lib, X, y)
+    bst = ctypes.c_void_p()
+    _check(lib, lib.LGBM_BoosterCreate(
+        ds, b"objective=binary num_leaves=15 verbosity=-1",
+        ctypes.byref(bst)))
+    fin = ctypes.c_int()
+    for _ in range(5):
+        _check(lib, lib.LGBM_BoosterUpdateOneIter(bst, ctypes.byref(fin)))
+
+    Xcsr = sp.csr_matrix(X)
+    indptr = np.ascontiguousarray(Xcsr.indptr, np.int32)
+    indices = np.ascontiguousarray(Xcsr.indices, np.int32)
+    data = np.ascontiguousarray(Xcsr.data, np.float64)
+
+    out_len = (ctypes.c_int64 * 2)()
+    out_indptr = ctypes.c_void_p()
+    out_indices = ctypes.POINTER(ctypes.c_int32)()
+    out_data = ctypes.c_void_p()
+    _check(lib, lib.LGBM_BoosterPredictSparseOutput(
+        bst, indptr.ctypes.data_as(ctypes.c_void_p), ctypes.c_int(2),
+        indices.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        data.ctypes.data_as(ctypes.c_void_p), ctypes.c_int(1),
+        ctypes.c_int64(len(indptr)), ctypes.c_int64(len(data)),
+        ctypes.c_int64(f), ctypes.c_int(3),  # C_API_PREDICT_CONTRIB
+        ctypes.c_int(0), ctypes.c_int(-1), b"", ctypes.c_int(0),  # CSR
+        out_len, ctypes.byref(out_indptr), ctypes.byref(out_indices),
+        ctypes.byref(out_data)))
+    nnz, ip_len = out_len[0], out_len[1]
+    assert ip_len == n + 1          # one class -> one stacked matrix
+    got_ip = np.ctypeslib.as_array(
+        ctypes.cast(out_indptr, ctypes.POINTER(ctypes.c_int32)),
+        shape=(ip_len,)).copy()
+    got_ix = np.ctypeslib.as_array(out_indices, shape=(max(nnz, 1),))[
+        :nnz].copy()
+    got_dt = np.ctypeslib.as_array(
+        ctypes.cast(out_data, ctypes.POINTER(ctypes.c_double)),
+        shape=(max(nnz, 1),))[:nnz].copy()
+    sparse_contrib = sp.csr_matrix((got_dt, got_ix, got_ip),
+                                   shape=(n, f + 1)).toarray()
+
+    dense = (ctypes.c_double * (n * (f + 1)))()
+    m = ctypes.c_int64()
+    _check(lib, lib.LGBM_BoosterPredictForCSR(
+        bst, indptr.ctypes.data_as(ctypes.c_void_p), ctypes.c_int(2),
+        indices.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        data.ctypes.data_as(ctypes.c_void_p), ctypes.c_int(1),
+        ctypes.c_int64(len(indptr)), ctypes.c_int64(len(data)),
+        ctypes.c_int64(f), ctypes.c_int(3), ctypes.c_int(0),
+        ctypes.c_int(-1), b"", ctypes.byref(m), dense))
+    np.testing.assert_allclose(
+        sparse_contrib, np.array(dense[:]).reshape(n, f + 1), rtol=1e-9)
+    _check(lib, lib.LGBM_BoosterFreePredictSparse(
+        out_indptr, out_indices, out_data, ctypes.c_int(2),
+        ctypes.c_int(1)))
+
+
+def test_capi_csr_single_row_fast():
+    """FastConfig pair for CSR rows (reference c_api.h:1162-1202): per-row
+    predictions must match the batch CSR path."""
+    import scipy.sparse as sp
+
+    lib = _load()
+    rng = np.random.RandomState(5)
+    n, f = 400, 5
+    X = rng.randn(n, f)
+    X[rng.rand(n, f) < 0.4] = 0.0
+    y = (X[:, 0] - X[:, 2] > 0).astype(float)
+    ds = _dataset_from_mat(lib, X, y)
+    bst = ctypes.c_void_p()
+    _check(lib, lib.LGBM_BoosterCreate(
+        ds, b"objective=binary num_leaves=15 verbosity=-1",
+        ctypes.byref(bst)))
+    fin = ctypes.c_int()
+    for _ in range(5):
+        _check(lib, lib.LGBM_BoosterUpdateOneIter(bst, ctypes.byref(fin)))
+
+    fast = ctypes.c_void_p()
+    _check(lib, lib.LGBM_BoosterPredictForCSRSingleRowFastInit(
+        bst, ctypes.c_int(0), ctypes.c_int(0), ctypes.c_int(-1),
+        ctypes.c_int(1), ctypes.c_int64(f), b"", ctypes.byref(fast)))
+
+    batch = np.zeros(n)
+    outv = ctypes.c_double()
+    out_n = ctypes.c_int64()
+    full = (ctypes.c_double * n)()
+    Xcsr = sp.csr_matrix(X)
+    _check(lib, lib.LGBM_BoosterPredictForCSR(
+        bst,
+        np.ascontiguousarray(Xcsr.indptr, np.int32).ctypes.data_as(
+            ctypes.c_void_p), ctypes.c_int(2),
+        np.ascontiguousarray(Xcsr.indices, np.int32).ctypes.data_as(
+            ctypes.POINTER(ctypes.c_int32)),
+        np.ascontiguousarray(Xcsr.data, np.float64).ctypes.data_as(
+            ctypes.c_void_p), ctypes.c_int(1),
+        ctypes.c_int64(n + 1), ctypes.c_int64(Xcsr.nnz), ctypes.c_int64(f),
+        ctypes.c_int(0), ctypes.c_int(0), ctypes.c_int(-1), b"",
+        ctypes.byref(out_n), full))
+    for i in range(0, n, 37):
+        row = sp.csr_matrix(X[i:i + 1])
+        rp = np.ascontiguousarray(row.indptr, np.int32)
+        ri = np.ascontiguousarray(row.indices, np.int32)
+        rd = np.ascontiguousarray(row.data, np.float64)
+        if row.nnz == 0:
+            ri = np.zeros(1, np.int32)
+            rd = np.zeros(1, np.float64)
+        _check(lib, lib.LGBM_BoosterPredictForCSRSingleRowFast(
+            fast, rp.ctypes.data_as(ctypes.c_void_p), ctypes.c_int(2),
+            ri.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            rd.ctypes.data_as(ctypes.c_void_p), ctypes.c_int64(2),
+            ctypes.c_int64(row.nnz), ctypes.byref(out_n),
+            ctypes.byref(outv)))
+        batch[i] = outv.value
+        # fast path bins through baked f32 LUTs; 1e-6 covers the rounding
+        np.testing.assert_allclose(outv.value, full[i], rtol=1e-6)
+    _check(lib, lib.LGBM_FastConfigFree(fast))
+
+
+def test_capi_dataset_create_from_csr_func(tmp_path):
+    """LGBM_DatasetCreateFromCSRFunc consumes a C++ row callback
+    (std::function pointer, the SynapseML seam — reference c_api.h:363);
+    driven here through a small compiled helper."""
+    import subprocess
+    import sys
+    import sysconfig
+
+    helper_src = tmp_path / "rowfn.cpp"
+    helper_src.write_text(r"""
+    #include <functional>
+    #include <utility>
+    #include <vector>
+    #include <cmath>
+    using RowFn = std::function<void(int, std::vector<std::pair<int, double>>&)>;
+    static RowFn g_fn = [](int i, std::vector<std::pair<int, double>>& ret) {
+      ret.clear();
+      ret.emplace_back(i % 4, std::sin(i * 0.7) + 1.5);
+      if (i % 3 == 0) ret.emplace_back(4, 1.0);
+    };
+    extern "C" void* make_row_fn() { return &g_fn; }
+    """)
+    so = tmp_path / "rowfn.so"
+    subprocess.run(["g++", "-O1", "-shared", "-fPIC", str(helper_src),
+                    "-o", str(so)], check=True)
+    helper = ctypes.CDLL(str(so))
+    helper.make_row_fn.restype = ctypes.c_void_p
+
+    lib = _load()
+    n, f = 600, 5
+    ds = ctypes.c_void_p()
+    _check(lib, lib.LGBM_DatasetCreateFromCSRFunc(
+        ctypes.c_void_p(helper.make_row_fn()), ctypes.c_int(n),
+        ctypes.c_int64(f), b"min_data_in_bin=1", ctypes.c_void_p(),
+        ctypes.byref(ds)))
+    nd, nf = ctypes.c_int32(), ctypes.c_int32()
+    _check(lib, lib.LGBM_DatasetGetNumData(ds, ctypes.byref(nd)))
+    _check(lib, lib.LGBM_DatasetGetNumFeature(ds, ctypes.byref(nf)))
+    assert (nd.value, nf.value) == (n, f)
+    # label + one boosting iteration proves the dataset is usable
+    y = np.ascontiguousarray((np.arange(n) % 4 < 2).astype(np.float32))
+    _check(lib, lib.LGBM_DatasetSetField(
+        ds, b"label", y.ctypes.data_as(ctypes.c_void_p), ctypes.c_int(n),
+        0))
+    bst = ctypes.c_void_p()
+    _check(lib, lib.LGBM_BoosterCreate(
+        ds, b"objective=binary num_leaves=7 verbosity=-1",
+        ctypes.byref(bst)))
+    fin = ctypes.c_int()
+    _check(lib, lib.LGBM_BoosterUpdateOneIter(bst, ctypes.byref(fin)))
+
+
+def test_capi_network_init_with_functions():
+    """LGBM_NetworkInitWithFunctions (reference c_api.cpp:2773, the
+    SynapseML injection seam) installs external reduce-scatter/allgather
+    C functions as the collectives-facade transport; a training run with
+    the backend installed keeps working, and the facade routes through
+    the injected functions until LGBM_NetworkFree."""
+    import jax.numpy as jnp
+
+    import lightgbm_tpu as lgb
+    import lightgbm_tpu.parallel.collectives as C
+    from lightgbm_tpu.parallel.mesh import make_mesh
+
+    lib = _load()
+    calls = []
+    world = 2
+
+    AG_T = ctypes.CFUNCTYPE(
+        None, ctypes.c_void_p, ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_int, ctypes.c_void_p, ctypes.c_int32)
+    RS_T = ctypes.CFUNCTYPE(
+        None, ctypes.c_void_p, ctypes.c_int32, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_int, ctypes.c_void_p, ctypes.c_int32, ctypes.c_void_p)
+
+    def fake_allgather(inp, in_size, starts, lens, nblock, out, out_size):
+        # single-process fake: every "rank" contributes the same block
+        calls.append("allgather")
+        blk = ctypes.string_at(inp, in_size)
+        buf = (ctypes.c_char * out_size).from_address(out)
+        for b in range(nblock):
+            buf[starts[b]:starts[b] + lens[b]] = blk[:lens[b]]
+
+    def fake_reduce_scatter(inp, in_size, type_size, starts, lens, nblock,
+                            out, out_size, reducer):
+        # world identical contributions -> own block times world
+        calls.append("reduce_scatter")
+        own = np.frombuffer(ctypes.string_at(inp, lens[0]), np.float32)
+        res = (own * world).astype(np.float32).tobytes()
+        ctypes.memmove(out, res, min(out_size, len(res)))
+
+    ag = AG_T(fake_allgather)
+    rs = RS_T(fake_reduce_scatter)
+    _check(lib, lib.LGBM_NetworkInitWithFunctions(
+        ctypes.c_int(world), ctypes.c_int(0),
+        ctypes.cast(rs, ctypes.c_void_p), ctypes.cast(ag, ctypes.c_void_p)))
+    try:
+        mesh = make_mesh()
+        v = jnp.ones(4)
+        s = np.asarray(C.global_sum(v, mesh))
+        # fake allgather replicates this rank's contribution world times,
+        # so the backend's sum over ranks doubles each element
+        np.testing.assert_allclose(s, world * np.ones(4))
+        hist = jnp.arange(8 * 4 * 3, dtype=jnp.float32).reshape(8, 4, 3)
+        red = np.asarray(C.histogram_reduce_scatter(hist, mesh))
+        # the single-process fakes: reduce_scatter returns own block * world,
+        # allgather replicates this rank's block into every slot
+        expect = np.tile(np.asarray(hist[:4]) * world, (world, 1, 1))
+        np.testing.assert_allclose(red, expect)
+        assert "allgather" in calls and "reduce_scatter" in calls
+        # training still works with the backend installed (the in-jit
+        # grower collectives are XLA's and unaffected by design)
+        rng = np.random.RandomState(0)
+        X = rng.randn(500, 4)
+        y = (X[:, 0] > 0).astype(float)
+        bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                         "verbosity": -1}, lgb.Dataset(X, label=y), 3)
+        assert bst.num_trees() == 3
+    finally:
+        _check(lib, lib.LGBM_NetworkFree())
+    assert C._comm_backend is None
