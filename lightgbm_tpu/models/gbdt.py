@@ -302,6 +302,17 @@ class GBDT:
                          else "segment")
             self.grower_cfg = _dc.replace(self.grower_cfg,
                                           histogram_impl=hist_impl)
+        # 4-bit bin packing (reference DenseBin IS_4BIT auto-selection):
+        # with every feature at <= 16 bins, store nibble pairs — the
+        # resident bin matrix and per-leaf gathers halve.  Excluded from
+        # EFB (bundle bins exceed 4 bits) and the feature-parallel layout
+        # (nibble pairs must not straddle feature shards).
+        if (cfg.tpu_4bit_bins and self.bundles is None
+                and train.binned.max_num_bins <= 16
+                and not fp_capable_for(self.grower_cfg, self.mesh,
+                                       DATA_AXIS)):
+            import dataclasses as _dc
+            self.grower_cfg = _dc.replace(self.grower_cfg, packed4=True)
         self._quant_key = (jax.random.PRNGKey(cfg.seed)
                            if cfg.use_quantized_grad else None)
         # PRNG for per-node randomness (extra_trees thresholds / bynode
@@ -320,6 +331,9 @@ class GBDT:
         else:
             self.bins_dev = train.bins_device()
             self._fg_dev = self._fo_dev = None
+        if self.grower_cfg.packed4:
+            from ..ops.histogram import pack_bins4
+            self.bins_dev = pack_bins4(self.bins_dev)
         self.meta_dev = train.feature_meta_device()
         if self.mesh is not None:
             if data_only_mesh:
@@ -602,6 +616,19 @@ class GBDT:
         device — an F/G x memory overhead paid only when a consumer (DART,
         rollback) actually needs it."""
         if self.bundles is None:
+            if self.grower_cfg.packed4:
+                # Tree prediction indexes ORIGINAL feature columns, so the
+                # packed matrix cannot be used directly.  Return the cached
+                # unpacked matrix (train_data caches it, keeping the object
+                # identity DART's pad-trim check relies on) and warn about
+                # the extra residency, mirroring the EFB branch below.
+                if self.train_data._bins_dev is None:
+                    from ..utils.log import Log
+                    Log.warning(
+                        "4-bit bins + DART/rollback keeps both the packed "
+                        "and the byte-per-bin matrices on device; set "
+                        "tpu_4bit_bins=false if HBM is tight")
+                return self.train_data.bins_device()
             return self.bins_dev
         if self.train_data._bins_dev is None:
             from ..utils.log import Log

@@ -45,7 +45,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..ops.histogram import histogram_from_vals
+from ..ops.histogram import histogram_from_vals, unpack_bins4
 from ..ops.split import (BestSplit, SplitConfig, best_split, leaf_gain,
                          leaf_output, smoothed_output)
 
@@ -134,6 +134,13 @@ class GrowerConfig:
     # required by mono_advanced to unroll its per-monotone-feature
     # constraint pass at trace time.
     mono_static: Optional[Tuple[int, ...]] = None
+    # 4-bit bin packing (reference DenseBin IS_4BIT arm, dense_bin.hpp):
+    # when every feature has <= 16 bins the (N, F) matrix is stored as
+    # (N, ceil(F/2)) uint8 nibble pairs — the resident bin matrix and the
+    # per-leaf row gathers halve, and the histogram kernels unpack in
+    # VMEM/registers.  Set by GBDT when eligible (no EFB bundling, no
+    # feature-parallel layout).
+    packed4: bool = False
 
 
 class TreeArrays(NamedTuple):
@@ -468,6 +475,9 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
             "monotone_constraints_method=advanced does not compose with "
             "forced splits (the refresh-gathered child bounds would not "
             "match a force-overwritten split); use intermediate")
+    if cfg.packed4 and (cfg.bundled or fp_capable):
+        raise ValueError("packed4 bins do not compose with EFB bundling or "
+                         "the feature-parallel layout (caller gates this)")
     if cfg.voting and (use_rand or use_bynode or use_groups
                        or cfg.split.use_cegb):
         raise ValueError(
@@ -1138,8 +1148,12 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
             seg = jax.lax.dynamic_slice(perm, (start,), (S,))
             valid = jnp.arange(S, dtype=jnp.int32) < cnt
             gcol = meta[4][feat] if cfg.bundled else feat
-            col = _decode_col(bins_pad[seg, gcol].astype(jnp.int32), feat,
-                              meta)
+            if cfg.packed4:
+                byte = bins_pad[seg, gcol // 2].astype(jnp.int32)
+                raw = jnp.where(gcol % 2 == 0, byte & 15, (byte >> 4) & 15)
+            else:
+                raw = bins_pad[seg, gcol].astype(jnp.int32)
+            col = _decode_col(raw, feat, meta)
             is_nan = col == nan_bins[feat]
             go_left = jnp.where(scat, cmask[col], col <= sbin)
             go_left = jnp.where(is_nan & ~scat, dleft, go_left)
@@ -1184,7 +1198,7 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
             off < 0, raw,
             jnp.where((raw >= off) & (raw < off + nb - 1), raw - off + 1, 0))
 
-    def _hist_branch_for(bins_pad, vals_pad, n, S):
+    def _hist_branch_for(bins_pad, vals_pad, n, S, nf=0):
         """RAW histogram of a contiguous perm range of static size S (the
         smaller sibling — the larger one comes from parent-hist subtraction,
         the reference's FeatureHistogram::Subtract).  Padded slots hit the
@@ -1196,7 +1210,8 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
             return histogram_from_vals(
                 bins_pad[seg], vals_pad[seg], num_bins=HB,
                 impl=cfg.histogram_impl,
-                rows_block=min(cfg.rows_block, S))
+                rows_block=min(cfg.rows_block, S),
+                packed4=cfg.packed4, features=nf)
         return branch
 
     def _apply_forced(st, scale3, meta):
@@ -1312,6 +1327,7 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
                                  jnp.full(max_bucket, n, jnp.int32)])
         root_hist = histogram_from_vals(
             bins, vals, num_bins=HB, impl=cfg.histogram_impl,
+            packed4=cfg.packed4, features=meta[0].shape[0],
             rows_block=cfg.rows_block)
         voting = cfg.voting and axis is not None
         if axis is not None and not voting:
@@ -1324,7 +1340,10 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
         if voting:
             root_tot = jax.lax.psum(root_tot, axis)
         root_g, root_h, root_c = root_tot[0], root_tot[1], root_tot[2]
-        state = _init_state(n, nfeat, gcols, root_hist, root_g, root_h,
+        # leaf_hist columns live in HISTOGRAM feature space, which under
+        # packed4 is the unpacked F (bins columns are nibble pairs)
+        hist_cols = nfeat if cfg.packed4 else gcols
+        state = _init_state(n, nfeat, hist_cols, root_hist, root_g, root_h,
                             root_c, key)
         state = state._replace(perm=perm0)
         root_pen = None
@@ -1409,7 +1428,8 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
                          if faxis is not None else
                          [_part_branch_for(bins_pad, nan_bins, S, meta)
                           for S in buckets])
-        hist_branches = [_hist_branch_for(bins_pad, vals_pad, n, S)
+        hist_branches = [_hist_branch_for(bins_pad, vals_pad, n, S,
+                                          meta[0].shape[0])
                          for S in buckets]
 
         def _bucket_of(cnt):
@@ -1530,7 +1550,8 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
 
         part_branches = [_part_branch_for(bins_pad, nan_bins, S, meta)
                          for S in buckets]
-        hist_branches = [_hist_branch_for(bins_pad, vals_pad, n, S)
+        hist_branches = [_hist_branch_for(bins_pad, vals_pad, n, S,
+                                          meta[0].shape[0])
                          for S in buckets]
 
         def _bucket_of(cnt):
@@ -1597,7 +1618,8 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
 
             hist_small = jax.lax.fori_loop(
                 0, W, hist_one,
-                jnp.zeros((W, gcols, HB, 3), raw_dtype))      # (W, G, B, 3)
+                jnp.zeros((W, f if cfg.packed4 else gcols, HB, 3),
+                          raw_dtype))                         # (W, G, B, 3)
             if axis is not None and not voting:
                 # ONE cross-shard reduce per wave — integer tensors under
                 # quantized training (bin.h:48-81).  Voting mode reduces only
@@ -2082,6 +2104,10 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
             tree, row_leaf = grow_fn(bins, vals, scale3, feature_mask,
                                      meta, cegb, split_key)
         else:
+            if cfg.packed4:
+                # the mask fallback (tiny row counts / no-gather) indexes
+                # full columns; unpack once — small data, small cost
+                bins = unpack_bins4(bins, meta[0].shape[0])
             tree, row_leaf = _grow_mask(bins, vals, scale3, feature_mask,
                                         meta, cegb, split_key)
         row_leaf = row_leaf[:n]

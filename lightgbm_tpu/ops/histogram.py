@@ -29,6 +29,28 @@ import jax
 import jax.numpy as jnp
 
 
+def pack_bins4(bins: jnp.ndarray) -> jnp.ndarray:
+    """Pack a (N, F) bin matrix whose bins all fit 4 bits (max_num_bins <=
+    16, NaN bin included) into (N, ceil(F/2)) uint8 — feature 2j in the low
+    nibble, 2j+1 in the high nibble.  Reference ``DenseBin`` IS_4BIT arm
+    (``src/io/dense_bin.hpp``) packs ROW pairs; packing FEATURE pairs here
+    keeps row gathers contiguous, which is what the perm layout streams."""
+    n, f = bins.shape
+    b = bins.astype(jnp.uint8)
+    if f % 2:
+        b = jnp.pad(b, ((0, 0), (0, 1)))
+    b = b.reshape(n, -1, 2)
+    return b[:, :, 0] | (b[:, :, 1] << 4)
+
+
+def unpack_bins4(packed: jnp.ndarray, num_features: int) -> jnp.ndarray:
+    """Inverse of :func:`pack_bins4` (drops the phantom odd-F column)."""
+    low = packed & jnp.uint8(15)
+    high = (packed >> 4) & jnp.uint8(15)
+    full = jnp.stack([low, high], axis=-1).reshape(packed.shape[0], -1)
+    return full[:, :num_features]
+
+
 def pack_values(
     grad: jnp.ndarray, hess: jnp.ndarray, mask: Optional[jnp.ndarray]
 ) -> jnp.ndarray:
@@ -40,28 +62,36 @@ def pack_values(
     return vals
 
 
-@functools.partial(jax.jit, static_argnames=("num_bins", "rows_block"))
+@functools.partial(jax.jit, static_argnames=("num_bins", "rows_block",
+                                             "packed4", "features"))
 def histogram_onehot(
-    bins: jnp.ndarray,       # (N, F) integer bins
+    bins: jnp.ndarray,       # (N, F) integer bins — or (N, ceil(F/2)) packed
     vals: jnp.ndarray,       # (N, 3) f32 (grad, hess, 1) or int8 quantized
     *,
     num_bins: int,
     rows_block: int = 16384,
+    packed4: bool = False,   # bins carry two 4-bit features per byte
+    features: int = 0,       # real F when packed4
 ) -> jnp.ndarray:            # (F, num_bins, 3) f32 — or i32 for int8 vals
-    n, f = bins.shape
+    n, cols = bins.shape
+    f = features if packed4 else cols
     integer = jnp.issubdtype(vals.dtype, jnp.integer)
     pad = (-n) % rows_block
     if pad:
         bins = jnp.pad(bins, ((0, pad), (0, 0)))
         vals = jnp.pad(vals, ((0, pad), (0, 0)))
     nblocks = (n + pad) // rows_block
-    bins_blk = bins.reshape(nblocks, rows_block, f)
+    bins_blk = bins.reshape(nblocks, rows_block, cols)
     vals_blk = vals.reshape(nblocks, rows_block, 3)
     iota = jnp.arange(num_bins, dtype=jnp.int32)
     acc_dtype = jnp.int32 if integer else vals.dtype
 
     def body(acc, blk):
         b, v = blk
+        if packed4:
+            # per-block nibble unpack fuses into the contraction's input
+            # pipeline; the full-size (N, F) matrix never lands in HBM
+            b = unpack_bins4(b, f)
         onehot = (b.astype(jnp.int32)[:, :, None] == iota[None, None, :])
         if integer:
             # Quantized path: s8 x s8 -> s32 (the MXU's integer contraction;
@@ -78,11 +108,15 @@ def histogram_onehot(
     return hist
 
 
-@functools.partial(jax.jit, static_argnames=("num_bins",))
+@functools.partial(jax.jit, static_argnames=("num_bins", "packed4",
+                                             "features"))
 def histogram_segment(
-    bins: jnp.ndarray, vals: jnp.ndarray, *, num_bins: int
+    bins: jnp.ndarray, vals: jnp.ndarray, *, num_bins: int,
+    packed4: bool = False, features: int = 0
 ) -> jnp.ndarray:
     """Scatter-add variant (useful on CPU; TPU scatters serialize)."""
+    if packed4:
+        bins = unpack_bins4(bins, features)
     n, f = bins.shape
     integer = jnp.issubdtype(vals.dtype, jnp.integer)
     acc_dtype = jnp.int32 if integer else vals.dtype
@@ -99,6 +133,8 @@ def histogram_from_vals(
     num_bins: int,
     impl: str = "auto",
     rows_block: int = 16384,
+    packed4: bool = False,
+    features: int = 0,
 ) -> jnp.ndarray:
     """Histogram from pre-packed (N, 3) channel values."""
     if impl == "auto":
@@ -109,14 +145,19 @@ def histogram_from_vals(
             # Quantized histograms: s8 x s8 -> s32 on the MXU's double-rate
             # int8 path (reference Int32HistogramSumReducer, bin.h:48-81).
             return histogram_flat(bins, vals, num_bins=num_bins,
-                                  rows_block=rows_block, dtype="int8")
+                                  rows_block=rows_block, dtype="int8",
+                                  packed4=packed4, features=features)
         return histogram_flat(bins, vals, num_bins=num_bins,
                               rows_block=rows_block,
-                              dtype="bf16" if impl == "flat_bf16" else "f32")
+                              dtype="bf16" if impl == "flat_bf16" else "f32",
+                              packed4=packed4, features=features)
     if impl == "onehot":
-        return histogram_onehot(bins, vals, num_bins=num_bins, rows_block=rows_block)
+        return histogram_onehot(bins, vals, num_bins=num_bins,
+                                rows_block=rows_block, packed4=packed4,
+                                features=features)
     if impl == "segment":
-        return histogram_segment(bins, vals, num_bins=num_bins)
+        return histogram_segment(bins, vals, num_bins=num_bins,
+                                 packed4=packed4, features=features)
     raise ValueError(f"unknown histogram impl: {impl}")
 
 

@@ -107,7 +107,7 @@ def _prep(bins, vals, rows_block, ftile):
 
 
 def _flat_kernel(bins_ref, valsT_ref, out_ref, *, num_bins, ftile,
-                 oh_dtype, acc_dtype, precision):
+                 oh_dtype, acc_dtype, precision, packed4=False):
     rb = pl.program_id(0)  # row-block index
 
     @pl.when(rb == 0)
@@ -117,6 +117,13 @@ def _flat_kernel(bins_ref, valsT_ref, out_ref, *, num_bins, ftile,
     bins_blk = bins_ref[:].astype(jnp.int32)            # (blk, ft)
     valsT = valsT_ref[:]                                # (C_PAD, blk)
     blk = bins_blk.shape[0]
+    if packed4:
+        # 4-bit mode: the streamed tile carries two features per byte
+        # (reference DenseBin IS_4BIT, dense_bin.hpp); the nibble unpack
+        # happens HERE in VMEM so HBM streams half the bin bytes.
+        low = bins_blk & 15
+        high = (bins_blk >> 4) & 15
+        bins_blk = jnp.stack([low, high], axis=-1).reshape(blk, ftile)
     iota_b = jax.lax.broadcasted_iota(jnp.int32, (blk, ftile, num_bins), 2)
     oh = (bins_blk[:, :, None] == iota_b).astype(oh_dtype)
     oh = oh.reshape(blk, ftile * num_bins)              # (blk, ft*B)
@@ -127,32 +134,39 @@ def _flat_kernel(bins_ref, valsT_ref, out_ref, *, num_bins, ftile,
 
 
 @functools.partial(
-    jax.jit, static_argnames=("num_bins", "rows_block", "dtype", "interpret"))
+    jax.jit, static_argnames=("num_bins", "rows_block", "dtype", "interpret",
+                              "packed4", "features"))
 def histogram_flat(
-    bins: jnp.ndarray,   # (N, F) uint8/uint16
+    bins: jnp.ndarray,   # (N, F) uint8/uint16 — or (N, ceil(F/2)) packed
     vals: jnp.ndarray,   # (N, 3) f32 masked (grad, hess, count) — or int8
     *,
     num_bins: int,
     rows_block: int = 0,
     dtype: str = "f32",  # one-hot/compute dtype: f32 | bf16 | int8
     interpret: bool = False,
+    packed4: bool = False,   # two 4-bit features per streamed byte
+    features: int = 0,       # real F when packed4
 ) -> jnp.ndarray:        # (F, num_bins, 3) f32 (int32 for int8)
     """Single-leaf flat-matmul histogram."""
-    n, f = bins.shape
+    n, fcols = bins.shape
+    f = features if packed4 else fcols
     oh_dtype, acc_dtype, isz = _DTYPES[dtype]
     # f32 must accumulate exactly (reference hists are exact f32 sums);
     # DEFAULT would run the MXU at bf16 and perturb every histogram entry.
     precision = (jax.lax.Precision.HIGHEST if dtype == "f32"
                  else jax.lax.Precision.DEFAULT)
     rows_block, ftile = _pick_tiles(f, num_bins, isz, rows_block)
-    bins, valsT, nblocks, nchunks = _prep(bins, vals, rows_block, ftile)
+    if packed4 and ftile % 2:
+        ftile += 1           # chunk boundaries must not split nibble pairs
+    cols_tile = ftile // 2 if packed4 else ftile
+    bins, valsT, nblocks, nchunks = _prep(bins, vals, rows_block, cols_tile)
     call = pl.pallas_call(
         functools.partial(_flat_kernel, num_bins=num_bins, ftile=ftile,
                           oh_dtype=oh_dtype, acc_dtype=acc_dtype,
-                          precision=precision),
+                          precision=precision, packed4=packed4),
         grid=(nblocks,),
         in_specs=[
-            pl.BlockSpec((rows_block, ftile), lambda i: (i, 0),
+            pl.BlockSpec((rows_block, cols_tile), lambda i: (i, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((C_PAD, rows_block), lambda i: (0, i),
                          memory_space=pltpu.VMEM),
@@ -165,8 +179,8 @@ def histogram_flat(
             vmem_limit_bytes=_VMEM_LIMIT),
         interpret=interpret,
     )
-    chunks = [call(jax.lax.slice_in_dim(bins, c * ftile, (c + 1) * ftile,
-                                        axis=1), valsT)
+    chunks = [call(jax.lax.slice_in_dim(bins, c * cols_tile,
+                                        (c + 1) * cols_tile, axis=1), valsT)
               for c in range(nchunks)]
     out = chunks[0] if nchunks == 1 else jnp.concatenate(chunks, axis=1)
     # (C_PAD, Fpad*B) -> (F, B, 3), dropping phantom feature blocks

@@ -1,0 +1,153 @@
+"""4-bit bin packing (reference ``DenseBin`` IS_4BIT arm,
+``src/io/dense_bin.hpp``): with max_bin <= 15 every feature fits a nibble,
+so the (N, F) bin matrix is stored as (N, ceil(F/2)) byte pairs and the
+histogram kernels unpack in-register.  Resident memory and per-leaf row
+gathers halve; trees must be EXACTLY the ones the byte-per-bin path grows.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.ops.histogram import (histogram_onehot, histogram_segment,
+                                        pack_bins4, unpack_bins4)
+
+P15 = {"objective": "binary", "num_leaves": 31, "max_bin": 15,
+       "min_data_in_leaf": 5, "verbosity": -1, "deterministic": True,
+       "seed": 3}
+
+
+def _data(n=20000, f=7, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(float)
+    return X, y
+
+
+def _assert_same_trees(a, b):
+    for k in range(len(a._gbdt.models)):
+        for t1, t2 in zip(a._gbdt.models[k], b._gbdt.models[k]):
+            np.testing.assert_array_equal(t1.split_feature, t2.split_feature)
+            np.testing.assert_array_equal(t1.split_bin, t2.split_bin)
+            np.testing.assert_allclose(t1.leaf_value, t2.leaf_value,
+                                       rtol=1e-6, atol=1e-7)
+
+
+def test_pack_unpack_roundtrip(rng):
+    for f in (6, 7):
+        bins = rng.randint(0, 16, (500, f)).astype(np.uint8)
+        packed = pack_bins4(jnp.asarray(bins))
+        assert packed.shape == (500, (f + 1) // 2)
+        np.testing.assert_array_equal(np.asarray(unpack_bins4(packed, f)),
+                                      bins)
+
+
+def test_kernel_parity_all_impls(rng):
+    n, f, B = 5000, 7, 16
+    bins = rng.randint(0, 16, (n, f)).astype(np.uint8)
+    vals = rng.randn(n, 3).astype(np.float32)
+    packed = pack_bins4(jnp.asarray(bins))
+    # same impl, packed vs unpacked: bit-identical
+    h = histogram_segment(jnp.asarray(bins), jnp.asarray(vals), num_bins=B)
+    hp = histogram_segment(packed, jnp.asarray(vals), num_bins=B,
+                           packed4=True, features=f)
+    np.testing.assert_array_equal(np.asarray(h), np.asarray(hp))
+    ho = histogram_onehot(jnp.asarray(bins), jnp.asarray(vals), num_bins=B)
+    hop = histogram_onehot(packed, jnp.asarray(vals), num_bins=B,
+                           packed4=True, features=f)
+    np.testing.assert_array_equal(np.asarray(ho), np.asarray(hop))
+
+
+def test_kernel_parity_pallas_interpret(rng):
+    from lightgbm_tpu.ops.pallas_histogram import histogram_flat
+    n, f, B = 3000, 8, 16
+    bins = rng.randint(0, 16, (n, f)).astype(np.uint8)
+    vals = rng.randn(n, 3).astype(np.float32)
+    packed = pack_bins4(jnp.asarray(bins))
+    h = histogram_flat(jnp.asarray(bins), jnp.asarray(vals), num_bins=B,
+                       interpret=True)
+    hp = histogram_flat(packed, jnp.asarray(vals), num_bins=B, packed4=True,
+                        features=f, interpret=True)
+    np.testing.assert_allclose(np.asarray(h), np.asarray(hp),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_auto_enable_and_memory_halves():
+    X, y = _data()
+    on = lgb.train(dict(P15), lgb.Dataset(X, label=y), 3)
+    assert on._gbdt.grower_cfg.packed4
+    assert on._gbdt.bins_dev.shape == (len(X), 4)
+    off = lgb.train(dict(P15, tpu_4bit_bins=False),
+                    lgb.Dataset(X, label=y), 3)
+    assert not off._gbdt.grower_cfg.packed4
+    # ceil(7/2)/7; an even F halves exactly
+    assert on._gbdt.bins_dev.nbytes * 7 == off._gbdt.bins_dev.nbytes * 4
+    coarse = lgb.train(dict(P15, max_bin=255), lgb.Dataset(X, label=y), 2)
+    assert not coarse._gbdt.grower_cfg.packed4
+
+
+@pytest.mark.parametrize("extra", [
+    {},                                           # serial perm
+    {"tpu_leaf_batch": 8},                        # wave growth
+    {"use_quantized_grad": True},                 # int8 grads, i32 hists
+    {"monotone_constraints": [1, 0, 0, 0, 0, 0, 0]},
+])
+def test_exact_tree_parity(extra):
+    X, y = _data()
+    on = lgb.train(dict(P15, **extra), lgb.Dataset(X, label=y), 6)
+    off = lgb.train(dict(P15, tpu_4bit_bins=False, **extra),
+                    lgb.Dataset(X, label=y), 6)
+    assert on._gbdt.grower_cfg.packed4
+    _assert_same_trees(on, off)
+    np.testing.assert_allclose(on.predict(X[:500]), off.predict(X[:500]),
+                               rtol=1e-7)
+
+
+def test_sharded_perm_parity():
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    X, y = _data(n=8 * 4000, f=6, seed=1)
+    on = lgb.train(dict(P15, tree_learner="data"),
+                   lgb.Dataset(X, label=y), 5)
+    off = lgb.train(dict(P15, tree_learner="data", tpu_4bit_bins=False),
+                    lgb.Dataset(X, label=y), 5)
+    assert on._gbdt.grower_cfg.packed4
+    _assert_same_trees(on, off)
+
+
+def test_efb_bundling_disables_packing():
+    rng = np.random.RandomState(2)
+    n, f = 4000, 12
+    X = np.zeros((n, f))
+    # mutually-exclusive sparse columns bundle under EFB
+    owner = rng.randint(0, f, n)
+    X[np.arange(n), owner] = rng.rand(n) + 0.5
+    y = (owner % 2).astype(float)
+    bst = lgb.train(dict(P15, enable_bundle=True),
+                    lgb.Dataset(X, label=y), 2)
+    if bst._gbdt.bundles is not None:
+        assert not bst._gbdt.grower_cfg.packed4
+
+
+def test_dart_and_rollback_parity():
+    """score_bins_dev consumers (DART drop/renorm, rollback) index ORIGINAL
+    feature columns — they must see unpacked bins (review finding r5)."""
+    X, y = _data(n=6000)
+    p = dict(P15, boosting="dart", drop_rate=0.5, num_leaves=15)
+    on = lgb.train(dict(p), lgb.Dataset(X, label=y), 8)
+    off = lgb.train(dict(p, tpu_4bit_bins=False), lgb.Dataset(X, label=y), 8)
+    assert on._gbdt.grower_cfg.packed4
+    np.testing.assert_allclose(on.predict(X[:500]), off.predict(X[:500]),
+                               rtol=1e-6, atol=1e-7)
+    # rollback path
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.Booster(params=dict(P15), train_set=ds)
+    bst.update()
+    bst.update()
+    assert bst._gbdt.grower_cfg.packed4
+    bst.rollback_one_iter()
+    assert bst.num_trees() == 1
