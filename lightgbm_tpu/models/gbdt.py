@@ -334,6 +334,11 @@ class GBDT:
         if self.grower_cfg.packed4:
             from ..ops.histogram import pack_bins4
             self.bins_dev = pack_bins4(self.bins_dev)
+            # Drop the Dataset's cached byte-per-bin device matrix — the
+            # packed copy is now the resident one (the halving is the
+            # feature's point).  DART/rollback re-materialize the unpacked
+            # view through score_bins_dev, which warns about the cost.
+            train._bins_dev = None
         self.meta_dev = train.feature_meta_device()
         if self.mesh is not None:
             if data_only_mesh:

@@ -129,8 +129,10 @@ def test_efb_bundling_disables_packing():
     y = (owner % 2).astype(float)
     bst = lgb.train(dict(P15, enable_bundle=True),
                     lgb.Dataset(X, label=y), 2)
-    if bst._gbdt.bundles is not None:
-        assert not bst._gbdt.grower_cfg.packed4
+    # the data is constructed to bundle; a vacuous pass would hide the
+    # EFB/packed4 exclusion this test exists for
+    assert bst._gbdt.bundles is not None
+    assert not bst._gbdt.grower_cfg.packed4
 
 
 def test_dart_and_rollback_parity():

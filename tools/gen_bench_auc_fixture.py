@@ -24,7 +24,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from bench import make_higgs_like  # noqa: E402
 
-N_TRAIN, N_VALID, F, ITERS, SEED = 200_000, 50_000, 28, 10, 0
+N_TRAIN, N_VALID, F, ITERS, SEED = 200_000, 50_000, 28, 100, 0
 
 PARAMS = {
     "objective": "binary",
@@ -57,18 +57,28 @@ def main():
                    np.column_stack([yt, Xt]), delimiter=",", fmt="%.7g")
         np.savetxt(os.path.join(td, "valid.csv"),
                    np.column_stack([yv, Xv]), delimiter=",", fmt="%.7g")
-        conf = [f"{k}={v}" for k, v in PARAMS.items()]
-        subprocess.run(
-            [binary, "task=train", f"data={td}/train.csv",
-             f"output_model={td}/model.txt", "saved_feature_importance_type=0"]
-            + conf, check=True, capture_output=True)
-        subprocess.run(
-            [binary, "task=predict", f"data={td}/valid.csv",
-             f"input_model={td}/model.txt",
-             f"output_result={td}/preds.txt", "predict_raw_score=true"],
-            check=True, capture_output=True)
-        preds = np.loadtxt(os.path.join(td, "preds.txt"))
+        def run(extra, tag):
+            conf = [f"{k}={v}" for k, v in PARAMS.items()] + extra
+            subprocess.run(
+                [binary, "task=train", f"data={td}/train.csv",
+                 f"output_model={td}/model_{tag}.txt",
+                 "saved_feature_importance_type=0"]
+                + conf, check=True, capture_output=True)
+            subprocess.run(
+                [binary, "task=predict", f"data={td}/valid.csv",
+                 f"input_model={td}/model_{tag}.txt",
+                 f"output_result={td}/preds_{tag}.txt",
+                 "predict_raw_score=true"],
+                check=True, capture_output=True)
+            return np.loadtxt(os.path.join(td, f"preds_{tag}.txt"))
+
+        preds = run([], "fp32")
+        # quantized-training pin at the SAME depth (reference
+        # use_quantized_grad, gradient_discretizer.hpp)
+        preds_q = run(["use_quantized_grad=true", "num_grad_quant_bins=4"],
+                      "quant")
     ref_auc = float(auc(yv, preds))
+    ref_auc_q = float(auc(yv, preds_q))
     out = {
         "description": "genuine LightGBM holdout AUC at the scaled bench "
                        "config (see tools/gen_bench_auc_fixture.py)",
@@ -76,12 +86,14 @@ def main():
                  "n_train": N_TRAIN, "n_valid": N_VALID, "n_features": F},
         "params": PARAMS,
         "ref_auc": ref_auc,
+        "ref_auc_quantized": ref_auc_q,
     }
     path = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "tests", "fixtures", "bench_auc.json")
     with open(path, "w") as fh:
         json.dump(out, fh, indent=1)
-    print("ref_auc:", ref_auc, "->", path)
+    print("ref_auc:", ref_auc, "quantized:", ref_auc_q,
+          "->", path)
 
 
 if __name__ == "__main__":
