@@ -2,9 +2,12 @@
 
 Reference: ``python-package/lightgbm/plotting.py`` (840 LoC) —
 ``plot_importance``, ``plot_split_value_histogram``, ``plot_metric``,
-``plot_tree``, ``create_tree_digraph``.  Same call signatures for the common
-arguments; matplotlib is imported lazily, graphviz is optional (gated, like the
-reference).
+``plot_tree``, ``create_tree_digraph``.  The public signatures (argument
+names and defaults) match the reference — they are the API contract — but
+the bodies are structured around two local helpers: ``_new_axes`` builds
+the figure, ``_decorate`` applies the shared limit/title/label/grid
+treatment that every chart needs.  matplotlib is imported lazily, graphviz
+is optional (gated, like the reference).
 """
 
 from __future__ import annotations
@@ -31,6 +34,41 @@ def _to_booster(booster) -> Booster:
     raise TypeError("booster must be a Booster or LGBMModel instance")
 
 
+def _new_axes(figsize, dpi):
+    import matplotlib.pyplot as plt
+
+    if figsize is not None:
+        _require_pair(figsize, "figsize")
+    _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
+    return ax
+
+
+def _decorate(ax, *, xlim=None, ylim=None, auto_xlim=None, auto_ylim=None,
+              title=None, xlabel=None, ylabel=None, grid=True):
+    """Shared axis treatment: explicit limits win (validated as pairs),
+    otherwise the chart's computed defaults apply; None labels stay off."""
+    if xlim is not None:
+        _require_pair(xlim, "xlim")
+    elif auto_xlim is not None:
+        xlim = auto_xlim
+    if xlim is not None:
+        ax.set_xlim(xlim)
+    if ylim is not None:
+        _require_pair(ylim, "ylim")
+    elif auto_ylim is not None:
+        ylim = auto_ylim
+    if ylim is not None:
+        ax.set_ylim(ylim)
+    if title is not None:
+        ax.set_title(title)
+    if xlabel is not None:
+        ax.set_xlabel(xlabel)
+    if ylabel is not None:
+        ax.set_ylabel(ylabel)
+    ax.grid(grid)
+    return ax
+
+
 def plot_importance(
     booster,
     ax=None,
@@ -51,53 +89,41 @@ def plot_importance(
 ):
     """Horizontal bar chart of feature importances (reference
     ``plotting.py plot_importance``)."""
-    import matplotlib.pyplot as plt
-
     bst = _to_booster(booster)
-    if importance_type == "auto":
-        importance_type = "split"
-    importance = bst.feature_importance(importance_type=importance_type)
-    feature_name = bst.feature_name()
-
-    if not len(importance):
+    imp_kind = "split" if importance_type == "auto" else importance_type
+    values = np.asarray(
+        bst.feature_importance(importance_type=imp_kind), np.float64)
+    if values.size == 0:
         raise ValueError("Booster's feature_importance is empty.")
-    tuples = sorted(zip(feature_name, importance), key=lambda x: x[1])
+    names = np.asarray(bst.feature_name(), dtype=object)
+
+    # ascending by importance so the top feature lands on the top row
+    order = np.argsort(values, kind="stable")
     if ignore_zero:
-        tuples = [x for x in tuples if x[1] > 0]
+        order = order[values[order] > 0]
     if max_num_features is not None and max_num_features > 0:
-        tuples = tuples[-max_num_features:]
-    labels, values = zip(*tuples)
+        order = order[-max_num_features:]
+    values = values[order]
+    names = names[order]
+    if values.size == 0:
+        raise ValueError(
+            "No feature has nonzero importance to plot; train the model "
+            "first or pass ignore_zero=False.")
 
     if ax is None:
-        if figsize is not None:
-            _require_pair(figsize, "figsize")
-        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
-    ylocs = np.arange(len(values))
-    ax.barh(ylocs, values, align="center", height=height, **kwargs)
-    for x, y in zip(values, ylocs):
-        fmt = f"%.{precision}f" if (precision is not None
-                                    and importance_type == "gain") else "%d"
-        ax.text(x + 1, y, fmt % x, va="center")
-    ax.set_yticks(ylocs)
-    ax.set_yticklabels(labels)
-    if xlim is not None:
-        _require_pair(xlim, "xlim")
-    else:
-        xlim = (0, max(values) * 1.1)
-    ax.set_xlim(xlim)
-    if ylim is not None:
-        _require_pair(ylim, "ylim")
-    else:
-        ylim = (-1, len(values))
-    ax.set_ylim(ylim)
-    if title is not None:
-        ax.set_title(title)
-    if xlabel is not None:
-        ax.set_xlabel(xlabel)
-    if ylabel is not None:
-        ax.set_ylabel(ylabel)
-    ax.grid(grid)
-    return ax
+        ax = _new_axes(figsize, dpi)
+    rows = np.arange(values.size)
+    ax.barh(rows, values, height=height, align="center", **kwargs)
+    show_decimals = precision is not None and imp_kind == "gain"
+    for row, v in zip(rows, values):
+        text = f"{v:.{precision}f}" if show_decimals else f"{int(v)}"
+        ax.text(v + 1, row, text, va="center")
+    ax.set_yticks(rows)
+    ax.set_yticklabels(list(names))
+    return _decorate(ax, xlim=xlim, ylim=ylim,
+                     auto_xlim=(0, float(values.max()) * 1.1),
+                     auto_ylim=(-1, values.size),
+                     title=title, xlabel=xlabel, ylabel=ylabel, grid=grid)
 
 
 def plot_split_value_histogram(
@@ -118,59 +144,42 @@ def plot_split_value_histogram(
 ):
     """Histogram of a feature's split thresholds across the model (reference
     ``plotting.py plot_split_value_histogram``)."""
-    import matplotlib.pyplot as plt
-
     bst = _to_booster(booster)
     dump = bst.dump_model()
-    names = dump["feature_names"]
     if isinstance(feature, str):
-        fidx = names.index(feature)
+        fidx = dump["feature_names"].index(feature)
     else:
         fidx = int(feature)
 
-    values: List[float] = []
-
-    def walk(node):
+    # iterative walk over every tree collecting this feature's thresholds
+    thresholds: List[float] = []
+    stack = [info["tree_structure"] for info in dump["tree_info"]]
+    while stack:
+        node = stack.pop()
         if "leaf_index" in node:
-            return
+            continue
         if node["split_feature"] == fidx and node["decision_type"] == "<=":
-            values.append(float(node["threshold"]))
-        walk(node["left_child"])
-        walk(node["right_child"])
-
-    for info in dump["tree_info"]:
-        walk(info["tree_structure"])
-    if not values:
+            thresholds.append(float(node["threshold"]))
+        stack.append(node["left_child"])
+        stack.append(node["right_child"])
+    if not thresholds:
         raise ValueError(
             f"Cannot plot split value histogram, "
             f"because feature {feature} was not used in splitting")
-    hist, bin_edges = np.histogram(values, bins=bins or "auto")
-    centers = (bin_edges[:-1] + bin_edges[1:]) / 2
 
+    counts, edges = np.histogram(thresholds, bins=bins or "auto")
     if ax is None:
-        if figsize is not None:
-            _require_pair(figsize, "figsize")
-        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
-    width = width_coef * (bin_edges[1] - bin_edges[0])
-    ax.bar(centers, hist, width=width, align="center", **kwargs)
-    if xlim is not None:
-        _require_pair(xlim, "xlim")
-        ax.set_xlim(xlim)
-    if ylim is not None:
-        _require_pair(ylim, "ylim")
-    else:
-        ylim = (0, max(hist) * 1.1)
-    ax.set_ylim(ylim)
+        ax = _new_axes(figsize, dpi)
+    ax.bar((edges[:-1] + edges[1:]) / 2, counts,
+           width=width_coef * (edges[1] - edges[0]), align="center",
+           **kwargs)
     if title is not None:
-        title = title.replace("@feature@", str(feature)).replace(
-            "@index/name@", "name" if isinstance(feature, str) else "index")
-        ax.set_title(title)
-    if xlabel is not None:
-        ax.set_xlabel(xlabel)
-    if ylabel is not None:
-        ax.set_ylabel(ylabel)
-    ax.grid(grid)
-    return ax
+        kind = "name" if isinstance(feature, str) else "index"
+        title = title.replace("@feature@", str(feature)) \
+                     .replace("@index/name@", kind)
+    return _decorate(ax, xlim=xlim, ylim=ylim,
+                     auto_ylim=(0, float(counts.max()) * 1.1),
+                     title=title, xlabel=xlabel, ylabel=ylabel, grid=grid)
 
 
 def plot_metric(
@@ -189,73 +198,50 @@ def plot_metric(
 ):
     """Plot metric curves recorded by ``record_evaluation`` (reference
     ``plotting.py plot_metric``)."""
-    import matplotlib.pyplot as plt
-
-    if isinstance(booster, LGBMModel):
-        eval_results = deepcopy(booster.evals_result_)
-    elif isinstance(booster, dict):
-        eval_results = deepcopy(booster)
-    elif isinstance(booster, Booster):
+    if isinstance(booster, Booster):
         raise TypeError("booster must be a dict from record_evaluation() "
                         "or an LGBMModel (reference behavior)")
+    if isinstance(booster, LGBMModel):
+        source = booster.evals_result_
+    elif isinstance(booster, dict):
+        source = booster
     else:
         raise TypeError("booster must be dict or LGBMModel.")
-    if not eval_results:
+    if not source:
         raise ValueError("eval results cannot be empty.")
+    eval_results = deepcopy(source)
 
-    if ax is None:
-        if figsize is not None:
-            _require_pair(figsize, "figsize")
-        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
-
-    if dataset_names is None:
-        dataset_names_iter = iter(eval_results.keys())
-    else:
-        dataset_names_iter = iter(dataset_names)
-    name = next(dataset_names_iter)
-    metrics_for_one = eval_results[name]
-    num_metric = len(metrics_for_one)
+    # resolve the metric name from the first dataset, then pull one curve
+    # per requested dataset
+    datasets = (list(eval_results.keys()) if dataset_names is None
+                else list(dataset_names))
+    first = eval_results[datasets[0]]
     if metric is None:
-        if num_metric > 1:
+        if len(first) > 1:
             raise ValueError("more than one metric available, pick one with "
                              "the metric parameter")
-        metric, results = list(metrics_for_one.items())[0]
-    else:
-        if metric not in metrics_for_one:
-            raise KeyError("No given metric in eval results.")
-        results = metrics_for_one[metric]
-    num_iteration = len(results)
-    max_result = max(results)
-    min_result = min(results)
-    x_ = range(num_iteration)
-    ax.plot(x_, results, label=name)
-    for name in dataset_names_iter:
-        metrics_for_one = eval_results[name]
-        results = metrics_for_one[metric]
-        max_result = max(*results, max_result)
-        min_result = min(*results, min_result)
-        ax.plot(x_, results, label=name)
+        metric = next(iter(first))
+    elif metric not in first:
+        raise KeyError("No given metric in eval results.")
+    curves = [(name, eval_results[name][metric]) for name in datasets]
+
+    if ax is None:
+        ax = _new_axes(figsize, dpi)
+    for name, series in curves:
+        ax.plot(range(len(series)), series, label=name)
     ax.legend(loc="best")
-    if xlim is not None:
-        _require_pair(xlim, "xlim")
-    else:
-        xlim = (0, num_iteration)
-    ax.set_xlim(xlim)
-    if ylim is not None:
-        _require_pair(ylim, "ylim")
-    else:
-        range_result = max_result - min_result
-        ylim = (min_result - range_result * 0.2,
-                max_result + range_result * 0.2)
-    ax.set_ylim(ylim)
-    if title is not None:
-        ax.set_title(title)
-    if xlabel is not None:
-        ax.set_xlabel(xlabel)
-    if ylabel is not None:
-        ax.set_ylabel(ylabel.replace("@metric@", metric))
-    ax.grid(grid)
-    return ax
+
+    n_iters = max(len(series) for _, series in curves)
+    lo = min(min(series) for _, series in curves)
+    hi = max(max(series) for _, series in curves)
+    margin = (hi - lo) * 0.2
+    return _decorate(ax, xlim=xlim, ylim=ylim,
+                     auto_xlim=(0, n_iters),
+                     auto_ylim=(lo - margin, hi + margin),
+                     title=title, xlabel=xlabel,
+                     ylabel=(None if ylabel is None
+                             else ylabel.replace("@metric@", metric)),
+                     grid=grid)
 
 
 def _float2str(value, precision: Optional[int] = 3) -> str:
@@ -332,12 +318,8 @@ def plot_tree(
     """Render one tree with matplotlib.  Uses graphviz when available
     (reference behavior); otherwise falls back to a pure-matplotlib
     layout so the function works in this hermetic environment."""
-    import matplotlib.pyplot as plt
-
     if ax is None:
-        if figsize is not None:
-            _require_pair(figsize, "figsize")
-        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
+        ax = _new_axes(figsize, dpi)
     try:
         from graphviz import Digraph  # noqa: F401
         graph = create_tree_digraph(booster, tree_index, show_info, precision,
