@@ -1,0 +1,120 @@
+"""Learner-composition capability matrix.
+
+The reference composes tree learners orthogonally through virtual
+dispatch (``tree_learner.cpp:31-44`` instantiates serial/feature/data/
+voting × CPU/GPU/CUDA); this build instead specializes compiled layouts,
+so some (learner × option) combinations downgrade to a safe layout or are
+rejected.  Every such decision lives HERE as one declarative rule —
+``resolve()`` is the single choke point GBDT routes through, so the
+matrix of silently-degraded configs is inspectable and enumerable by
+tests (``tests/test_capabilities.py``) instead of scattered ad-hoc warns.
+
+Two static layout predicates complete the matrix but live with their
+layouts: ``grower.fp_capable_for`` (feature-sharded perm layout
+eligibility) and the ``packed4`` gate in ``GBDT.__init__`` (4-bit bins ×
+EFB / feature-parallel exclusion).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, List, Optional, Tuple
+
+
+@dataclasses.dataclass
+class Composition:
+    """The mutable facts ``resolve`` adjudicates.  ``voting``/``leaf_batch``
+    are the two downgrade targets; everything else is read-only context."""
+
+    voting: bool
+    leaf_batch: int
+    mono_method: str            # "none" | "basic" | "intermediate" | "advanced"
+    forced_splits: bool
+    extra_trees: bool
+    feature_fraction_bynode: bool
+    interaction_constraints: bool
+    cegb: bool
+
+
+def _mono_refresh(c: Composition) -> bool:
+    # intermediate/advanced recompute bounds + best splits every step
+    return c.mono_method in ("intermediate", "advanced")
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    name: str
+    applies: Callable[[Composition], bool]
+    action: str                 # "error" | "fallback"
+    message: str
+    fix: Optional[Callable[[Composition], Composition]] = None
+
+
+RULES: Tuple[Rule, ...] = (
+    Rule("voting-x-randomness-or-cegb",
+         lambda c: c.voting and (c.extra_trees or c.feature_fraction_bynode
+                                 or c.interaction_constraints or c.cegb),
+         "fallback",
+         "tree_learner=voting does not compose with extra_trees/"
+         "feature_fraction_bynode/interaction_constraints/CEGB; "
+         "falling back to data-parallel",
+         lambda c: dataclasses.replace(c, voting=False)),
+    Rule("forced-x-wave",
+         lambda c: c.forced_splits and c.leaf_batch > 1,
+         "fallback",
+         "forced splits require sequential leaf-wise growth; disabling "
+         "wave batching (tpu_leaf_batch=1)",
+         lambda c: dataclasses.replace(c, leaf_batch=1)),
+    Rule("forced-x-voting",
+         lambda c: c.forced_splits and c.voting,
+         "fallback",
+         "tree_learner=voting does not compose with forced splits; "
+         "falling back to data-parallel",
+         lambda c: dataclasses.replace(c, voting=False)),
+    Rule("mono-refresh-x-wave",
+         lambda c: _mono_refresh(c) and c.leaf_batch > 1,
+         "fallback",
+         "monotone_constraints_method=intermediate/advanced requires "
+         "sequential leaf-wise growth; disabling wave batching "
+         "(tpu_leaf_batch=1)",
+         lambda c: dataclasses.replace(c, leaf_batch=1)),
+    Rule("mono-refresh-x-voting",
+         lambda c: _mono_refresh(c) and c.voting,
+         "fallback",
+         "tree_learner=voting does not compose with "
+         "monotone_constraints_method=intermediate/advanced; falling back "
+         "to data-parallel",
+         lambda c: dataclasses.replace(c, voting=False)),
+    Rule("mono-refresh-x-randomness",
+         lambda c: _mono_refresh(c) and (c.extra_trees
+                                         or c.feature_fraction_bynode),
+         "error",
+         "monotone_constraints_method=intermediate/advanced does not "
+         "compose with extra_trees / feature_fraction_bynode; use "
+         "monotone_constraints_method=basic"),
+    Rule("mono-advanced-x-forced",
+         lambda c: c.mono_method == "advanced" and c.forced_splits,
+         "error",
+         "monotone_constraints_method=advanced does not compose with "
+         "forced_splits; use intermediate"),
+)
+
+
+def resolve(comp: Composition,
+            warn: Optional[Callable[[str], None]] = None
+            ) -> Tuple[Composition, List[Rule]]:
+    """Apply every matching rule in order.  ``error`` rules raise
+    ``ValueError(message)``; ``fallback`` rules rewrite the composition and
+    report through ``warn``.  Returns the resolved composition plus the
+    rules that fired (for tests/introspection)."""
+    fired: List[Rule] = []
+    for rule in RULES:
+        if not rule.applies(comp):
+            continue
+        if rule.action == "error":
+            raise ValueError(rule.message)
+        comp = rule.fix(comp)
+        fired.append(rule)
+        if warn is not None:
+            warn(rule.message)
+    return comp, fired

@@ -135,7 +135,6 @@ class GBDT:
         from ..parallel.mesh import mesh_for_tree_learner, shard_arrays
         self.mesh = mesh_for_tree_learner(cfg.tree_learner)
         self.feature_sampler = FeatureSampler(cfg, train.num_features)
-        from ..utils.log import Log as _Log
         has_mono = (train.monotone_constraints is not None
                     and np.any(train.monotone_constraints != 0))
         mono_method = cfg.monotone_constraints_method
@@ -146,12 +145,6 @@ class GBDT:
                 "expected basic, intermediate or advanced")
         self._mono_advanced = has_mono and mono_method == "advanced"
         self._mono_intermediate = has_mono and mono_method == "intermediate"
-        if ((self._mono_intermediate or self._mono_advanced)
-                and (cfg.extra_trees or cfg.feature_fraction_bynode < 1.0)):
-            raise ValueError(
-                f"monotone_constraints_method={mono_method} does not "
-                "compose with extra_trees / feature_fraction_bynode; use "
-                "monotone_constraints_method=basic")
         # is_enable_sparse is subsumed by EFB (enable_bundle), which covers
         # the sparse-column win here — say so loudly instead of silently
         # ignoring it.
@@ -192,17 +185,6 @@ class GBDT:
                           and int(self.mesh.shape[FEATURE_AXIS]) == 1)
         hist_impl = cfg.tpu_histogram_impl
         voting = cfg.tree_learner == "voting" and data_only_mesh
-        if voting and (cfg.extra_trees or cfg.feature_fraction_bynode < 1.0
-                       or cfg.interaction_constraints
-                       or bool(cfg.cegb_penalty_split > 0.0
-                               or cfg.cegb_penalty_feature_coupled
-                               or cfg.cegb_penalty_feature_lazy
-                               or cfg.cegb_tradeoff < 1.0)):
-            Log.warning(
-                "tree_learner=voting does not compose with extra_trees/"
-                "feature_fraction_bynode/interaction_constraints/CEGB; "
-                "falling back to data-parallel")
-            voting = False
         # EFB (reference FindGroups/FeatureGroup): histogram/partition run
         # on the bundled column matrix; split scans see reconstructed
         # per-feature views (models/grower.py _expand_hist).
@@ -237,33 +219,26 @@ class GBDT:
                 if "right" in spec and spec["right"]:
                     queue.append((spec["right"], idx, False))
             forced = tuple(tuple(nd) for nd in nodes)
-            if leaf_batch > 1:
-                Log.warning("forced splits require sequential leaf-wise "
-                            "growth; disabling wave batching "
-                            "(tpu_leaf_batch=1)")
-                leaf_batch = 1
-            if voting:
-                Log.warning("tree_learner=voting does not compose with "
-                            "forced splits; falling back to data-parallel")
-                voting = False
         if self.bundles is not None:
             Log.info(f"EFB: bundled {train.num_features} features into "
                      f"{self.bundles.num_groups} columns")
-        mono_refresh = self._mono_intermediate or self._mono_advanced
-        if mono_refresh and leaf_batch > 1:
-            Log.warning("monotone_constraints_method=intermediate/advanced "
-                        "requires sequential leaf-wise growth; disabling "
-                        "wave batching (tpu_leaf_batch=1)")
-            leaf_batch = 1
-        if mono_refresh and voting:
-            Log.warning("tree_learner=voting does not compose with "
-                        "monotone_constraints_method=intermediate/advanced; "
-                        "falling back to data-parallel")
-            voting = False
-        if self._mono_advanced and forced:
-            raise ValueError(
-                "monotone_constraints_method=advanced does not compose "
-                "with forced_splits; use intermediate")
+        # Every learner-composition downgrade/rejection goes through the
+        # declarative capability matrix (models/capabilities.py) — ONE
+        # enumerable table instead of scattered warn-and-fallback branches.
+        from .capabilities import Composition, resolve
+        comp, _ = resolve(Composition(
+            voting=voting,
+            leaf_batch=leaf_batch,
+            mono_method=mono_method if has_mono else "none",
+            forced_splits=forced is not None,
+            extra_trees=cfg.extra_trees,
+            feature_fraction_bynode=cfg.feature_fraction_bynode < 1.0,
+            interaction_constraints=bool(cfg.interaction_constraints),
+            cegb=bool(cfg.cegb_penalty_split > 0.0
+                      or cfg.cegb_penalty_feature_coupled
+                      or cfg.cegb_penalty_feature_lazy
+                      or cfg.cegb_tradeoff < 1.0)), warn=Log.warning)
+        voting, leaf_batch = comp.voting, comp.leaf_batch
         self.grower_cfg = GrowerConfig(
             num_leaves=cfg.num_leaves,
             max_depth=cfg.max_depth,

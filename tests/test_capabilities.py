@@ -1,0 +1,104 @@
+"""The learner-composition capability matrix (models/capabilities.py):
+every warn-and-fallback / rejection decision is a declarative rule, and
+this test enumerates the full (option-combination) space against the
+matrix so no silently-degraded config exists outside it.  Reference
+contrast: tree_learner.cpp:31-44 composes learners orthogonally."""
+
+import itertools
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.models.capabilities import RULES, Composition, resolve
+
+
+def _comp(**kw):
+    base = dict(voting=False, leaf_batch=1, mono_method="none",
+                forced_splits=False, extra_trees=False,
+                feature_fraction_bynode=False,
+                interaction_constraints=False, cegb=False)
+    base.update(kw)
+    return Composition(**base)
+
+
+def test_rule_names_unique_and_actions_valid():
+    names = [r.name for r in RULES]
+    assert len(names) == len(set(names))
+    for r in RULES:
+        assert r.action in ("error", "fallback")
+        assert (r.fix is None) == (r.action == "error")
+
+
+def test_matrix_enumeration_is_total():
+    """Resolve the FULL boolean space: every outcome must be a fixed point
+    (no rule still applies after resolve) or an error — i.e. the matrix
+    is closed under its own fallbacks."""
+    mono_methods = ("none", "basic", "intermediate", "advanced")
+    flags = list(itertools.product((False, True), repeat=6))
+    checked = errors = fallbacks = 0
+    for mono in mono_methods:
+        for voting, forced, extra, bynode, cegb, inter in flags:
+            for leaf_batch in (1, 16):
+                comp = _comp(voting=voting, leaf_batch=leaf_batch,
+                             mono_method=mono, forced_splits=forced,
+                             extra_trees=extra,
+                             feature_fraction_bynode=bynode, cegb=cegb,
+                             interaction_constraints=inter)
+                checked += 1
+                try:
+                    out, fired = resolve(comp)
+                except ValueError:
+                    errors += 1
+                    continue
+                fallbacks += bool(fired)
+                for r in RULES:
+                    if r.action == "fallback":
+                        assert not r.applies(out), (r.name, comp)
+    assert checked == 4 * 64 * 2
+    assert errors and fallbacks        # both classes actually exercised
+
+
+@pytest.mark.parametrize("kw,expect_voting,expect_batch", [
+    (dict(voting=True, extra_trees=True, leaf_batch=16), False, 16),
+    (dict(voting=True, forced_splits=True, leaf_batch=16), False, 1),
+    (dict(mono_method="intermediate", leaf_batch=16), False, 1),
+    (dict(mono_method="advanced", voting=True, leaf_batch=16), False, 1),
+])
+def test_fallback_outcomes(kw, expect_voting, expect_batch):
+    out, fired = resolve(_comp(**kw))
+    assert out.voting == expect_voting
+    assert out.leaf_batch == expect_batch
+    assert fired
+
+
+@pytest.mark.parametrize("kw", [
+    dict(mono_method="intermediate", extra_trees=True),
+    dict(mono_method="advanced", feature_fraction_bynode=True),
+    dict(mono_method="advanced", forced_splits=True),
+])
+def test_error_outcomes(kw):
+    with pytest.raises(ValueError, match="does not compose"):
+        resolve(_comp(**kw))
+
+
+def test_gbdt_routes_through_matrix(capsys):
+    """The driver's downgrades must be the matrix's downgrades (same
+    messages, same effects)."""
+    rng = np.random.RandomState(0)
+    X = rng.rand(1500, 4)
+    y = 2 * X[:, 0] + 0.1 * rng.randn(1500)
+    bst = lgb.train({"objective": "regression", "num_leaves": 15,
+                     "monotone_constraints": [1, 0, 0, 0],
+                     "monotone_constraints_method": "intermediate",
+                     "tpu_leaf_batch": 8, "verbosity": 1},
+                    lgb.Dataset(X, label=y), 2)
+    out = capsys.readouterr()
+    assert "tpu_leaf_batch=1" in out.out + out.err
+    assert bst._gbdt.grower_cfg.leaf_batch == 1
+    with pytest.raises(ValueError, match="extra_trees"):
+        lgb.train({"objective": "regression", "num_leaves": 15,
+                   "monotone_constraints": [1, 0, 0, 0],
+                   "monotone_constraints_method": "intermediate",
+                   "extra_trees": True, "verbosity": -1},
+                  lgb.Dataset(X, label=y), 2)
