@@ -71,13 +71,6 @@ RULES: Tuple[Rule, ...] = (
          "tree_learner=voting does not compose with forced splits; "
          "falling back to data-parallel",
          lambda c: dataclasses.replace(c, voting=False)),
-    Rule("mono-refresh-x-wave",
-         lambda c: _mono_refresh(c) and c.leaf_batch > 1,
-         "fallback",
-         "monotone_constraints_method=intermediate/advanced requires "
-         "sequential leaf-wise growth; disabling wave batching "
-         "(tpu_leaf_batch=1)",
-         lambda c: dataclasses.replace(c, leaf_batch=1)),
     Rule("mono-refresh-x-voting",
          lambda c: _mono_refresh(c) and c.voting,
          "fallback",

@@ -117,9 +117,11 @@ class GrowerConfig:
     # TPU-shaped equivalent of the reference's recursive
     # GoUpToFindLeavesToUpdate tree walk), and every leaf's stored best
     # split is refreshed against the new bounds from its resident
-    # histogram (the reference's RecomputeBestSplitForLeaf).  Sequential
-    # growth only (leaf_batch=1): simultaneous wave splits of adjacent
-    # leaves could violate each other's freshly-derived bounds.
+    # histogram (the reference's RecomputeBestSplitForLeaf).  Composes
+    # with wave growth through conflict-free wave selection: leaves
+    # ORDERED by a monotone relation never split in the same wave, so the
+    # pre-wave bounds stay valid through the wave and ONE refresh runs per
+    # wave instead of per split.
     mono_intermediate: bool = False
     # Advanced monotone mode (reference AdvancedLeafConstraints,
     # monotone_constraints.hpp:583): on top of the intermediate per-step
@@ -455,12 +457,11 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
     adv = cfg.mono_advanced and cfg.split.has_monotone
     inter = (cfg.mono_intermediate or adv) and cfg.split.has_monotone
     fp_capable = fp_capable_for(cfg, mesh, data_axis)
-    if inter and (cfg.leaf_batch > 1 or cfg.voting):
+    if inter and cfg.voting:
         raise ValueError(
-            "monotone_constraints_method=intermediate/advanced requires "
-            "sequential growth (leaf_batch=1, non-voting): simultaneous "
-            "splits of adjacent leaves could violate each other's fresh "
-            "bounds")
+            "monotone_constraints_method=intermediate/advanced does not "
+            "compose with tree_learner=voting (the refresh needs the full "
+            "leaf histograms resident, voting keeps them local)")
     if inter and need_key:
         raise ValueError(
             "monotone_constraints_method=intermediate/advanced does not "
@@ -951,6 +952,29 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
                 -INF))
         return LLO, LHI, RLO, RHI
 
+    def _pair_up(st, mono):
+        """(L, L) bool: out_j upper-bounds leaf i's future children — j sits
+        wholly on i's output-increasing side along some monotone feature
+        while overlapping i in every other dimension.  The vectorized
+        equivalent of the reference's GoUpToFindLeavesToUpdate contiguity
+        walk, shared by the per-step refresh and the wave conflict
+        filter."""
+        f = mono.shape[0]
+        lo_r, hi_r = st.leaf_bin_lo, st.leaf_bin_hi            # (L, F)
+        alive = jnp.arange(L) < st.num_leaves
+        o_lo, o_hi = lo_r[:, None, :], hi_r[:, None, :]
+        t_lo, t_hi = lo_r[None, :, :], hi_r[None, :, :]
+        overlap = (o_lo < t_hi) & (t_lo < o_hi)                # (L, L, F)
+        n_overlap = jnp.sum(overlap, axis=-1)                  # (L, L)
+        # pair (i, j) is adjacent along f iff their rectangles overlap in
+        # every OTHER feature dimension
+        adj = (n_overlap[:, :, None]
+               - overlap.astype(jnp.int32)) == (f - 1)
+        inc = (mono > 0)[None, None, :]
+        dec = (mono < 0)[None, None, :]
+        upper = adj & ((inc & (o_hi <= t_lo)) | (dec & (t_hi <= o_lo)))
+        return jnp.any(upper, axis=-1) & alive[:, None] & alive[None, :]
+
     def _inter_refresh(st, scale3, meta, feature_mask, cegb=None,
                        groups_mat=None):
         """Intermediate monotone mode, per-step bound + best-split refresh.
@@ -970,24 +994,8 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
         (L, F, B, 3) leaf_hist makes this a data-reuse win, not a rescan of
         rows)."""
         mono = meta[3]
-        f = mono.shape[0]
-        lo_r, hi_r = st.leaf_bin_lo, st.leaf_bin_hi            # (L, F)
         alive = jnp.arange(L) < st.num_leaves
-        o_lo, o_hi = lo_r[:, None, :], hi_r[:, None, :]
-        t_lo, t_hi = lo_r[None, :, :], hi_r[None, :, :]
-        overlap = (o_lo < t_hi) & (t_lo < o_hi)                # (L, L, F)
-        n_overlap = jnp.sum(overlap, axis=-1)                  # (L, L)
-        # pair (i, j) is adjacent along f iff their rectangles overlap in
-        # every OTHER feature dimension
-        adj = (n_overlap[:, :, None]
-               - overlap.astype(jnp.int32)) == (f - 1)
-        inc = (mono > 0)[None, None, :]
-        dec = (mono < 0)[None, None, :]
-        # out_j upper-bounds leaf i's future children when j sits wholly on
-        # i's increasing side (or decreasing side under a negative
-        # constraint) in an adjacent position
-        upper = adj & ((inc & (o_hi <= t_lo)) | (dec & (t_hi <= o_lo)))
-        pair_up = jnp.any(upper, axis=-1) & alive[:, None] & alive[None, :]
+        pair_up = _pair_up(st, mono)
         out = st.leaf_out
         new_hi = jnp.min(jnp.where(pair_up, out[None, :], jnp.inf), axis=1)
         new_lo = jnp.max(jnp.where(pair_up.T, out[None, :], -jnp.inf),
@@ -1563,6 +1571,25 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
             top_g, top_l = jax.lax.top_k(st.best_gain, W)
             slot = jnp.arange(W, dtype=jnp.int32)
             active = (top_g > _NEG_INF) & (slot < budget)
+            if inter:
+                # Conflict-free wave (per-wave bound recomputation): two
+                # leaves ORDERED by a monotone relation must not split in
+                # the same wave — each one's pre-wave bound assumes the
+                # other's output stays put for the wave.  Greedily keep
+                # candidates in gain order that are unordered w.r.t. every
+                # kept candidate; skipped leaves stay pending, so the
+                # executed split sequence remains best-first.
+                pu = _pair_up(st, meta[3])
+                rel = pu | pu.T
+                cand_rel = rel[top_l][:, top_l]                # (W, W)
+                wslot = jnp.arange(W)
+
+                def _sel(j, keep):
+                    clash = jnp.any(keep & (wslot < j) & cand_rel[j])
+                    return keep.at[j].set(keep[j] & ~clash)
+
+                keep = jax.lax.fori_loop(0, W, _sel, jnp.ones(W, bool))
+                active = active & keep
             n_act = jnp.sum(active.astype(jnp.int32))
             rank = (jnp.cumsum(active.astype(jnp.int32))
                     - active.astype(jnp.int32))
@@ -1641,7 +1668,44 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
             out_l = smoothed_output(gl, hl, cl, pout, cfg.split)
             out_r = smoothed_output(gr, hr, cr, pout, cfg.split)
             bounds2 = None
-            if cfg.split.has_monotone:
+            if cfg.split.has_monotone and inter:
+                # Intermediate/advanced: clip to the pre-wave refreshed
+                # bounds (per-threshold slices when advanced); children
+                # inherit the parent bounds verbatim and the REAL bounds
+                # come from the post-wave refresh.  Track child bin
+                # rectangles for the adjacency pass.
+                plo, phi = st.leaf_lo[top_l], st.leaf_hi[top_l]
+                if adv:
+                    out_l = jnp.clip(out_l, st.adv_llo[top_l],
+                                     st.adv_lhi[top_l])
+                    out_r = jnp.clip(out_r, st.adv_rlo[top_l],
+                                     st.adv_rhi[top_l])
+                else:
+                    out_l = jnp.clip(out_l, plo, phi)
+                    out_r = jnp.clip(out_r, plo, phi)
+                cut = (sbins + 1)[:, None]
+                lo_p = st.leaf_bin_lo[top_l]                   # (W, F)
+                hi_p = st.leaf_bin_hi[top_l]
+                fhot1 = jnp.arange(lo_p.shape[1])[None, :] == feats[:, None]
+                isnum = (~scats)[:, None]
+                hi_l_r = jnp.where(fhot1 & isnum,
+                                   jnp.minimum(hi_p, cut), hi_p)
+                lo_r_r = jnp.where(fhot1 & isnum,
+                                   jnp.maximum(lo_p, cut), lo_p)
+                pair_idx = jnp.concatenate([leaf_j, newleaf_j])
+                st = st._replace(
+                    leaf_bin_lo=st.leaf_bin_lo.at[pair_idx].set(
+                        jnp.concatenate([lo_p, lo_r_r]), mode="drop"),
+                    leaf_bin_hi=st.leaf_bin_hi.at[pair_idx].set(
+                        jnp.concatenate([hi_l_r, hi_p]), mode="drop"),
+                    leaf_lo=st.leaf_lo.at[pair_idx].set(
+                        jnp.concatenate([plo, plo]), mode="drop"),
+                    leaf_hi=st.leaf_hi.at[pair_idx].set(
+                        jnp.concatenate([phi, phi]), mode="drop"))
+                # bounds2 stays None: the children best-split pass is
+                # skipped on this path (the per-wave refresh recomputes
+                # every leaf's split against fresh bounds)
+            elif cfg.split.has_monotone:
                 plo, phi = st.leaf_lo[top_l], st.leaf_hi[top_l]
                 out_l = jnp.clip(out_l, plo, phi)
                 out_r = jnp.clip(out_r, plo, phi)
@@ -1740,13 +1804,25 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
                 coupled, lazy = cegb
                 feat_used = st.feat_used | jnp.any(fhot, axis=0)
                 st = st._replace(feat_used=feat_used)
-                pen_l = jax.vmap(
-                    lambda c, p: _cegb_penalty(c, feat_used, p, coupled,
-                                               lazy))(cl, child_path)
-                pen_r = jax.vmap(
-                    lambda c, p: _cegb_penalty(c, feat_used, p, coupled,
-                                               lazy))(cr, child_path)
-                penalty2 = cat2(pen_l, pen_r)
+                if not inter:
+                    # the inter path's refresh recomputes penaltyL for all
+                    # leaves; computing the per-child pair here would be
+                    # dead work in the jitted hot loop
+                    pen_l = jax.vmap(
+                        lambda c, p: _cegb_penalty(c, feat_used, p, coupled,
+                                                   lazy))(cl, child_path)
+                    pen_r = jax.vmap(
+                        lambda c, p: _cegb_penalty(c, feat_used, p, coupled,
+                                                   lazy))(cr, child_path)
+                    penalty2 = cat2(pen_l, pen_r)
+
+            if inter:
+                # Per-wave bound + best-split refresh over ALL leaves — the
+                # wave analog of the sequential per-split refresh.  The 2W
+                # children's searches are part of the full rescan, so the
+                # dedicated children pass below is skipped.
+                return _inter_refresh(st, scale3, meta, feature_mask, cegb,
+                                      groups_mat)
 
             # ---- best splits for all 2W children in one vmapped search
             node_key = None

@@ -59,17 +59,19 @@ def test_matrix_enumeration_is_total():
     assert errors and fallbacks        # both classes actually exercised
 
 
-@pytest.mark.parametrize("kw,expect_voting,expect_batch", [
-    (dict(voting=True, extra_trees=True, leaf_batch=16), False, 16),
-    (dict(voting=True, forced_splits=True, leaf_batch=16), False, 1),
-    (dict(mono_method="intermediate", leaf_batch=16), False, 1),
-    (dict(mono_method="advanced", voting=True, leaf_batch=16), False, 1),
+@pytest.mark.parametrize("kw,expect_voting,expect_batch,expect_fired", [
+    (dict(voting=True, extra_trees=True, leaf_batch=16), False, 16, True),
+    (dict(voting=True, forced_splits=True, leaf_batch=16), False, 1, True),
+    # monotone refresh composes with wave growth (conflict-free selection)
+    (dict(mono_method="intermediate", leaf_batch=16), False, 16, False),
+    (dict(mono_method="advanced", voting=True, leaf_batch=16), False, 16,
+     True),
 ])
-def test_fallback_outcomes(kw, expect_voting, expect_batch):
+def test_fallback_outcomes(kw, expect_voting, expect_batch, expect_fired):
     out, fired = resolve(_comp(**kw))
     assert out.voting == expect_voting
     assert out.leaf_batch == expect_batch
-    assert fired
+    assert bool(fired) == expect_fired
 
 
 @pytest.mark.parametrize("kw", [
@@ -82,15 +84,17 @@ def test_error_outcomes(kw):
         resolve(_comp(**kw))
 
 
-def test_gbdt_routes_through_matrix(capsys):
+def test_gbdt_routes_through_matrix(capsys, tmp_path):
     """The driver's downgrades must be the matrix's downgrades (same
     messages, same effects)."""
     rng = np.random.RandomState(0)
     X = rng.rand(1500, 4)
     y = 2 * X[:, 0] + 0.1 * rng.randn(1500)
+    import json
+    forced_path = tmp_path / "forced.json"
+    forced_path.write_text(json.dumps({"feature": 1, "threshold": 0.5}))
     bst = lgb.train({"objective": "regression", "num_leaves": 15,
-                     "monotone_constraints": [1, 0, 0, 0],
-                     "monotone_constraints_method": "intermediate",
+                     "forcedsplits_filename": str(forced_path),
                      "tpu_leaf_batch": 8, "verbosity": 1},
                     lgb.Dataset(X, label=y), 2)
     out = capsys.readouterr()
