@@ -12,6 +12,17 @@ reference, chosen for the TPU storage model:
   scan can peel it off with a static slice instead of per-feature bin bookkeeping.
 - Categorical bins are ordered by descending category frequency (rare categories
   beyond ``max_bin`` collapse into the last bin).
+
+On the reference's ``SparseBin`` (``src/io/sparse_bin.hpp:73``, delta-encoded
+sparse column storage): that structure exists to serve the CPU's pointer-chasing
+scan; on TPU the histogram is a dense MXU contraction over gathered row blocks,
+so a sparse post-binning layout would force serialized scatters.  The roles
+SparseBin plays are covered TPU-natively instead: sparse INGESTION bins straight
+from CSC without densifying (``_bin_sparse_matrix``, O(nnz) peak), EFB bundles
+mutually-exclusive sparse columns into shared histogram columns (the compaction
+win), and 4-bit nibble packing (``ops/histogram.pack_bins4``) halves the dense
+matrix whenever every feature fits 16 bins — the reference's own ``IS_4BIT``
+dense arm, which is what LightGBM itself uses once sparse columns are bundled.
 """
 
 from __future__ import annotations

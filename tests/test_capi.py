@@ -1317,3 +1317,66 @@ def test_capi_network_init_with_functions():
     finally:
         _check(lib, lib.LGBM_NetworkFree())
     assert C._comm_backend is None
+
+
+def test_capi_sparse_predict_output_csc():
+    """CSC matrix_type: input is column-compressed and the output is a CSC
+    matrix over the (num_data, num_feature+1) contribution block — col_ptr
+    of length ncols_out+1 per class (reference Booster::PredictSparseCSC)."""
+    import scipy.sparse as sp
+
+    lib = _load()
+    rng = np.random.RandomState(11)
+    n, f = 250, 5
+    X = rng.randn(n, f)
+    X[rng.rand(n, f) < 0.35] = 0.0
+    y = (X[:, 0] - X[:, 1] > 0).astype(float)
+    ds = _dataset_from_mat(lib, X, y)
+    bst = ctypes.c_void_p()
+    _check(lib, lib.LGBM_BoosterCreate(
+        ds, b"objective=binary num_leaves=7 verbosity=-1", ctypes.byref(bst)))
+    fin = ctypes.c_int()
+    for _ in range(4):
+        _check(lib, lib.LGBM_BoosterUpdateOneIter(bst, ctypes.byref(fin)))
+
+    Xcsc = sp.csc_matrix(X)
+    col_ptr = np.ascontiguousarray(Xcsc.indptr, np.int32)
+    indices = np.ascontiguousarray(Xcsc.indices, np.int32)
+    data = np.ascontiguousarray(Xcsc.data, np.float64)
+    out_len = (ctypes.c_int64 * 2)()
+    oip = ctypes.c_void_p()
+    oix = ctypes.POINTER(ctypes.c_int32)()
+    odt = ctypes.c_void_p()
+    _check(lib, lib.LGBM_BoosterPredictSparseOutput(
+        bst, col_ptr.ctypes.data_as(ctypes.c_void_p), ctypes.c_int(2),
+        indices.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        data.ctypes.data_as(ctypes.c_void_p), ctypes.c_int(1),
+        ctypes.c_int64(len(col_ptr)), ctypes.c_int64(len(data)),
+        ctypes.c_int64(n),               # CSC: num rows
+        ctypes.c_int(3), ctypes.c_int(0), ctypes.c_int(-1), b"",
+        ctypes.c_int(1),                 # C_API_MATRIX_TYPE_CSC
+        out_len, ctypes.byref(oip), ctypes.byref(oix), ctypes.byref(odt)))
+    nnz, ip_len = out_len[0], out_len[1]
+    assert ip_len == f + 2               # (ncols_out + 1) per class
+    got_ip = np.ctypeslib.as_array(
+        ctypes.cast(oip, ctypes.POINTER(ctypes.c_int32)),
+        shape=(ip_len,)).copy()
+    got_ix = np.ctypeslib.as_array(oix, shape=(max(nnz, 1),))[:nnz].copy()
+    got_dt = np.ctypeslib.as_array(
+        ctypes.cast(odt, ctypes.POINTER(ctypes.c_double)),
+        shape=(max(nnz, 1),))[:nnz].copy()
+    contrib_csc = sp.csc_matrix((got_dt, got_ix, got_ip),
+                                shape=(n, f + 1)).toarray()
+    _check(lib, lib.LGBM_BoosterFreePredictSparse(
+        oip, oix, odt, ctypes.c_int(2), ctypes.c_int(1)))
+
+    # parity vs the dense contrib path on the same rows
+    dense = (ctypes.c_double * (n * (f + 1)))()
+    m = ctypes.c_int64()
+    X32 = np.ascontiguousarray(X, np.float32)
+    _check(lib, lib.LGBM_BoosterPredictForMat(
+        bst, X32.ctypes.data_as(ctypes.c_void_p), 0, ctypes.c_int32(n),
+        ctypes.c_int32(f), ctypes.c_int(1), ctypes.c_int(3), ctypes.c_int(0),
+        ctypes.c_int(-1), b"", ctypes.byref(m), dense))
+    np.testing.assert_allclose(
+        contrib_csc, np.array(dense[:]).reshape(n, f + 1), rtol=1e-9)

@@ -200,3 +200,26 @@ def test_advanced_rejects_forced_splits(tmp_path):
         lgb.train(dict(P, monotone_constraints_method="advanced",
                        forcedsplits_filename=str(path)),
                   lgb.Dataset(X, label=y), 2)
+
+
+def test_intermediate_sharded_wave_composes():
+    """Data-parallel + wave growth + monotone refresh: the conflict-free
+    wave selection runs on replicated state under shard_map, so the
+    sharded wave grower must train, stay monotone, and track the serial
+    wave grower closely."""
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    X, y = _mono_data(n=8 * 2000, seed=6)
+    params = dict(P, monotone_constraints_method="intermediate",
+                  tpu_leaf_batch=8, min_data_in_leaf=20)
+    serial = lgb.train(dict(params, tree_learner="serial"),
+                       lgb.Dataset(X, label=y), 5)
+    sharded = lgb.train(dict(params, tree_learner="data"),
+                        lgb.Dataset(X, label=y), 5)
+    assert sharded._gbdt.grower_cfg.leaf_batch == 8
+    assert _is_monotone(sharded)
+    mse_s = float(np.mean((serial.predict(X) - y) ** 2))
+    mse_d = float(np.mean((sharded.predict(X) - y) ** 2))
+    assert mse_d < mse_s * 1.05, (mse_d, mse_s)

@@ -1,0 +1,59 @@
+"""Capture a jax.profiler trace of ONE bench-config training iteration and
+print the top time sinks (VERDICT r4 ask #1: if vs_baseline < 1.0, name
+the top-3 sinks in PERF.md).
+
+    python tools/profile_iter.py [rows] [iters]
+
+Writes the trace to /tmp/tpu_trace (open with tensorboard or xprof) and
+prints a coarse wall-clock breakdown measured around the device fences.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    rows = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+    iters = int(sys.argv[2]) if len(sys.argv) > 2 else 3
+
+    import jax
+    import numpy as np
+
+    import lightgbm_tpu as lgb
+    from bench import (FEATURES, LEAF_BATCH, NUM_LEAVES,
+                       QUANTIZED, make_higgs_like)
+
+    X, y = make_higgs_like(rows, FEATURES)
+    # the same knobs bench.py honored, so the trace profiles the SAME
+    # compiled program the bench measured
+    params = {"objective": "binary", "num_leaves": NUM_LEAVES,
+              "learning_rate": 0.1, "max_bin": 255, "min_data_in_leaf": 0,
+              "min_sum_hessian_in_leaf": 100.0, "metric": "none",
+              "verbosity": -1, "tpu_leaf_batch": LEAF_BATCH}
+    if QUANTIZED:
+        params["use_quantized_grad"] = True
+    ds = lgb.Dataset(X, label=y)
+    ds.construct(params)
+    bst = lgb.Booster(params=params, train_set=ds)
+    bst.update()                                    # compile
+    np.array(jax.device_get(bst._gbdt.scores[:8]))  # fence
+
+    trace_dir = "/tmp/tpu_trace"
+    t0 = time.time()
+    with jax.profiler.trace(trace_dir):
+        for _ in range(iters):
+            t_it = time.time()
+            bst.update()
+            np.array(jax.device_get(bst._gbdt.scores[:8]))
+            print(f"iter wall: {time.time() - t_it:.3f}s")
+    total = time.time() - t0
+    print(f"{iters} iters in {total:.3f}s "
+          f"({rows * iters / total / 1e6:.2f} M row-iters/s)")
+    print(f"trace: {trace_dir} (tensorboard --logdir {trace_dir})")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,40 @@
+#!/bin/sh
+# One-command playbook for an unwedged-TPU window (VERDICT r4 top ask):
+#   1. 90s matmul probe — abort early if the chip is wedged
+#   2. scaled bench (1M rows x 20 iters) — fast signal, ~minutes
+#   3. full headline bench (10.5M x 60) — the BENCH_r{N} number
+#   4. if vs_baseline < 1, capture a one-iteration profiler trace
+# Results land in bench_result.json (+ stdout JSON lines) and traces in
+# /tmp/tpu_trace.
+set -e
+cd "$(dirname "$0")/.."
+
+echo "== probe =="
+timeout 95 python -c "
+import jax, jax.numpy as jnp, time
+t0 = time.time(); x = jnp.ones((64, 64)); (x @ x).block_until_ready()
+print('TPU OK %.1fs' % (time.time() - t0))" || {
+  echo "chip wedged; aborting"; exit 1; }
+
+echo "== scaled bench (1M x 20) =="
+BENCH_ROWS=1000000 BENCH_ITERS=20 BENCH_QUANT_CHECK=0 \
+  BENCH_RESULT_FILE=bench_result_1m.json python -u bench.py
+
+echo "== full bench (10.5M x 60) =="
+python -u bench.py
+VSB=$(python -c "
+import json
+print(json.load(open('bench_result.json'))['result']['vs_baseline'])")
+PLATFORM=$(python -c "
+import json
+print(json.load(open('bench_result.json'))['result']['detail']['platform'])")
+echo "vs_baseline: $VSB (platform: $PLATFORM)"
+
+# Profile only when an ACCELERATOR number came in under par — a
+# cpu-fallback result means the chip wedged mid-run and profiling would
+# hang on the dead tunnel (and trace the wrong backend anyway).
+BELOW=$(python -c "print(1 if float('$VSB') < 1.0 else 0)")
+if [ "$BELOW" = "1" ] && [ "$PLATFORM" != "cpu" ]; then
+  echo "== vs_baseline < 1: profiling one iteration =="
+  timeout 1200 python -u tools/profile_iter.py || true
+fi
