@@ -44,6 +44,26 @@ QUANT_CHECK = os.environ.get("BENCH_QUANT_CHECK", "1") == "1"
 QUANT_ITERS = int(os.environ.get("BENCH_QUANT_ITERS", 20))
 
 
+def bench_params():
+    """The headline training config (docs/Experiments.rst:82-91) with the
+    env knobs applied — shared with tools/profile_iter.py so a profiler
+    trace always compiles the SAME program the bench measured."""
+    params = {
+        "objective": "binary",
+        "num_leaves": NUM_LEAVES,
+        "learning_rate": 0.1,
+        "max_bin": 255,
+        "min_data_in_leaf": 0,
+        "min_sum_hessian_in_leaf": 100.0,
+        "metric": "none",
+        "verbosity": -1,
+        "tpu_leaf_batch": LEAF_BATCH,
+    }
+    if QUANTIZED:
+        params["use_quantized_grad"] = True
+    return params
+
+
 def make_higgs_like(n, f, seed=0):
     rng = np.random.RandomState(seed)
     X = rng.randn(n, f).astype(np.float32)
@@ -95,19 +115,7 @@ def run_bench(rows, iters):
     import lightgbm_tpu as lgb
 
     X, y = make_higgs_like(rows, FEATURES)
-    params = {
-        "objective": "binary",
-        "num_leaves": NUM_LEAVES,
-        "learning_rate": 0.1,
-        "max_bin": 255,
-        "min_data_in_leaf": 0,
-        "min_sum_hessian_in_leaf": 100.0,
-        "metric": "none",
-        "verbosity": -1,
-        "tpu_leaf_batch": LEAF_BATCH,
-    }
-    if QUANTIZED:
-        params["use_quantized_grad"] = True
+    params = bench_params()
     ds = lgb.Dataset(X, label=y)
     t_bin0 = time.time()
     ds.construct(params)

@@ -46,6 +46,19 @@ def run(argv: List[str]) -> int:
     task = params.pop("task", "train")
     cfg = Config(dict(params))
     if task in ("train", "save_binary"):
+        # Distributed bootstrap (reference Application::Train ->
+        # Network::Init from machines/machine_list_file): num_machines > 1
+        # brings up the multi-process jax runtime; the data mesh then spans
+        # every process's devices, so tree_learner=data/voting shard rows
+        # across machines exactly like the reference's socket cluster.
+        from .parallel.distributed import init_distributed, shutdown
+        rank, world = init_distributed(cfg)
+        if world > 1 and cfg.pre_partition:
+            Log.warning(
+                "pre_partition=true has no effect on the TPU build: every "
+                "rank loads the full data file and row placement is done "
+                "by the device mesh (per-rank pre-partitioned arrays are "
+                "supported through the library API / parallel.launcher)")
         data_path = params.pop("data", None)
         if not data_path:
             Log.fatal(f"task={task} requires data=<file>")
@@ -67,12 +80,17 @@ def run(argv: List[str]) -> int:
         if task == "save_binary" or cfg.save_binary:
             # reference application task=save_binary / save_binary=true:
             # write "<data>.bin" next to the input and, for the standalone
-            # task, stop there.
-            out_bin = data_path + ".bin"
+            # task, stop there.  One writer under distributed training —
+            # every rank holds the identical dataset and a shared
+            # filesystem path must not be raced.
             ds.construct(params)
-            ds.save_binary(out_bin)
-            Log.info(f"Saved binary dataset to {out_bin}")
+            if rank == 0:
+                out_bin = data_path + ".bin"
+                ds.save_binary(out_bin)
+                Log.info(f"Saved binary dataset to {out_bin}")
             if task == "save_binary":
+                if world > 1:
+                    shutdown()
                 return 0
         valid_sets, valid_names = [], []
         valid = params.pop("valid", params.pop("valid_data", ""))
@@ -83,13 +101,24 @@ def run(argv: List[str]) -> int:
             valid_names.append(f"valid_{i}")
         from .callback import log_evaluation
         init_model = cfg.input_model or None
-        bst = train_fn(dict(params), ds, num_boost_round=cfg.num_iterations,
-                       valid_sets=valid_sets, valid_names=valid_names,
-                       init_model=init_model,
-                       callbacks=[log_evaluation(cfg.metric_freq)])
-        out = cfg.output_model or "LightGBM_model.txt"
-        bst.save_model(out)
-        Log.info(f"Finished training; model saved to {out}")
+        try:
+            bst = train_fn(dict(params), ds,
+                           num_boost_round=cfg.num_iterations,
+                           valid_sets=valid_sets, valid_names=valid_names,
+                           init_model=init_model,
+                           callbacks=[log_evaluation(cfg.metric_freq)])
+            if rank == 0:
+                # every rank trains the identical replicated model; one
+                # writer avoids racing on a shared filesystem path
+                out = cfg.output_model or "LightGBM_model.txt"
+                bst.save_model(out)
+                Log.info(f"Finished training; model saved to {out}")
+            else:
+                Log.info(f"Finished training (rank {rank}/{world}; rank 0 "
+                         "writes the model)")
+        finally:
+            if world > 1:
+                shutdown()
         return 0
     if task == "predict":
         model_path = cfg.input_model or "LightGBM_model.txt"

@@ -393,11 +393,13 @@ class GBDT:
         num_class = self.num_class
         shape_k = self._shape_k
 
-        def grow_apply(scores_k, grad_k, hess_k, mask, fmask, shrink,
+        def grow_apply(bins, scores_k, grad_k, hess_k, mask, fmask, shrink,
                        cegb_coupled=None, cegb_lazy=None, quant_key=None,
                        split_key=None):
+            # bins rides as an ARGUMENT (not a closure): multi-process jit
+            # rejects closing over arrays spanning non-addressable devices
             arrays, row_leaf = grow(
-                self.bins_dev, grad_k, hess_k, mask, fmask,
+                bins, grad_k, hess_k, mask, fmask,
                 meta["num_bins_per_feature"], meta["nan_bins"],
                 meta["is_categorical"], meta["monotone"],
                 cegb_coupled, cegb_lazy, quant_key, split_key,
@@ -413,7 +415,7 @@ class GBDT:
         self._fused_iter = None
         if (obj is not None and not obj.need_renew_tree_output
                 and not obj.stochastic_gradients):
-            def fused(scores, mask, fmask, shrink, quant_key=None,
+            def fused(bins, scores, mask, fmask, shrink, quant_key=None,
                       split_key=None):
                 grad, hess = obj.get_gradients(scores)
                 outs = []
@@ -425,12 +427,12 @@ class GBDT:
                         sk = (None if split_key is None
                               else jax.random.fold_in(split_key, k))
                         ns_k, arrays, row_leaf = grow_apply(
-                            new_scores[:, k], grad[:, k], hess[:, k],
+                            bins, new_scores[:, k], grad[:, k], hess[:, k],
                             mask, fmask, shrink, quant_key=qk, split_key=sk)
                         new_scores = new_scores.at[:, k].set(ns_k)
                         outs.append((arrays, row_leaf))
                     return new_scores, outs
-                ns, arrays, row_leaf = grow_apply(scores, grad, hess,
+                ns, arrays, row_leaf = grow_apply(bins, scores, grad, hess,
                                                   mask, fmask, shrink,
                                                   quant_key=quant_key,
                                                   split_key=split_key)
@@ -524,7 +526,8 @@ class GBDT:
                 and not cfg.linear_tree):
             # Hot path: ONE device dispatch for gradients + all class trees +
             # score updates.
-            self.scores, outs = self._fused_iter(self.scores, mask_dev,
+            self.scores, outs = self._fused_iter(self.bins_dev,
+                                                 self.scores, mask_dev,
                                                  fmask, shrink, qkey, skey)
             results = [(k, a, rl) for k, (a, rl) in enumerate(outs)]
         else:
@@ -563,11 +566,11 @@ class GBDT:
                     coupled = jnp.asarray(
                         self._cegb_coupled_raw * (~self._cegb_used))
                     new_sk, arrays, row_leaf = self._grow_apply(
-                        sk, gk, hk, mask_dev, fmask, shrink,
+                        self.bins_dev, sk, gk, hk, mask_dev, fmask, shrink,
                         coupled, self._cegb_lazy_dev, qk, nk)
                 else:
                     new_sk, arrays, row_leaf = self._grow_apply(
-                        sk, gk, hk, mask_dev, fmask, shrink,
+                        self.bins_dev, sk, gk, hk, mask_dev, fmask, shrink,
                         quant_key=qk, split_key=nk)
                 if self._shape_k:
                     self.scores = self.scores.at[:, k].set(new_sk)

@@ -52,7 +52,8 @@ class RandomForest(GBDT):
             qk = None if qkey is None else jax.random.fold_in(qkey, k)
             zero = jnp.zeros(self.train_data.num_data, jnp.float32)
             contrib, arrays, row_leaf = self._grow_apply(
-                zero, gk, hk, mask_dev, fmask, 1.0, quant_key=qk)
+                self.bins_dev, zero, gk, hk, mask_dev, fmask, 1.0,
+                quant_key=qk)
             self.dev_models[k].append(arrays)
             self._host_cache[k].append(None)
             num_leaves_flags.append(arrays.num_leaves)

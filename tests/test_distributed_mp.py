@@ -251,3 +251,74 @@ def test_launcher_two_workers_match_serial():
         jnp.ones(f, bool), meta["num_bins_per_feature"], meta["nan_bins"],
         meta["is_categorical"], meta["monotone"])
     assert feats == np.asarray(tree.split_feature).tolist()
+
+
+CLI_WORKER = """
+import os, sys
+sys.path.insert(0, os.environ["LGB_REPO"])
+import _hermetic
+jax = _hermetic.force_cpu(4)
+from lightgbm_tpu.cli import run
+rc = run([f"config={os.environ['LGB_CONF']}"])
+assert rc == 0
+print("CLI_WORKER_OK", os.environ["LIGHTGBM_TPU_RANK"])
+"""
+
+
+def test_cli_two_process_training(tmp_path):
+    """The CLI trains distributed from the reference-style config
+    (machines + num_machines + tree_learner=data): 2 OS processes
+    bootstrap through jax.distributed, shard rows over the global mesh,
+    and rank 0 writes the model (Application::Train parity)."""
+    X, y = _make_data()
+    train_csv = tmp_path / "train.csv"
+    np.savetxt(train_csv, np.column_stack([y, X]), delimiter=",",
+               fmt="%.6g")
+    with socket.socket() as s1, socket.socket() as s2:
+        s1.bind(("127.0.0.1", 0))
+        s2.bind(("127.0.0.1", 0))
+        p1, p2 = s1.getsockname()[1], s2.getsockname()[1]
+    model_out = tmp_path / "model.txt"
+    conf = tmp_path / "train.conf"
+    conf.write_text(
+        "task = train\n"
+        "objective = binary\n"
+        "num_leaves = 15\n"
+        "num_iterations = 5\n"
+        "tree_learner = data\n"
+        f"machines = 127.0.0.1:{p1},127.0.0.1:{p2}\n"
+        "num_machines = 2\n"
+        f"data = {train_csv}\n"
+        f"output_model = {model_out}\n"
+        "verbosity = -1\n")
+    script = tmp_path / "cli_worker.py"
+    script.write_text(CLI_WORKER)
+
+    procs = []
+    for rank in range(2):
+        env = dict(os.environ)
+        env.update({"LGB_REPO": REPO, "LGB_CONF": str(conf),
+                    "LIGHTGBM_TPU_RANK": str(rank),
+                    "JAX_PLATFORMS": "cpu"})
+        procs.append(subprocess.Popen(
+            [sys.executable, "-u", str(script)], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    outs = []
+    for pr in procs:
+        try:
+            out, _ = pr.communicate(timeout=600)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out)
+    for rank, (pr, out) in enumerate(zip(procs, outs)):
+        assert pr.returncode == 0, f"rank {rank} failed:\n{out[-4000:]}"
+        assert f"CLI_WORKER_OK {rank}" in out
+
+    assert model_out.exists()
+    import lightgbm_tpu as lgb
+    bst = lgb.Booster(model_file=str(model_out))
+    assert bst.num_trees() == 5
+    acc = ((bst.predict(X) > 0.5) == (y > 0.5)).mean()
+    assert acc > 0.85, acc

@@ -23,18 +23,12 @@ def main():
     import numpy as np
 
     import lightgbm_tpu as lgb
-    from bench import (FEATURES, LEAF_BATCH, NUM_LEAVES,
-                       QUANTIZED, make_higgs_like)
+    from bench import FEATURES, bench_params, make_higgs_like
 
     X, y = make_higgs_like(rows, FEATURES)
-    # the same knobs bench.py honored, so the trace profiles the SAME
+    # bench.py's own config builder, so the trace profiles the SAME
     # compiled program the bench measured
-    params = {"objective": "binary", "num_leaves": NUM_LEAVES,
-              "learning_rate": 0.1, "max_bin": 255, "min_data_in_leaf": 0,
-              "min_sum_hessian_in_leaf": 100.0, "metric": "none",
-              "verbosity": -1, "tpu_leaf_batch": LEAF_BATCH}
-    if QUANTIZED:
-        params["use_quantized_grad"] = True
+    params = bench_params()
     ds = lgb.Dataset(X, label=y)
     ds.construct(params)
     bst = lgb.Booster(params=params, train_set=ds)
