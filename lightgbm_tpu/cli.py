@@ -55,10 +55,12 @@ def run(argv: List[str]) -> int:
         rank, world = init_distributed(cfg)
         if world > 1 and cfg.pre_partition:
             Log.warning(
-                "pre_partition=true has no effect on the TPU build: every "
-                "rank loads the full data file and row placement is done "
-                "by the device mesh (per-rank pre-partitioned arrays are "
-                "supported through the library API / parallel.launcher)")
+                "pre_partition=true: the CLI loads the full data file on "
+                "every rank (row placement is done by the device mesh); "
+                "for true per-rank data use the library API — "
+                "parallel.pre_partition.sync_bin_mappers + "
+                "global_row_sharded (reference "
+                "DatasetLoader::LoadFromFile(rank, num_machines))")
         data_path = params.pop("data", None)
         if not data_path:
             Log.fatal(f"task={task} requires data=<file>")

@@ -1,0 +1,164 @@
+"""Pre-partitioned distributed loading: per-rank data, synced bin mappers.
+
+Reference: ``DatasetLoader::LoadFromFile(filename, rank, num_machines)``
+with ``pre_partition=true`` plus the distributed arm of
+``ConstructBinMappersFromTextData`` (``src/io/dataset_loader.cpp:1070``):
+when every machine holds only its own rows, bin boundaries cannot be found
+from any single machine's full view — so features are partitioned across
+ranks, each rank finds mappers for ITS feature slice from its LOCAL rows,
+and the mappers are allgathered so every rank discretizes with identical
+boundaries.  The same approximation (per-feature boundaries from one
+rank's sample) is used here, with ``jax.experimental.multihost_utils``
+carrying the fixed-size mapper arrays instead of the reference's socket
+Allgather.
+
+After binning, :func:`global_row_sharded` turns per-process row blocks
+into ONE global jax array sharded over the data axis
+(``jax.make_array_from_process_local_data`` — the pre-partitioned analog
+of ``device_put`` with a replicated host copy), padding ranks to equal
+shard sizes with mask-out rows.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..binning import (BinMapper, bin_dataset, mappers_from_arrays,
+                       mappers_to_arrays)
+from .mesh import DATA_AXIS
+
+
+def _fixed_mapper_arrays(mappers: List[BinMapper], max_bin: int) -> dict:
+    """Variable-length mapper fields padded to fixed (F, max_bin + 2)
+    shapes so every rank contributes identically-shaped allgather
+    operands."""
+    arrs = mappers_to_arrays(mappers)
+    f = len(mappers)
+    width = max_bin + 2
+    ub = np.full((f, width), np.inf, np.float64)
+    ub_len = np.zeros(f, np.int32)
+    cats = np.zeros((f, width), np.int64)
+    cat_len = np.zeros(f, np.int32)
+    for j in range(f):
+        lo, hi = int(arrs["mapper_ub_off"][j]), int(arrs["mapper_ub_off"][j + 1])
+        ub_len[j] = hi - lo
+        ub[j, : hi - lo] = arrs["mapper_ub"][lo:hi]
+        clo, chi = (int(arrs["mapper_cat_off"][j]),
+                    int(arrs["mapper_cat_off"][j + 1]))
+        cat_len[j] = chi - clo
+        cats[j, : chi - clo] = arrs["mapper_cats"][clo:chi]
+    return {
+        "num_bins": arrs["mapper_num_bins"],
+        "missing": arrs["mapper_missing"],
+        "is_cat": arrs["mapper_is_cat"],
+        "trivial": arrs["mapper_trivial"],
+        "default_bin": arrs["mapper_default_bin"],
+        "ub": ub, "ub_len": ub_len, "cats": cats, "cat_len": cat_len,
+    }
+
+
+def _mappers_from_fixed(d: dict) -> List[BinMapper]:
+    f = len(d["num_bins"])
+    ub_off = np.concatenate([[0], np.cumsum(d["ub_len"])]).astype(np.int64)
+    cat_off = np.concatenate([[0], np.cumsum(d["cat_len"])]).astype(np.int64)
+    flat = {
+        "mapper_num_bins": np.asarray(d["num_bins"], np.int32),
+        "mapper_missing": np.asarray(d["missing"], np.int32),
+        "mapper_is_cat": np.asarray(d["is_cat"], bool),
+        "mapper_trivial": np.asarray(d["trivial"], bool),
+        "mapper_default_bin": np.asarray(d["default_bin"], np.int32),
+        "mapper_ub": np.concatenate(
+            [d["ub"][j, : int(d["ub_len"][j])] for j in range(f)])
+        if f else np.zeros(0),
+        "mapper_ub_off": ub_off,
+        "mapper_cats": np.concatenate(
+            [d["cats"][j, : int(d["cat_len"][j])] for j in range(f)])
+        if f else np.zeros(0, np.int64),
+        "mapper_cat_off": cat_off,
+    }
+    return mappers_from_arrays(flat)
+
+
+def sync_bin_mappers(X_local: np.ndarray, *, max_bin: int = 255,
+                     min_data_in_bin: int = 3,
+                     categorical_features: Sequence[int] = (),
+                     sample_cnt: int = 200000) -> List[BinMapper]:
+    """Feature-partitioned mapper construction + allgather.
+
+    Every rank calls this with ITS local rows; all ranks return the SAME
+    mapper list: feature ``f``'s boundaries come from rank ``f % world``'s
+    local sample (the reference's distributed FindBin approximation —
+    boundaries are per-rank-local by design, ``dataset_loader.cpp:1070``).
+    Single-process calls degenerate to plain local binning."""
+    import jax
+
+    local = bin_dataset(np.asarray(X_local), max_bin=max_bin,
+                        min_data_in_bin=min_data_in_bin,
+                        categorical_features=categorical_features,
+                        sample_cnt=sample_cnt)
+    if jax.process_count() <= 1:
+        return local.mappers
+    from jax.experimental import multihost_utils
+
+    fixed = _fixed_mapper_arrays(local.mappers, max_bin)
+    # process_allgather canonicalizes f64->f32 / i64->i32 when x64 is off,
+    # which would shift bin boundaries vs a single-process run; ship wide
+    # dtypes as raw bytes and view-cast back to preserve exact widths.
+    wide = {k: v.dtype for k, v in fixed.items() if v.dtype.itemsize == 8}
+    packed = {k: (v.view(np.uint8).reshape(v.shape[0], -1)
+                  if k in wide else v)
+              for k, v in fixed.items()}
+    gathered = multihost_utils.process_allgather(packed)  # (world, F, ...)
+    world = jax.process_count()
+    f = len(local.mappers)
+    owner = np.arange(f) % world
+    synced = {}
+    for k, v in gathered.items():
+        sel = np.ascontiguousarray(np.asarray(v)[owner, np.arange(f)])
+        if k in wide:
+            sel = sel.view(wide[k]).reshape(f, -1)
+            if fixed[k].ndim == 1:
+                sel = sel.reshape(f)
+        synced[k] = sel
+    return _mappers_from_fixed(synced)
+
+
+def pad_local_rows(arrays: Sequence[np.ndarray],
+                   mask: Optional[np.ndarray] = None
+                   ) -> Tuple[List[np.ndarray], np.ndarray, int]:
+    """Pad this rank's row blocks to the max local row count across ranks
+    (equal shard sizes are required to assemble one global array).  Returns
+    (padded arrays, padded mask, global row count).  Pad rows carry
+    ``mask == 0`` so they contribute to no histogram."""
+    import jax
+    from jax.experimental import multihost_utils
+
+    n_local = int(arrays[0].shape[0])
+    counts = np.asarray(multihost_utils.process_allgather(
+        np.asarray([n_local], np.int32))).reshape(-1)
+    # equal PER-DEVICE shards: round the common per-process size up to a
+    # multiple of the local device count so the data-axis sharding divides
+    ndev = jax.local_device_count()
+    n_max = int(counts.max())
+    n_max += (-n_max) % ndev
+    if mask is None:
+        mask = np.ones(n_local, np.float32)
+    pad = n_max - n_local
+    if pad:
+        arrays = [np.concatenate(
+            [a, np.zeros((pad,) + a.shape[1:], a.dtype)]) for a in arrays]
+        mask = np.concatenate([mask, np.zeros(pad, np.float32)])
+    return list(arrays), mask, n_max * jax.process_count()
+
+
+def global_row_sharded(mesh, local: np.ndarray, axis: str = DATA_AXIS):
+    """One global jax array from per-process row blocks (equal sizes —
+    see :func:`pad_local_rows`), sharded along the data axis."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    spec = P(axis) if local.ndim == 1 else P(axis, *([None] * (local.ndim - 1)))
+    return jax.make_array_from_process_local_data(
+        NamedSharding(mesh, spec), np.ascontiguousarray(local))

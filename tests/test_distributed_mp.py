@@ -102,31 +102,28 @@ print("WORKER_OK", rank)
 """
 
 
-def test_two_process_data_parallel_matches_serial(tmp_path):
-    # pick two free loopback ports: one for the jax coordinator (entry 0 of
-    # the machine list = coordinator, like the reference's rank-0 socket)
+
+def _spawn_two_workers(script_path, extra_env, timeout=600):
+    """Shared 2-process scaffolding: pick coordinator ports, spawn both
+    rank processes, collect output, assert both succeeded."""
     with socket.socket() as s1, socket.socket() as s2:
         s1.bind(("127.0.0.1", 0))
         s2.bind(("127.0.0.1", 0))
         p1, p2 = s1.getsockname()[1], s2.getsockname()[1]
     machines = f"127.0.0.1:{p1},127.0.0.1:{p2}"
-    script = tmp_path / "worker.py"
-    script.write_text(WORKER)
-    out_npz = str(tmp_path / "tree.npz")
-
     procs = []
     for rank in range(2):
         env = dict(os.environ)
         env.update({"LGB_REPO": REPO, "LGB_MACHINES": machines,
-                    "LIGHTGBM_TPU_RANK": str(rank), "LGB_OUT": out_npz,
-                    "JAX_PLATFORMS": "cpu"})
+                    "LIGHTGBM_TPU_RANK": str(rank), "JAX_PLATFORMS": "cpu"})
+        env.update(extra_env)
         procs.append(subprocess.Popen(
-            [sys.executable, "-u", str(script)], env=env,
+            [sys.executable, "-u", str(script_path)], env=env,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
     outs = []
     for pr in procs:
         try:
-            out, _ = pr.communicate(timeout=600)
+            out, _ = pr.communicate(timeout=timeout)
         except subprocess.TimeoutExpired:
             for q in procs:
                 q.kill()
@@ -134,6 +131,15 @@ def test_two_process_data_parallel_matches_serial(tmp_path):
         outs.append(out)
     for rank, (pr, out) in enumerate(zip(procs, outs)):
         assert pr.returncode == 0, f"rank {rank} failed:\n{out[-4000:]}"
+    return machines, outs
+
+
+def test_two_process_data_parallel_matches_serial(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER)
+    out_npz = str(tmp_path / "tree.npz")
+    _machines, outs = _spawn_two_workers(script, {"LGB_OUT": out_npz})
+    for rank, out in enumerate(outs):
         assert f"WORKER_OK {rank}" in out
 
     # single-process serial reference tree on the same data
@@ -274,12 +280,18 @@ def test_cli_two_process_training(tmp_path):
     train_csv = tmp_path / "train.csv"
     np.savetxt(train_csv, np.column_stack([y, X]), delimiter=",",
                fmt="%.6g")
+    model_out = tmp_path / "model.txt"
+    conf = tmp_path / "train.conf"
+    script = tmp_path / "cli_worker.py"
+    script.write_text(CLI_WORKER)
+
+    # the CLI worker reads machines from the config file, which needs the
+    # ports before spawn; reuse the helper's machine list via a placeholder
+    # rewritten per spawn is overkill — pick ports once here instead.
     with socket.socket() as s1, socket.socket() as s2:
         s1.bind(("127.0.0.1", 0))
         s2.bind(("127.0.0.1", 0))
         p1, p2 = s1.getsockname()[1], s2.getsockname()[1]
-    model_out = tmp_path / "model.txt"
-    conf = tmp_path / "train.conf"
     conf.write_text(
         "task = train\n"
         "objective = binary\n"
@@ -291,8 +303,6 @@ def test_cli_two_process_training(tmp_path):
         f"data = {train_csv}\n"
         f"output_model = {model_out}\n"
         "verbosity = -1\n")
-    script = tmp_path / "cli_worker.py"
-    script.write_text(CLI_WORKER)
 
     procs = []
     for rank in range(2):
@@ -322,3 +332,141 @@ def test_cli_two_process_training(tmp_path):
     assert bst.num_trees() == 5
     acc = ((bst.predict(X) > 0.5) == (y > 0.5)).mean()
     assert acc > 0.85, acc
+
+
+PP_WORKER = """
+import os, sys
+sys.path.insert(0, os.environ["LGB_REPO"])
+import _hermetic
+jax = _hermetic.force_cpu(4)
+import numpy as np
+import jax.numpy as jnp
+
+from lightgbm_tpu.binning import BinnedData
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.parallel.distributed import (global_mesh, init_distributed,
+                                               shutdown)
+from lightgbm_tpu.parallel.mesh import DATA_AXIS
+from lightgbm_tpu.parallel.pre_partition import (global_row_sharded,
+                                                 pad_local_rows,
+                                                 sync_bin_mappers)
+import lightgbm_tpu.models.grower as G
+from lightgbm_tpu.models.gbdt import _split_config
+
+rank = int(os.environ["LIGHTGBM_TPU_RANK"])
+boot = Config({"machines": os.environ["LGB_MACHINES"], "num_machines": 2,
+               "verbosity": -1})
+r, world = init_distributed(boot)
+assert (r, world) == (rank, 2)
+mesh = global_mesh()
+
+# each rank holds a DIFFERENT slice of the data (pre_partition=true)
+sys.path.insert(0, os.path.join(os.environ["LGB_REPO"], "tests"))
+from test_distributed_mp import _make_data
+X, y = _make_data()
+cut = 5201                  # odd split exercises device rounding
+X_local = X[:cut] if rank == 0 else X[cut:]
+y_local = y[:cut] if rank == 0 else y[cut:]
+
+mappers = sync_bin_mappers(X_local, max_bin=63)
+binned = BinnedData.from_mappers(X_local, mappers)
+grad_l = (0.5 - y_local).astype(np.float32)
+hess_l = np.full(len(y_local), 0.25, np.float32)
+(arrs, mask_l, n_glob) = pad_local_rows(
+    [binned.bins, grad_l, hess_l])
+bins_g = global_row_sharded(mesh, arrs[0])
+grad_g = global_row_sharded(mesh, arrs[1])
+hess_g = global_row_sharded(mesh, arrs[2])
+mask_g = global_row_sharded(mesh, mask_l)
+
+tcfg = Config({"objective": "binary", "num_leaves": 31,
+               "min_data_in_leaf": 20, "verbosity": -1})
+gcfg = G.GrowerConfig(num_leaves=31, num_bins=binned.max_num_bins,
+                      split=_split_config(tcfg))
+grow = G.make_grower(gcfg, mesh=mesh, data_axis=DATA_AXIS)
+from jax.sharding import NamedSharding, PartitionSpec as P
+rep = NamedSharding(mesh, P())
+meta_arrs = [jax.device_put(np.asarray(a), rep) for a in (
+    binned.num_bins_per_feature, binned.nan_bins, binned.is_categorical,
+    np.zeros(binned.num_features, np.int32))]
+fmask = jax.device_put(np.ones(binned.num_features, bool), rep)
+tree, _rl = grow(bins_g, grad_g, hess_g, mask_g, fmask, *meta_arrs)
+if rank == 0:
+    np.savez(os.environ["LGB_OUT"],
+             split_feature=np.asarray(tree.split_feature),
+             split_bin=np.asarray(tree.split_bin),
+             leaf_value=np.asarray(tree.leaf_value),
+             num_leaves=int(tree.num_leaves))
+shutdown()
+print("PP_WORKER_OK", rank)
+"""
+
+
+def test_pre_partitioned_two_process_matches_serial(tmp_path):
+    """pre_partition distributed loading (reference
+    DatasetLoader::LoadFromFile(rank, num_machines) + the distributed
+    bin-mapper allgather, dataset_loader.cpp:1070): each rank holds only
+    its OWN rows, mappers are feature-partitioned + synced, and the global
+    sharded grower must produce EXACTLY the tree a single process grows
+    from the concatenated data binned with the same synced mappers."""
+    from lightgbm_tpu.binning import BinnedData, bin_dataset
+
+    X, y = _make_data()
+    cut = 5201          # odd split: exercises per-device padding (4 devs)
+    script = tmp_path / "pp_worker.py"
+    script.write_text(PP_WORKER)
+    out_npz = str(tmp_path / "pp_tree.npz")
+    _machines, outs = _spawn_two_workers(script, {"LGB_OUT": out_npz})
+    for rank, out in enumerate(outs):
+        assert f"PP_WORKER_OK {rank}" in out
+
+    # expected: single process, same per-owner mapper assembly (feature f's
+    # boundaries from rank f%2's local sample), padded global row order
+    halves = (X[:cut], X[cut:])
+    local_mappers = [bin_dataset(h, max_bin=63).mappers for h in halves]
+    f = X.shape[1]
+    synced = [local_mappers[j % 2][j] for j in range(f)]
+    n_shard = max(cut, len(X) - cut)
+    n_shard += (-n_shard) % 4            # pad_local_rows device rounding
+    bins_parts, g_parts, m_parts = [], [], []
+    for rk, h in enumerate(halves):
+        binned = BinnedData.from_mappers(h, synced)
+        yl = y[:cut] if rk == 0 else y[cut:]
+        pad = n_shard - len(h)
+        bins_parts.append(np.concatenate(
+            [binned.bins, np.zeros((pad, f), binned.bins.dtype)]))
+        g_parts.append(np.concatenate(
+            [(0.5 - yl).astype(np.float32), np.zeros(pad, np.float32)]))
+        m_parts.append(np.concatenate(
+            [np.ones(len(h), np.float32), np.zeros(pad, np.float32)]))
+    bins_full = np.concatenate(bins_parts)
+    grad_full = np.concatenate(g_parts)
+    mask_full = np.concatenate(m_parts)
+    binned0 = BinnedData.from_prebinned(bins_full, synced)
+
+    import jax.numpy as jnp
+
+    import lightgbm_tpu.models.grower as G
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.models.gbdt import _split_config
+
+    tcfg = Config({"objective": "binary", "num_leaves": 31,
+                   "min_data_in_leaf": 20, "verbosity": -1})
+    gcfg = G.GrowerConfig(num_leaves=31, num_bins=binned0.max_num_bins,
+                          split=_split_config(tcfg))
+    tree, _ = G.make_grower(gcfg)(
+        jnp.asarray(bins_full), jnp.asarray(grad_full),
+        jnp.full(len(bins_full), 0.25, jnp.float32), jnp.asarray(mask_full),
+        jnp.ones(f, bool), jnp.asarray(binned0.num_bins_per_feature),
+        jnp.asarray(binned0.nan_bins), jnp.asarray(binned0.is_categorical),
+        jnp.zeros(f, jnp.int32))
+    got = np.load(out_npz)
+    nl = int(got["num_leaves"])
+    assert nl == int(tree.num_leaves)
+    np.testing.assert_array_equal(got["split_feature"][: nl - 1],
+                                  np.asarray(tree.split_feature)[: nl - 1])
+    np.testing.assert_array_equal(got["split_bin"][: nl - 1],
+                                  np.asarray(tree.split_bin)[: nl - 1])
+    np.testing.assert_allclose(got["leaf_value"][:nl],
+                               np.asarray(tree.leaf_value)[:nl], rtol=1e-5,
+                               atol=1e-6)
