@@ -32,8 +32,6 @@ class Composition:
     forced_splits: bool
     extra_trees: bool
     feature_fraction_bynode: bool
-    interaction_constraints: bool
-    cegb: bool
 
 
 def _mono_refresh(c: Composition) -> bool:
@@ -51,14 +49,6 @@ class Rule:
 
 
 RULES: Tuple[Rule, ...] = (
-    Rule("voting-x-randomness-or-cegb",
-         lambda c: c.voting and (c.extra_trees or c.feature_fraction_bynode
-                                 or c.interaction_constraints or c.cegb),
-         "fallback",
-         "tree_learner=voting does not compose with extra_trees/"
-         "feature_fraction_bynode/interaction_constraints/CEGB; "
-         "falling back to data-parallel",
-         lambda c: dataclasses.replace(c, voting=False)),
     Rule("forced-x-wave",
          lambda c: c.forced_splits and c.leaf_batch > 1,
          "fallback",

@@ -232,12 +232,8 @@ class GBDT:
             mono_method=mono_method if has_mono else "none",
             forced_splits=forced is not None,
             extra_trees=cfg.extra_trees,
-            feature_fraction_bynode=cfg.feature_fraction_bynode < 1.0,
-            interaction_constraints=bool(cfg.interaction_constraints),
-            cegb=bool(cfg.cegb_penalty_split > 0.0
-                      or cfg.cegb_penalty_feature_coupled
-                      or cfg.cegb_penalty_feature_lazy
-                      or cfg.cegb_tradeoff < 1.0)), warn=Log.warning)
+            feature_fraction_bynode=cfg.feature_fraction_bynode < 1.0),
+            warn=Log.warning)
         voting, leaf_batch = comp.voting, comp.leaf_batch
         self.grower_cfg = GrowerConfig(
             num_leaves=cfg.num_leaves,

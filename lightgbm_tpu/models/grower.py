@@ -363,6 +363,15 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
             fmaskk = jnp.where(keep, fmaskk & sel, fmaskk)
         return fmaskk, randk
 
+    def _node_scan_inputs(key, feature_mask, nbpf, k, pathk, groups_mat):
+        """Per-node (fmask, rand_bins) incl. the interaction-constraint
+        path mask — ONE derivation shared by the data-parallel and voting
+        scans so their per-node option semantics cannot diverge."""
+        fmaskk, randk = _batch_node_inputs(key, feature_mask, nbpf, k)
+        if use_groups and pathk is not None and groups_mat is not None:
+            fmaskk = fmaskk & _allowed_for_paths(pathk, groups_mat)
+        return fmaskk, randk
+
     def _best_for_batch(histk, pgk, phk, pck, meta, feature_mask,
                         penaltyk=None, parent_outk=None, key=None,
                         pathk=None, groups_mat=None, boundsk=None,
@@ -373,9 +382,8 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
         k = histk.shape[0]
         if parent_outk is None:
             parent_outk = jnp.zeros(k, jnp.float32)
-        fmaskk, randk = _batch_node_inputs(key, feature_mask, nbpf, k)
-        if use_groups and pathk is not None and groups_mat is not None:
-            fmaskk = fmaskk & _allowed_for_paths(pathk, groups_mat)
+        fmaskk, randk = _node_scan_inputs(key, feature_mask, nbpf, k,
+                                          pathk, groups_mat)
         if boundsk is None:
             lok = hik = jnp.zeros(k, jnp.float32)
             use_b = False
@@ -479,21 +487,23 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
     if cfg.packed4 and (cfg.bundled or fp_capable):
         raise ValueError("packed4 bins do not compose with EFB bundling or "
                          "the feature-parallel layout (caller gates this)")
-    if cfg.voting and (use_rand or use_bynode or use_groups
-                       or cfg.split.use_cegb):
-        raise ValueError(
-            "voting-parallel does not support extra_trees / "
-            "feature_fraction_bynode / interaction_constraints / CEGB; "
-            "use tree_learner=data")
-
     def _vote_best_batch(hist_loc, pgk, phk, pck, poutk, scale3, meta,
-                         feature_mask, boundsk, depthk, axis):
+                         feature_mask, boundsk, depthk, axis,
+                         penaltyk=None, key=None, pathk=None,
+                         groups_mat=None):
         """Voting-parallel split search for k children (reference
         ``GlobalVoting`` + ``SyncUpHistograms``,
         ``voting_parallel_tree_learner.cpp``): each shard votes its local
         top-k features by LOCAL split gain; only the global top-2k features'
         histogram slices are psum'd, then the real split search runs on the
-        compact global slices."""
+        compact global slices.
+
+        Per-node randomness (extra_trees thresholds, bynode feature masks),
+        interaction constraints, and CEGB penalties compose: the node key
+        and penalties are replicated across shards, so every shard draws
+        the SAME masks/thresholds and votes stay consistent (the
+        reference's learners compose the same options orthogonally,
+        tree_learner.cpp:31-44)."""
         nbpf, nan_bins, is_cat, monotone = meta[:4]
         k_child, f = hist_loc.shape[0], meta[0].shape[0]
         kk = min(cfg.vote_top_k, f)
@@ -512,17 +522,27 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
         else:
             lok, hik = boundsk
             use_b = True
+        fmaskk, randk = _node_scan_inputs(key, feature_mask, nbpf,
+                                          k_child, pathk, groups_mat)
+        has_rand = randk is not None
+        has_pen = penaltyk is not None
+        randk_ = randk if has_rand else jnp.zeros((k_child, 1), jnp.int32)
+        penk_ = (penaltyk if has_pen
+                 else jnp.zeros((k_child, 1), jnp.float32))
 
-        def local_gains(h, g, hh, c):
+        def local_gains(h, g, hh, c, fm, rb, pen):
             _, fg = best_split(
                 h, g, hh, c, num_bins_per_feature=nbpf, nan_bins=nan_bins,
                 is_categorical=is_cat, monotone=monotone,
-                feature_mask=feature_mask, cfg=cfg.split,
+                feature_mask=fm, cfg=cfg.split,
+                rand_bins=rb if has_rand else None,
+                gain_penalty=pen if has_pen else None,
                 with_feature_gains=True)
             return fg
 
         fg = jax.vmap(local_gains)(hist_loc_s, loc_tot[:, 0],
-                                   loc_tot[:, 1], loc_tot[:, 2])   # (k, F)
+                                   loc_tot[:, 1], loc_tot[:, 2],
+                                   fmaskk, randk_, penk_)          # (k, F)
         _, top_idx = jax.lax.top_k(fg, kk)
         votes = jnp.zeros((k_child, f), jnp.int32).at[
             jnp.arange(k_child)[:, None], top_idx].add(1)
@@ -548,12 +568,14 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
                 hist_loc, sel[:, :, None, None], axis=1)
             hist_sel = _scale_hist(jax.lax.psum(hist_sel, axis), scale3)
 
-        def one(h, pg, ph, pc, po, selj, lo, hi, dep):
+        def one(h, pg, ph, pc, po, selj, lo, hi, dep, fm, rb, pen):
             bs = best_split(
                 h, pg, ph, pc,
                 num_bins_per_feature=nbpf[selj], nan_bins=nan_bins[selj],
                 is_categorical=is_cat[selj], monotone=monotone[selj],
-                feature_mask=feature_mask[selj], cfg=cfg.split,
+                feature_mask=fm[selj], cfg=cfg.split,
+                rand_bins=rb[selj] if has_rand else None,
+                gain_penalty=pen[selj] if has_pen else None,
                 parent_output=po,
                 out_lo=lo if use_b else None,
                 out_hi=hi if use_b else None,
@@ -561,7 +583,7 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
             return bs._replace(feature=selj[bs.feature])
 
         return jax.vmap(one)(hist_sel, pgk, phk, pck, poutk, sel, lok, hik,
-                             depthk)
+                             depthk, fmaskk, randk_, penk_)
 
     def _cegb_penalty(count, feat_used, path_used, coupled, lazy):
         """Per-feature gain penalty (reference CEGB ``DeltaGain``):
@@ -1359,10 +1381,18 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
             root_pen = _cegb_penalty(root_c, state.feat_used,
                                      state.leaf_path[0], *cegb)
         if voting:
+            vkey = None
+            if need_key:
+                rng, vkey = jax.random.split(state.rng)
+                state = state._replace(rng=rng)
             bs1 = _vote_best_batch(
                 state.leaf_hist[0:1], root_g[None], root_h[None],
                 root_c[None], state.leaf_out[0:1], scale3, meta,
-                feature_mask, None, None, axis)
+                feature_mask, None, None, axis,
+                penaltyk=None if root_pen is None else root_pen[None],
+                key=vkey,
+                pathk=state.leaf_path[0:1] if track_path else None,
+                groups_mat=groups_mat)
             root_bs = jax.tree.map(lambda a: a[0], bs1)
         else:
             state, root_bs = _root_best(state, scale3, meta, feature_mask,
@@ -1833,7 +1863,9 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
                 bs = _vote_best_batch(
                     cat2(hist_left, hist_right), cat2(gl, gr),
                     cat2(hl, hr), cat2(cl, cr), cat2(out_l, out_r), scale3,
-                    meta, feature_mask, bounds2, cat2(depth, depth), axis)
+                    meta, feature_mask, bounds2, cat2(depth, depth), axis,
+                    penaltyk=penalty2, key=node_key, pathk=path2,
+                    groups_mat=groups_mat)
             else:
                 hist2s = _expand_hist_batch(
                     _scale_hist(cat2(hist_left, hist_right), scale3), meta,

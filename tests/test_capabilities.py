@@ -16,8 +16,7 @@ from lightgbm_tpu.models.capabilities import RULES, Composition, resolve
 def _comp(**kw):
     base = dict(voting=False, leaf_batch=1, mono_method="none",
                 forced_splits=False, extra_trees=False,
-                feature_fraction_bynode=False,
-                interaction_constraints=False, cegb=False)
+                feature_fraction_bynode=False)
     base.update(kw)
     return Composition(**base)
 
@@ -35,16 +34,15 @@ def test_matrix_enumeration_is_total():
     (no rule still applies after resolve) or an error — i.e. the matrix
     is closed under its own fallbacks."""
     mono_methods = ("none", "basic", "intermediate", "advanced")
-    flags = list(itertools.product((False, True), repeat=6))
+    flags = list(itertools.product((False, True), repeat=4))
     checked = errors = fallbacks = 0
     for mono in mono_methods:
-        for voting, forced, extra, bynode, cegb, inter in flags:
+        for voting, forced, extra, bynode in flags:
             for leaf_batch in (1, 16):
                 comp = _comp(voting=voting, leaf_batch=leaf_batch,
                              mono_method=mono, forced_splits=forced,
                              extra_trees=extra,
-                             feature_fraction_bynode=bynode, cegb=cegb,
-                             interaction_constraints=inter)
+                             feature_fraction_bynode=bynode)
                 checked += 1
                 try:
                     out, fired = resolve(comp)
@@ -55,12 +53,13 @@ def test_matrix_enumeration_is_total():
                 for r in RULES:
                     if r.action == "fallback":
                         assert not r.applies(out), (r.name, comp)
-    assert checked == 4 * 64 * 2
+    assert checked == 4 * 16 * 2
     assert errors and fallbacks        # both classes actually exercised
 
 
 @pytest.mark.parametrize("kw,expect_voting,expect_batch,expect_fired", [
-    (dict(voting=True, extra_trees=True, leaf_batch=16), False, 16, True),
+    # voting composes with per-node randomness/CEGB since round 5
+    (dict(voting=True, extra_trees=True, leaf_batch=16), True, 16, False),
     (dict(voting=True, forced_splits=True, leaf_batch=16), False, 1, True),
     # monotone refresh composes with wave growth (conflict-free selection)
     (dict(mono_method="intermediate", leaf_batch=16), False, 16, False),

@@ -350,24 +350,29 @@ def test_voting_reduces_collective_bytes():
     assert vote_bytes < data_bytes * 0.6, (vote_bytes, data_bytes)
 
 
-def test_voting_composition_fallback(capsys):
-    """Voting-parallel's unsupported knobs warn and fall back to
-    data-parallel instead of silently mis-training (documented deviation:
-    the reference's voting learner composes with its ColSampler)."""
+def test_voting_composes_with_node_options(capsys):
+    """Voting-parallel composes with per-node randomness, interaction
+    constraints and CEGB like the reference's orthogonal learners
+    (tree_learner.cpp:31-44): the node key and penalties are replicated
+    across shards, so every shard votes consistently.  Forced splits still
+    fall back (sequential-only)."""
     n, f = 8 * 256, 12
     rng = np.random.RandomState(5)
     X = rng.randn(n, f)
     y = (X[:, 0] > 0).astype(np.float64)
     base = {"objective": "binary", "num_leaves": 7, "verbosity": 1,
             "min_data_in_leaf": 5, "tree_learner": "voting"}
-    for bad in ({"extra_trees": True},
-                {"feature_fraction_bynode": 0.5},
-                {"interaction_constraints": [[0, 1], [2, 3]]},
-                {"cegb_penalty_split": 0.1}):
-        bst = lgb.train(dict(base, **bad), lgb.Dataset(X, label=y), 2)
+    for extra in ({"extra_trees": True},
+                  {"feature_fraction_bynode": 0.5},
+                  {"interaction_constraints": [[0, 1], [2, 3]]},
+                  {"cegb_penalty_split": 0.1}):
+        bst = lgb.train(dict(base, **extra), lgb.Dataset(X, label=y), 2)
         assert bst.num_trees() == 2
+        assert bst._gbdt.grower_cfg.voting, extra
         out = capsys.readouterr()
-        assert "does not compose" in out.out + out.err
+        assert "falling back" not in (out.out + out.err).lower(), extra
+        acc = ((bst.predict(X) > 0.5) == (y > 0.5)).mean()
+        assert acc > 0.8, (extra, acc)
     import json, tempfile, os as _os
     fd, path = tempfile.mkstemp(suffix=".json")
     with _os.fdopen(fd, "w") as fh:
