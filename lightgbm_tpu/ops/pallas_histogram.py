@@ -35,6 +35,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -54,6 +55,7 @@ def _pick_tiles(f: int, num_bins: int, itemsize: int, rows_block: int,
                 acc_size: int = 4):
     """(rows_block, features_per_chunk) bounding the kernel's VMEM working
     set (the in-VMEM one-hot PLUS the (C_PAD, ft*B) accumulator block).
+    ``num_bins`` here is the LANE-PADDED bin count (multiple of 128).
 
     Mosaic requires each BlockSpec's last dim to be a multiple of 128 or
     equal to the full array dim, so the kernel never tiles features inside
@@ -108,29 +110,44 @@ def _prep(bins, vals, rows_block, ftile):
 
 def _flat_kernel(bins_ref, valsT_ref, out_ref, *, num_bins, ftile,
                  oh_dtype, acc_dtype, precision, packed4=False):
+    """``num_bins`` is the lane-padded bin count (multiple of 128): Mosaic
+    only supports the (blk, ft, B) -> (blk, ft*B) one-hot flatten when the
+    merged minor dim stays 128-aligned.  Real bin ids never reach the
+    phantom bins, so their histogram lanes are exact zeros and the caller
+    slices them off."""
     rb = pl.program_id(0)  # row-block index
 
     @pl.when(rb == 0)
     def _init():
         out_ref[:] = jnp.zeros_like(out_ref)
 
-    bins_blk = bins_ref[:].astype(jnp.int32)            # (blk, ft)
+    bins_blk = bins_ref[:].astype(jnp.int32)            # (blk, ct)
     valsT = valsT_ref[:]                                # (C_PAD, blk)
     blk = bins_blk.shape[0]
+    if oh_dtype != valsT.dtype:
+        valsT = valsT.astype(oh_dtype)
+
+    def contract(b2d):
+        ft = b2d.shape[1]
+        iota_b = jax.lax.broadcasted_iota(jnp.int32, (blk, ft, num_bins), 2)
+        oh = (b2d[:, :, None] == iota_b).astype(oh_dtype)
+        oh = oh.reshape(blk, ft * num_bins)             # lane-aligned merge
+        return jax.lax.dot_general(
+            valsT, oh, dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=acc_dtype, precision=precision)
+
     if packed4:
         # 4-bit mode: the streamed tile carries two features per byte
         # (reference DenseBin IS_4BIT, dense_bin.hpp); the nibble unpack
-        # happens HERE in VMEM so HBM streams half the bin bytes.
-        low = bins_blk & 15
-        high = (bins_blk >> 4) & 15
-        bins_blk = jnp.stack([low, high], axis=-1).reshape(blk, ftile)
-    iota_b = jax.lax.broadcasted_iota(jnp.int32, (blk, ftile, num_bins), 2)
-    oh = (bins_blk[:, :, None] == iota_b).astype(oh_dtype)
-    oh = oh.reshape(blk, ftile * num_bins)              # (blk, ft*B)
-    out_ref[:, :] += jax.lax.dot_general(
-        valsT.astype(oh_dtype) if oh_dtype != valsT.dtype else valsT,
-        oh, dimension_numbers=(((1,), (0,)), ((), ())),
-        preferred_element_type=acc_dtype, precision=precision)
+        # happens HERE in VMEM so HBM streams half the bin bytes.  The two
+        # nibble planes are contracted separately into contiguous output
+        # halves (a vector interleave of the planes is not a Mosaic-legal
+        # shape cast); the caller un-permutes the feature order.
+        half = (ftile // 2) * num_bins
+        out_ref[:, :half] += contract(bins_blk & 15)
+        out_ref[:, half:] += contract((bins_blk >> 4) & 15)
+    else:
+        out_ref[:, :] += contract(bins_blk)
 
 
 @functools.partial(
@@ -155,13 +172,16 @@ def histogram_flat(
     # DEFAULT would run the MXU at bf16 and perturb every histogram entry.
     precision = (jax.lax.Precision.HIGHEST if dtype == "f32"
                  else jax.lax.Precision.DEFAULT)
-    rows_block, ftile = _pick_tiles(f, num_bins, isz, rows_block)
+    # Mosaic-legal one-hot flatten requires a 128-multiple bin axis; bin
+    # ids are < num_bins so the phantom bins stay exactly zero.
+    b_pad = -(-num_bins // 128) * 128
+    rows_block, ftile = _pick_tiles(f, b_pad, isz, rows_block)
     if packed4 and ftile % 2:
         ftile += 1           # chunk boundaries must not split nibble pairs
     cols_tile = ftile // 2 if packed4 else ftile
     bins, valsT, nblocks, nchunks = _prep(bins, vals, rows_block, cols_tile)
     call = pl.pallas_call(
-        functools.partial(_flat_kernel, num_bins=num_bins, ftile=ftile,
+        functools.partial(_flat_kernel, num_bins=b_pad, ftile=ftile,
                           oh_dtype=oh_dtype, acc_dtype=acc_dtype,
                           precision=precision, packed4=packed4),
         grid=(nblocks,),
@@ -171,9 +191,9 @@ def histogram_flat(
             pl.BlockSpec((C_PAD, rows_block), lambda i: (0, i),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((C_PAD, ftile * num_bins),
+        out_specs=pl.BlockSpec((C_PAD, ftile * b_pad),
                                lambda i: (0, 0), memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((C_PAD, ftile * num_bins), acc_dtype),
+        out_shape=jax.ShapeDtypeStruct((C_PAD, ftile * b_pad), acc_dtype),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary",),
             vmem_limit_bytes=_VMEM_LIMIT),
@@ -183,8 +203,17 @@ def histogram_flat(
                                         (c + 1) * cols_tile, axis=1), valsT)
               for c in range(nchunks)]
     out = chunks[0] if nchunks == 1 else jnp.concatenate(chunks, axis=1)
-    # (C_PAD, Fpad*B) -> (F, B, 3), dropping phantom feature blocks
-    out = out.reshape(C_PAD, nchunks * ftile, num_bins)[:3, :f]
+    out = out.reshape(C_PAD, nchunks * ftile, b_pad)[:3, :, :num_bins]
+    if packed4:
+        # Each chunk emits its low-nibble features then its high-nibble
+        # features; un-permute back to the interleaved pack_bins4 order
+        # (feature 2j in packed column j's low nibble, 2j+1 high).
+        order = np.concatenate(
+            [np.concatenate([2 * cols, 2 * cols + 1])
+             for cols in np.split(np.arange(nchunks * cols_tile), nchunks)])
+        out = jnp.take(out, jnp.asarray(np.argsort(order)[:f]), axis=1)
+    else:
+        out = out[:, :f]     # drop phantom feature blocks
     return jnp.transpose(out, (1, 2, 0))
 
 
