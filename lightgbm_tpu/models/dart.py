@@ -18,6 +18,8 @@ from .tree import predict_tree_bins_device
 
 
 class DART(GBDT):
+    _deterministic_iters = False   # drop/renorm mutates scores between iters
+
     def __init__(self, cfg, train, valids=(), base_model=None):
         super().__init__(cfg, train, valids, base_model=base_model)
         self.drop_rng = np.random.RandomState(cfg.drop_seed)

@@ -103,6 +103,10 @@ def _scale_tree_arrays(arrays: TreeArrays, factor) -> TreeArrays:
 class GBDT:
     """Boosting driver (reference ``GBDT``, ``gbdt.h:630``)."""
 
+    # Subclasses that mutate scores between iterations (DART's drop/renorm)
+    # clear this so the stop check never defers (see train_one_iter).
+    _deterministic_iters = True
+
     def __init__(self, cfg: Config, train: TrainData,
                  valids: Sequence[Tuple[str, TrainData]] = (),
                  base_model=None):
@@ -351,6 +355,11 @@ class GBDT:
             self._cegb_used = np.zeros(nf, bool)
 
         self._linear_nls: List[int] = []
+        # Degenerate-tree stop check runs one iteration BEHIND: the pending
+        # num_leaves handles are fetched only after the NEXT iteration has
+        # been dispatched, so the host sync never drains the device queue
+        # (each fetch targets an iteration that has already finished).
+        self._nls_pending = None
         self.init_scores = np.zeros(self.num_class, np.float64)
         # Reference gbdt.cpp:319 BoostFromAverage applies only when the data
         # carries no init score (continuation replays the base model there).
@@ -517,9 +526,10 @@ class GBDT:
                 if self._split_key is not None else None)
 
         results = []
-        if (grad is None and self._fused_iter is not None
-                and not self.sample_strategy.is_goss and not self._use_cegb
-                and not cfg.linear_tree):
+        used_fused = (grad is None and self._fused_iter is not None
+                      and not self.sample_strategy.is_goss
+                      and not self._use_cegb and not cfg.linear_tree)
+        if used_fused:
             # Hot path: ONE device dispatch for gradients + all class trees +
             # score updates.
             self.scores, outs = self._fused_iter(self.bins_dev,
@@ -582,10 +592,32 @@ class GBDT:
                 sf, nl = jax.device_get((arrays.split_feature,
                                          arrays.num_leaves))
                 self._cegb_used[np.asarray(sf[: max(int(nl) - 1, 0)])] = True
-        nls = jax.device_get([a.num_leaves for _, a, _rl in results]
-                             + self._linear_nls)
+        nls = [a.num_leaves for _, a, _rl in results] + self._linear_nls
         self._linear_nls = []
-        return all(int(x) <= 1 for x in nls)
+        # Deferring the degenerate-stop fetch by one iteration keeps the
+        # device queue full (the fetch targets an iteration that finished
+        # while the next was dispatched above).  Only sound when iteration
+        # t+1 replays t exactly if scores did not change: the fused
+        # deterministic path with static row/feature masks and no
+        # per-iteration RNG (bagging/GOSS resample, quantize or smearing
+        # keys, DART score mutation all break that, as does any path that
+        # already syncs the host each iteration).
+        defer = (used_fused and self._deterministic_iters
+                 and mask_dev is self._full_mask
+                 and self._fmask_static is not None
+                 and qkey is None and skey is None)
+        if not defer:
+            if self._nls_pending is not None:   # drain a deferred backlog
+                nls = list(self._nls_pending) + nls
+                self._nls_pending = None
+            return all(int(x) <= 1 for x in jax.device_get(nls))
+        prev, self._nls_pending = self._nls_pending, nls
+        if prev is None:
+            return False
+        # Stopping one iteration late stores at most one extra tree, trained
+        # on the stump-shifted scores — a legitimate boosting step, where
+        # reference GBDT::TrainOneIter's immediate check stores none.
+        return all(int(x) <= 1 for x in jax.device_get(prev))
 
     @property
     def score_bins_dev(self):
@@ -864,6 +896,7 @@ class GBDT:
         and subtract their score contributions."""
         if self.iter_ == 0:
             return
+        self._nls_pending = None   # handles refer to the dropped trees
         from .linear import predict_linear
         nan_bins_np = np.asarray(self.train_data.binned.nan_bins)
         for k in range(self.num_class):
