@@ -58,6 +58,7 @@ def bench_params():
         "metric": "none",
         "verbosity": -1,
         "tpu_leaf_batch": LEAF_BATCH,
+        "tpu_histogram_impl": os.environ.get("BENCH_HIST_IMPL", "auto"),
     }
     if QUANTIZED:
         params["use_quantized_grad"] = True
@@ -160,6 +161,7 @@ def run_bench(rows, iters):
                 "rows": rows, "features": FEATURES, "iters": iters,
                 "num_leaves": NUM_LEAVES, "leaf_batch": LEAF_BATCH,
                 "quantized": QUANTIZED,
+                "histogram_impl": params["tpu_histogram_impl"],
                 "platform": platform, "devices": n_dev,
                 "train_time_s": round(elapsed, 3),
                 "iters_per_sec": round(iters_per_sec, 3),
@@ -276,6 +278,9 @@ def main():
     attempts = [
         ("accelerator", {}, ROWS, ITERS),
         ("accelerator-retry", {}, ROWS, ITERS),
+        # A Mosaic/Pallas compile regression must degrade to a slower TPU
+        # number (XLA one-hot contraction), not to the CPU fallback.
+        ("accelerator-xla-hist", {"BENCH_HIST_IMPL": "onehot"}, ROWS, ITERS),
         ("accelerator-retry2", {}, ROWS, ITERS),
         # Hermetic CPU fallback: smaller shapes (XLA-on-host is slow), honest
         # platform tag in the JSON so the number is never mistaken for TPU.
