@@ -88,6 +88,23 @@ def _pick_tiles(f: int, num_bins: int, itemsize: int, rows_block: int,
     return blk, ft
 
 
+def kernel_layout(f: int, num_bins: int, dtype: str, rows_block: int = 0,
+                  packed4: bool = False):
+    """(rows_block, ftile, cols_tile, b_pad) for one ``histogram_flat``
+    config.  Every Mosaic legality constraint lives here so it is testable
+    without hardware: the bin axis is padded to a 128-multiple (bin ids are
+    < num_bins, so phantom bins stay exactly zero), which keeps the
+    kernel's one-hot flatten — and, under packed4, each nibble plane's
+    contiguous output half — lane-aligned."""
+    isz = _DTYPES[dtype][2]
+    b_pad = -(-num_bins // 128) * 128
+    rows_block, ftile = _pick_tiles(f, b_pad, isz, rows_block)
+    if packed4 and ftile % 2:
+        ftile += 1           # chunk boundaries must not split nibble pairs
+    cols_tile = ftile // 2 if packed4 else ftile
+    return rows_block, ftile, cols_tile, b_pad
+
+
 def _prep(bins, vals, rows_block, ftile):
     """Pad rows to the block size, features to a multiple of the chunk
     width, channels to C_PAD; returns (bins, valsT, nblocks, nchunks).
@@ -172,13 +189,8 @@ def histogram_flat(
     # DEFAULT would run the MXU at bf16 and perturb every histogram entry.
     precision = (jax.lax.Precision.HIGHEST if dtype == "f32"
                  else jax.lax.Precision.DEFAULT)
-    # Mosaic-legal one-hot flatten requires a 128-multiple bin axis; bin
-    # ids are < num_bins so the phantom bins stay exactly zero.
-    b_pad = -(-num_bins // 128) * 128
-    rows_block, ftile = _pick_tiles(f, b_pad, isz, rows_block)
-    if packed4 and ftile % 2:
-        ftile += 1           # chunk boundaries must not split nibble pairs
-    cols_tile = ftile // 2 if packed4 else ftile
+    rows_block, ftile, cols_tile, b_pad = kernel_layout(
+        f, num_bins, dtype, rows_block, packed4)
     bins, valsT, nblocks, nchunks = _prep(bins, vals, rows_block, cols_tile)
     call = pl.pallas_call(
         functools.partial(_flat_kernel, num_bins=b_pad, ftile=ftile,

@@ -210,3 +210,42 @@ def test_flat_histogram_dtypes_match_oracle(rng):
     np.testing.assert_array_equal(np.asarray(got8, np.int64), ref8)
 
 
+
+
+def test_flat_histogram_bench_bin_count(rng):
+    """max_bin=255 regression: 255 bins made the kernel's one-hot flatten a
+    Mosaic-illegal shape cast on hardware (merged minor dim 7140 is not
+    128-aligned); the kernel now pads the bin axis to a 128-multiple and
+    phantom bins must stay exactly zero."""
+    from lightgbm_tpu.ops.pallas_histogram import histogram_flat
+
+    n, f, B = 768, 28, 255
+    bins = rng.randint(0, B, size=(n, f)).astype(np.uint8)
+    vals = pack_values(jnp.asarray(rng.randn(n), dtype=jnp.float32),
+                       jnp.asarray(rng.rand(n), dtype=jnp.float32),
+                       jnp.asarray(rng.rand(n) > 0.3))
+    ref = np.asarray(histogram_segment(jnp.asarray(bins), vals, num_bins=B))
+    got = histogram_flat(jnp.asarray(bins), vals, num_bins=B, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), ref, rtol=1e-4, atol=1e-4)
+
+
+def test_flat_histogram_layout_mosaic_alignment():
+    """Hardware-independent guard for the max_bin=255 Mosaic regression:
+    interpret-mode parity cannot see layout legality, so pin the
+    constraints structurally — the padded bin axis, the one-hot flatten
+    width, the packed4 half-width, and the row block must all be
+    128-aligned for every bin count and dtype."""
+    from lightgbm_tpu.ops.pallas_histogram import kernel_layout
+
+    for dtype in ("f32", "bf16", "int8"):
+        for num_bins in (2, 15, 16, 63, 255, 256, 300):
+            for f in (1, 28, 300):
+                blk, ftile, cols_tile, b_pad = kernel_layout(
+                    f, num_bins, dtype)
+                assert b_pad % 128 == 0 and b_pad >= num_bins
+                assert (ftile * b_pad) % 128 == 0
+                assert blk % 128 == 0
+            blk, ftile, cols_tile, b_pad = kernel_layout(
+                28, num_bins, dtype, packed4=True)
+            assert ftile % 2 == 0 and ftile == 2 * cols_tile
+            assert ((ftile // 2) * b_pad) % 128 == 0  # nibble-plane halves
