@@ -66,13 +66,52 @@ def bench_params():
 
 
 def make_higgs_like(n, f, seed=0):
+    cache = _cache_path(f"higgs_{n}x{f}_s{seed}.npz")
+    if cache and os.path.exists(cache):
+        try:
+            with np.load(cache) as d:
+                return d["X"], d["y"]
+        except Exception:  # noqa: BLE001 — torn/stale cache: regenerate
+            _cache_drop(cache)
     rng = np.random.RandomState(seed)
     X = rng.randn(n, f).astype(np.float32)
     w = rng.randn(f) / np.sqrt(f)
     logits = X @ w + 0.5 * np.sin(X[:, 0] * 2) * X[:, 1]
     p = 1 / (1 + np.exp(-logits))
     y = (rng.rand(n) < p).astype(np.float64)
+    if cache:
+        def _write(path):
+            with open(path, "wb") as fh:   # handle keeps the exact name
+                np.savez(fh, X=X, y=y)
+        _cache_write(cache, _write)
     return X, y
+
+
+def _cache_path(name):
+    """Retry attempts (the wedge ladder) re-run the whole measurement in
+    fresh child processes; caching the synthetic data and the binned
+    dataset keeps each retry's host-side preamble to seconds."""
+    root = os.environ.get("BENCH_DATA_CACHE", "/tmp/bench_cache")
+    return os.path.join(root, name) if root else None
+
+
+def _cache_write(path, writer):
+    """Atomic cache publish: write under a per-process name, then rename —
+    concurrent cold-cache runs each publish only their own complete file."""
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = f"{path}.{os.getpid()}.tmp"
+        writer(tmp)
+        os.replace(tmp, path)
+    except OSError:
+        pass
+
+
+def _cache_drop(path):
+    try:
+        os.unlink(path)
+    except OSError:
+        pass
 
 
 def _probe_backend():
@@ -117,9 +156,22 @@ def run_bench(rows, iters):
 
     X, y = make_higgs_like(rows, FEATURES)
     params = bench_params()
-    ds = lgb.Dataset(X, label=y)
+    bin_cache = _cache_path(
+        f"higgs_{rows}x{FEATURES}_b{params['max_bin']}.bin")
     t_bin0 = time.time()
-    ds.construct(params)
+    ds = None
+    if bin_cache and os.path.exists(bin_cache):
+        try:
+            ds = lgb.Dataset(bin_cache, params=params)
+            ds.construct(params)
+        except Exception:  # noqa: BLE001 — torn/stale cache: rebin
+            _cache_drop(bin_cache)
+            ds = None
+    if ds is None:
+        ds = lgb.Dataset(X, label=y)
+        ds.construct(params)
+        if bin_cache:
+            _cache_write(bin_cache, ds.save_binary)
     bin_time = time.time() - t_bin0
 
     # Warmup: compile the training step (excluded from timing, like the
