@@ -532,9 +532,9 @@ class GBDT:
         if used_fused:
             # Hot path: ONE device dispatch for gradients + all class trees +
             # score updates.
-            self.scores, outs = self._fused_iter(self.bins_dev,
-                                                 self.scores, mask_dev,
-                                                 fmask, shrink, qkey, skey)
+            self.scores, outs = self._hist_fallback_call(
+                "_fused_iter", self.bins_dev, self.scores, mask_dev,
+                fmask, shrink, qkey, skey)
             results = [(k, a, rl) for k, (a, rl) in enumerate(outs)]
         else:
             if goss_grads is not None:
@@ -551,8 +551,8 @@ class GBDT:
                 qk = None if qkey is None else jax.random.fold_in(qkey, k)
                 nk = None if skey is None else jax.random.fold_in(skey, k)
                 if cfg.linear_tree:
-                    arrays, row_leaf = self._raw_grow(gk, hk, mask_dev, fmask,
-                                                      qk, nk)
+                    arrays, row_leaf = self._hist_fallback_call(
+                        "_raw_grow", gk, hk, mask_dev, fmask, qk, nk)
                     new_sk = self._fit_and_store_linear(
                         k, arrays, row_leaf, gk, hk, mask_dev, sk, shrink)
                     if self._shape_k:
@@ -562,8 +562,8 @@ class GBDT:
                     continue
                 if (self.objective is not None
                         and self.objective.need_renew_tree_output):
-                    arrays, row_leaf = self._raw_grow(gk, hk, mask_dev, fmask,
-                                                      qk, nk)
+                    arrays, row_leaf = self._hist_fallback_call(
+                        "_raw_grow", gk, hk, mask_dev, fmask, qk, nk)
                     arrays = self._renew_and_shrink(arrays, row_leaf, sk,
                                                     shrink)
                     new_sk = _add_leaf_outputs(sk, row_leaf,
@@ -571,13 +571,13 @@ class GBDT:
                 elif self._use_cegb:
                     coupled = jnp.asarray(
                         self._cegb_coupled_raw * (~self._cegb_used))
-                    new_sk, arrays, row_leaf = self._grow_apply(
-                        self.bins_dev, sk, gk, hk, mask_dev, fmask, shrink,
-                        coupled, self._cegb_lazy_dev, qk, nk)
+                    new_sk, arrays, row_leaf = self._hist_fallback_call(
+                        "_grow_apply", self.bins_dev, sk, gk, hk, mask_dev,
+                        fmask, shrink, coupled, self._cegb_lazy_dev, qk, nk)
                 else:
-                    new_sk, arrays, row_leaf = self._grow_apply(
-                        self.bins_dev, sk, gk, hk, mask_dev, fmask, shrink,
-                        quant_key=qk, split_key=nk)
+                    new_sk, arrays, row_leaf = self._hist_fallback_call(
+                        "_grow_apply", self.bins_dev, sk, gk, hk, mask_dev,
+                        fmask, shrink, quant_key=qk, split_key=nk)
                 if self._shape_k:
                     self.scores = self.scores.at[:, k].set(new_sk)
                 else:
@@ -648,6 +648,41 @@ class GBDT:
                 "original bin matrices on device; set enable_bundle=false "
                 "if HBM is tight")
         return self.train_data.bins_device()
+
+    def _degrade_histogram_impl(self, err) -> bool:
+        """Runtime fallback for in-kernel compile failures: when the Pallas
+        histogram kernel fails Mosaic compilation (a layout-legality class
+        of error that no CPU test can see — docs/PERF.md round 5), rebuild
+        the growers on the XLA one-hot contraction instead of crashing
+        training.  Returns True when a retry makes sense."""
+        from ..parallel.mesh import DATA_AXIS
+        from ..utils.log import Log
+        msg = str(err)
+        if "mosaic" not in msg.lower() and "pallas" not in msg.lower():
+            return False
+        if self.grower_cfg.histogram_impl not in ("auto", "pallas"):
+            return False   # an explicit impl choice should fail loudly
+        Log.warning(
+            "Pallas histogram kernel failed to compile; falling back to "
+            f"tpu_histogram_impl=onehot ({msg.splitlines()[0][:160]})")
+        import dataclasses as _dc
+        self.grower_cfg = _dc.replace(self.grower_cfg,
+                                      histogram_impl="onehot")
+        self.grow = make_grower(self.grower_cfg, mesh=self.mesh,
+                                data_axis=DATA_AXIS)
+        self._build_iter_fns()
+        return True
+
+    def _hist_fallback_call(self, name, *args, **kw):
+        """Dispatch a compiled program by attribute name; on a Mosaic or
+        Pallas compile failure degrade the histogram impl and retry once
+        (the rebuilt program lives under the same attribute)."""
+        try:
+            return getattr(self, name)(*args, **kw)
+        except Exception as e:  # noqa: BLE001 — inspect, re-raise if foreign
+            if not self._degrade_histogram_impl(e):
+                raise
+            return getattr(self, name)(*args, **kw)
 
     def _raw_grow(self, gk, hk, mask_dev, fmask, quant_key=None,
                   split_key=None):

@@ -347,3 +347,42 @@ def test_degenerate_stop_immediate_with_dart():
                      "verbosity": -1, "num_leaves": 7},
                     lgb.Dataset(X, label=y), 10)
     assert bst.num_trees() == 1
+
+
+def test_mosaic_compile_failure_degrades_to_onehot(monkeypatch):
+    """A Pallas/Mosaic kernel compile failure mid-training must degrade to
+    the XLA one-hot histogram (with a warning) and produce the same model,
+    not crash (docs/PERF.md round 5: layout legality is invisible off-TPU)."""
+    from lightgbm_tpu.ops import pallas_histogram
+
+    def boom(*a, **k):
+        raise RuntimeError(
+            "Mosaic failed to compile TPU kernel: infer-vector-layout: "
+            "unsupported shape cast (simulated)")
+
+    monkeypatch.setattr(pallas_histogram, "histogram_flat", boom)
+    X, y = make_regression(n_samples=600, n_features=6, noise=0.1,
+                           random_state=3)
+    params = {"objective": "regression", "verbosity": -1, "num_leaves": 15,
+              "tpu_histogram_impl": "pallas"}
+    bst = lgb.train(params, lgb.Dataset(X, label=y), 8)
+    ref = lgb.train({**params, "tpu_histogram_impl": "onehot"},
+                    lgb.Dataset(X, label=y), 8)
+    np.testing.assert_allclose(bst.predict(X), ref.predict(X),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_explicit_impl_failure_raises(monkeypatch):
+    """An explicit non-pallas impl choice must fail loudly, not degrade."""
+    from lightgbm_tpu.ops import histogram
+
+    def boom(*a, **k):
+        raise RuntimeError("Mosaic failed to compile TPU kernel (simulated)")
+
+    monkeypatch.setattr(histogram, "histogram_segment", boom)
+    X, y = make_regression(n_samples=300, n_features=4, noise=0.1,
+                           random_state=3)
+    with pytest.raises(Exception, match="[Mm]osaic"):
+        lgb.train({"objective": "regression", "verbosity": -1,
+                   "tpu_histogram_impl": "segment"},
+                  lgb.Dataset(X, label=y), 3)
