@@ -215,8 +215,12 @@ def run_bench(rows, iters):
                 "num_leaves": NUM_LEAVES, "leaf_batch": LEAF_BATCH,
                 "quantized": QUANTIZED,
                 # EFFECTIVE impl: the library can degrade pallas->onehot at
-                # runtime (Mosaic compile failure); report what actually ran.
-                "histogram_impl": bst._gbdt.grower_cfg.histogram_impl,
+                # runtime (Mosaic compile failure); report what actually ran,
+                # resolving "auto" the way histogram_from_vals does.
+                "histogram_impl": (
+                    ("pallas" if platform == "tpu" else "segment")
+                    if bst._gbdt.grower_cfg.histogram_impl == "auto"
+                    else bst._gbdt.grower_cfg.histogram_impl),
                 "platform": platform, "devices": n_dev,
                 "train_time_s": round(elapsed, 3),
                 "iters_per_sec": round(iters_per_sec, 3),

@@ -103,9 +103,20 @@ class LGBMModel:
 
     def _lgb_params(self) -> Dict[str, Any]:
         extra = getattr(self, "_lgb_extra", {})
+        # When the user supplied the objective through an alias kwarg
+        # (application=...), the class-default "objective" key must not be
+        # emitted: config alias resolution is first-write-wins with the
+        # canonical key beating aliases (reference KeyAliasTransform), so
+        # the filler default would silently override the user's choice.
+        if self.objective is None and any(
+                k in self._other_params
+                for k in ("objective_type", "app", "application", "loss")):
+            objective = None
+        else:
+            objective = self.objective or self._default_objective()
         p = {
             "boosting": self.boosting_type,
-            "objective": self.objective or self._default_objective(),
+            "objective": objective,
             "num_leaves": self.num_leaves,
             "max_depth": self.max_depth,
             "learning_rate": self.learning_rate,
@@ -120,6 +131,8 @@ class LGBMModel:
             "lambda_l2": self.reg_lambda,
             "verbosity": -1,
         }
+        if objective is None:
+            del p["objective"]
         if self.random_state is not None:
             p["seed"] = int(self.random_state)
         p.update(self._other_params)
@@ -200,12 +213,58 @@ class LGBMModel:
         return self.booster_.best_iteration
 
     @property
+    def best_score_(self) -> Dict[str, Dict[str, float]]:
+        """Best validation scores (reference ``LGBMModel.best_score_``):
+        {dataset: {metric: value}} at the best (or final) iteration.  The
+        recorded curves cover only THIS fit's rounds, while best_iteration
+        counts any init_model base trees too — index curve-relative."""
+        it = self.n_estimators_
+        total = self.booster_.current_iteration
+
+        def pick(curve):
+            idx = min(it, total) - (total - len(curve))
+            return curve[min(max(idx, 1), len(curve)) - 1]
+
+        return {name: {metric: pick(curve)
+                       for metric, curve in metrics.items() if curve}
+                for name, metrics in self._evals_result.items()}
+
+    @property
+    def objective_(self) -> Union[str, Callable]:
+        if self._Booster is None:
+            raise ValueError("Model not fitted")
+        if callable(self.objective):
+            return self.objective
+        from .config import Config
+        return Config(self._lgb_params()).objective  # resolves aliases
+
+    @property
+    def n_estimators_(self) -> int:
+        """Trained tree count per class (reference ``n_estimators_`` —
+        reflects early stopping, unlike the ``n_estimators`` param)."""
+        bst = self.booster_
+        return bst.best_iteration if bst.best_iteration > 0 \
+            else bst.current_iteration
+
+    @property
+    def n_iter_(self) -> int:
+        return self.n_estimators_
+
+    @property
     def n_features_(self) -> int:
         return self.booster_.num_feature()
 
     @property
     def n_features_in_(self) -> int:
         return self.n_features_
+
+    @property
+    def feature_name_(self) -> List[str]:
+        return self.booster_.feature_name()
+
+    @property
+    def feature_names_in_(self) -> np.ndarray:
+        return np.asarray(self.feature_name_)
 
 
 class LGBMRegressor(LGBMModel):

@@ -107,3 +107,37 @@ def test_ranker_eval_at_and_init_model():
     clf2 = lgb.LGBMClassifier(n_estimators=2, num_leaves=7)
     clf2.fit(Xc, yc, init_model=clf.booster_)
     assert clf2.booster_.num_trees() >= 2
+
+
+def test_fitted_attribute_surface():
+    """Reference LGBMModel exposes best_score_/objective_/n_estimators_/
+    n_iter_/feature_name_/feature_names_in_ on fitted estimators."""
+    X, y = make_classification(n_samples=800, n_features=8, random_state=0)
+    est = lgb.LGBMClassifier(n_estimators=12, num_leaves=7)
+    est.fit(X, y, eval_set=[(X, y)], eval_metric="binary_logloss")
+    assert est.objective_ == "binary"
+    assert est.n_estimators_ == 12 and est.n_iter_ == 12
+    # objective supplied through an alias kwarg must be reported (not the
+    # class default)
+    X2, y2 = make_regression(n_samples=300, n_features=4, random_state=2)
+    reg = lgb.LGBMRegressor(n_estimators=3, application="poisson")
+    reg.fit(X2, np.abs(y2) + 1.0)
+    assert reg.objective_ == "poisson"
+    assert len(est.feature_name_) == 8
+    assert est.feature_names_in_.shape == (8,)
+    bs = est.best_score_
+    assert "valid_0" in bs and "binary_logloss" in bs["valid_0"]
+    assert bs["valid_0"]["binary_logloss"] == \
+        est.evals_result_["valid_0"]["binary_logloss"][-1]
+
+
+def test_best_score_tracks_early_stopping():
+    X, y = make_classification(n_samples=2000, n_features=10, random_state=1)
+    est = lgb.LGBMClassifier(n_estimators=300, learning_rate=0.3)
+    est.fit(X[:1500], y[:1500], eval_set=[(X[1500:], y[1500:])],
+            eval_metric="binary_logloss",
+            callbacks=[lgb.early_stopping(5, verbose=False)])
+    assert est.n_estimators_ == est.best_iteration_ > 0
+    curve = est.evals_result_["valid_0"]["binary_logloss"]
+    assert est.best_score_["valid_0"]["binary_logloss"] == \
+        curve[est.best_iteration_ - 1]
