@@ -329,5 +329,10 @@ def canonical_name(key: str) -> str:
     return _ALIASES.get(key, key)
 
 
+def aliases_of(name: str) -> List[str]:
+    """All alias spellings of a canonical parameter (excluding itself)."""
+    return [a for a, c in _ALIASES.items() if c == name]
+
+
 def param_names() -> List[str]:
     return list(_CANONICAL.keys())

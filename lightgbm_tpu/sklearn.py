@@ -108,9 +108,10 @@ class LGBMModel:
         # emitted: config alias resolution is first-write-wins with the
         # canonical key beating aliases (reference KeyAliasTransform), so
         # the filler default would silently override the user's choice.
+        from .config import aliases_of
         if self.objective is None and any(
-                k in self._other_params
-                for k in ("objective_type", "app", "application", "loss")):
+                self._other_params.get(k) is not None
+                for k in aliases_of("objective")):
             objective = None
         else:
             objective = self.objective or self._default_objective()
@@ -283,8 +284,9 @@ class LGBMClassifier(LGBMModel):
         y_enc = np.searchsorted(self._classes, y)
         if self._n_classes > 2:
             self._other_params.setdefault("num_class", self._n_classes)
-            if self.objective is None:
-                self.objective = "multiclass"
+            # objective stays None: _default_objective() resolves to
+            # multiclass via _n_classes, and _lgb_params' alias-suppression
+            # then also honors e.g. application='multiclassova'
         if "eval_set" in kwargs and kwargs["eval_set"] is not None:
             kwargs["eval_set"] = [
                 (ex, np.searchsorted(self._classes, np.asarray(ey)))

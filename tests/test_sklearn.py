@@ -141,3 +141,17 @@ def test_best_score_tracks_early_stopping():
     curve = est.evals_result_["valid_0"]["binary_logloss"]
     assert est.best_score_["valid_0"]["binary_logloss"] == \
         curve[est.best_iteration_ - 1]
+
+
+def test_classifier_alias_objective_multiclass():
+    """application='multiclassova' on 3-class data must train OVA, not be
+    silently replaced by the multiclass default (alias suppression must
+    apply to the classifier path too); a None-valued alias must be inert."""
+    X, y = make_classification(n_samples=900, n_features=8, n_informative=6,
+                               n_classes=3, random_state=5)
+    est = lgb.LGBMClassifier(n_estimators=5, application="multiclassova")
+    est.fit(X, y)
+    assert est.objective_ == "multiclassova"
+    est2 = lgb.LGBMClassifier(n_estimators=5, application=None)
+    est2.fit(X, y)
+    assert est2.objective_ == "multiclass"

@@ -13,7 +13,10 @@ OUT=${1:-/tmp/lgbbuild2}
 EIGEN=$(python -c "import tensorflow, os; print(os.path.join(os.path.dirname(tensorflow.__file__), 'include'))" 2>/dev/null \
   || echo /opt/venv/lib/python3.12/site-packages/tensorflow/include)
 mkdir -p "$OUT"
-g++ -O2 -std=c++17 -fopenmp -DUSE_SOCKET -DEIGEN_MPL2_ONLY \
+# -DMM_MALLOC=1: common.h otherwise macro-defines _mm_malloc(a,b)->malloc(a),
+# which mangles Eigen's later #include <mm_malloc.h> declarations into
+# conflicting static redeclarations of malloc/free (gcc12 + TF Eigen).
+g++ -O2 -std=c++17 -fopenmp -DUSE_SOCKET -DEIGEN_MPL2_ONLY -DMM_MALLOC=1 \
   -I"$(dirname "$0")" -I/root/reference/include -I"$EIGEN" \
   /root/reference/src/main.cpp /root/reference/src/*/*.cpp \
   -o "$OUT/lightgbm" -lpthread
