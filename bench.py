@@ -281,6 +281,9 @@ def _run_child(env_extra, rows, iters, timeout):
     env["_BENCH_INNER"] = "1"
     env["BENCH_ROWS"] = str(rows)
     env["BENCH_ITERS"] = str(iters)
+    # Persistent XLA compile cache: retry attempts re-trace the identical
+    # program; the cached executable skips the 20-40s first-compile.
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__)],
