@@ -283,7 +283,11 @@ def _run_child(env_extra, rows, iters, timeout):
     env["BENCH_ITERS"] = str(iters)
     # Persistent XLA compile cache: retry attempts re-trace the identical
     # program; the cached executable skips the 20-40s first-compile.
-    env.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
+    # Per-user path: a world-shared dir could be unwritable or let another
+    # local user pre-plant executables.
+    import tempfile
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", os.path.join(
+        tempfile.gettempdir(), f"jax_cache_{os.getuid()}"))
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__)],

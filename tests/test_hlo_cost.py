@@ -57,7 +57,7 @@ def hlo():
     serial, and fp32 8-way data-parallel."""
     cfg = Config({"objective": "binary", "verbosity": -1})
 
-    def compile_text(quantized=False, mesh=None):
+    def compile_text(quantized=False, mesh=None, want_cost=False):
         n = N if mesh is None else N_SHARDED
         rng = np.random.RandomState(0)
         X = rng.randn(n, F)
@@ -72,16 +72,22 @@ def hlo():
                 jnp.ones(n, jnp.float32), jnp.ones(n, jnp.float32),
                 jnp.ones(F, bool), meta["num_bins_per_feature"],
                 meta["nan_bins"], meta["is_categorical"], meta["monotone"]]
-        txt = grow.lower(*args).compile().as_text()
+        compiled = grow.lower(*args).compile()
+        txt = compiled.as_text()
         if mesh is not None:
             # Guard against the mask-layout fallback silently compiling a
             # collective-free program (rows/shard must exceed _MIN_BUCKET).
             assert "all-reduce" in txt
-        return txt
+        if not want_cost:
+            return txt, None
+        cost = compiled.cost_analysis()
+        return txt, (cost[0] if isinstance(cost, list) else cost)
 
-    return {"fp32": compile_text(),
-            "quant": compile_text(quantized=True),
-            "sharded": compile_text(mesh=make_mesh(8, 1))}
+    fp32, fp32_cost = compile_text(want_cost=True)
+    quant, _ = compile_text(quantized=True)
+    sharded, _ = compile_text(mesh=make_mesh(8, 1))
+    return {"fp32": fp32, "quant": quant, "sharded": sharded,
+            "fp32_cost": fp32_cost}
 
 
 def _whiles(txt):
@@ -159,3 +165,19 @@ def test_collective_bytes_per_wave(hlo):
     assert wave_hist_reduces == 1, wave_hist_reduces
     assert total <= wave_bytes + root_bytes + (256 << 10), (
         total, wave_bytes + root_bytes)
+
+
+def test_program_flops_bounded(hlo):
+    """XLA's own FLOP count for the bench-shaped program (while bodies
+    counted once) must stay near the one-hot contraction's analytic cost.
+    The round-2 M-packed multi-sibling kernel was a ~100x FLOP
+    pessimization on an op that was never FLOP-limited — this pins that
+    class of regression without hardware.
+
+    Analytic floor: per wave step the W sibling histograms contract
+    (N, F*B) one-hots against (N, 3) values -> ~2*N*F*B*3 FLOPs at the
+    static bucket bound, plus split-scan/partition smallness."""
+    flops = hlo["fp32_cost"].get("flops", 0.0)
+    onehot_step = 2.0 * N * F * B * 3
+    assert 0 < flops <= 3.0 * onehot_step, (
+        f"program flops {flops:.3e} vs one-hot step {onehot_step:.3e}")
