@@ -283,11 +283,10 @@ def _run_child(env_extra, rows, iters, timeout):
     env["BENCH_ITERS"] = str(iters)
     # Persistent XLA compile cache: retry attempts re-trace the identical
     # program; the cached executable skips the 20-40s first-compile.
-    # Per-user path: a world-shared dir could be unwritable or let another
-    # local user pre-plant executables.
-    import tempfile
+    # Lives under the user's own cache dir — a /tmp path could be
+    # pre-created (and executables pre-planted) by another local user.
     env.setdefault("JAX_COMPILATION_CACHE_DIR", os.path.join(
-        tempfile.gettempdir(), f"jax_cache_{os.getuid()}"))
+        os.path.expanduser("~"), ".cache", "lightgbm_tpu_jax_cache"))
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__)],

@@ -383,18 +383,30 @@ class MulticlassSoftmax(ObjectiveFunction):
         self.num_model_per_iteration = cfg.num_class
         self.onehot = jax.nn.one_hot(
             jnp.asarray(label, jnp.int32), cfg.num_class, dtype=jnp.float32)
+        # Friedman's redundant->non-redundant rescale (reference
+        # multiclass_objective.hpp:31): 2.0 only in the K=2 case.
+        self.factor = cfg.num_class / (cfg.num_class - 1.0)
+        # Weighted class priors for boost-from-average (reference Init,
+        # multiclass_objective.hpp:53-80).
+        w = (np.ones(len(label)) if weight is None
+             else np.asarray(weight, np.float64))
+        counts = np.zeros(cfg.num_class)
+        np.add.at(counts, np.asarray(label, np.int64), w)
+        self.class_init_probs = counts / max(w.sum(), 1e-300)
 
     def get_gradients(self, score):  # score: (N, K)
         p = jax.nn.softmax(score, axis=-1)
         grad = p - self.onehot
-        hess = 2.0 * p * (1.0 - p)
+        hess = self.factor * p * (1.0 - p)
         if self.weight is not None:
             grad = grad * self.weight[:, None]
             hess = hess * self.weight[:, None]
         return grad, hess
 
     def boost_from_score(self, class_id: int = 0) -> float:
-        return 0.0
+        # log class prior (reference BoostFromScore,
+        # multiclass_objective.hpp:155)
+        return float(np.log(max(1e-15, self.class_init_probs[class_id])))
 
     def convert_output(self, score):
         return jax.nn.softmax(score, axis=-1)

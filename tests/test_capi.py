@@ -1017,7 +1017,8 @@ def test_capi_multiclass_custom_objective_layout():
     X = rng.randn(n, f)
     y = (X[:, 0] + 0.7 * X[:, 1] > 0).astype(int) + (X[:, 2] > 0.5)
 
-    params = b"objective=multiclass num_class=3 num_leaves=7 verbosity=-1"
+    params = (b"objective=multiclass num_class=3 num_leaves=7 "
+             b"verbosity=-1 boost_from_average=false")
     ds_a = _dataset_from_mat(lib, X, y)
     bst_a = ctypes.c_void_p()
     _check(lib, lib.LGBM_BoosterCreate(ds_a, params, ctypes.byref(bst_a)))
@@ -1029,7 +1030,8 @@ def test_capi_multiclass_custom_objective_layout():
     ds_b = _dataset_from_mat(lib, X, y)
     bst_b = ctypes.c_void_p()
     _check(lib, lib.LGBM_BoosterCreate(
-        ds_b, b"objective=custom num_class=3 num_leaves=7 verbosity=-1",
+        ds_b, b"objective=custom num_class=3 num_leaves=7 verbosity=-1 "
+        b"boost_from_average=false",
         ctypes.byref(bst_b)))
     onehot = np.eye(k, dtype=np.float64)[y]
     out_len = ctypes.c_int64()
@@ -1043,7 +1045,9 @@ def test_capi_multiclass_custom_objective_layout():
         e = np.exp(s - s.max(axis=1, keepdims=True))
         p = e / e.sum(axis=1, keepdims=True)
         grad = np.ascontiguousarray((p - onehot).T, np.float32)  # (k, n)
-        hess = np.ascontiguousarray((2.0 * p * (1.0 - p)).T, np.float32)
+        # reference softmax hessian factor k/(k-1) (multiclass_objective.hpp:31)
+        hess = np.ascontiguousarray(
+            (k / (k - 1.0) * p * (1.0 - p)).T, np.float32)
         _check(lib, lib.LGBM_BoosterUpdateOneIterCustom(
             bst_b,
             grad.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),

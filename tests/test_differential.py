@@ -1,0 +1,135 @@
+"""Differential harness vs the GENUINE LightGBM binary.
+
+Trains the same data/params through our framework and the reference CLI
+(built from ``/root/reference`` via ``tools/refbuild/build_reference.sh``)
+and compares holdout quality. Opt-in like the live interop test: set
+``LGBM_REFERENCE_BIN`` to the binary's path; skipped otherwise so CI does
+not depend on a from-source C++ build.
+
+These are QUALITY-parity checks (same data, same params, tolerance on the
+holdout metric), not tree-identity checks — tree identity at depth is
+covered by ``test_interop.py`` (first-tree splits) and the bench-config
+AUC pin (``tests/fixtures/bench_auc.json``).
+"""
+
+import os
+import shutil
+import subprocess
+import tempfile
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.metrics import _auc
+
+BIN = os.environ.get("LGBM_REFERENCE_BIN")
+
+pytestmark = pytest.mark.skipif(
+    not BIN, reason="set LGBM_REFERENCE_BIN to a reference CLI binary")
+
+N_TRAIN, N_VALID, SEED = 16_000, 4_000, 0
+
+
+def _data(objective, n_features=12, n_classes=3):
+    rng = np.random.RandomState(SEED)
+    n = N_TRAIN + N_VALID
+    X = rng.randn(n, n_features)
+    logits = X[:, 0] - 0.7 * X[:, 1] + 0.4 * X[:, 2] * X[:, 3]
+    if objective.startswith("multiclass"):
+        y = np.clip((logits - logits.mean()) / logits.std() + 1.5, 0,
+                    n_classes - 1).round()
+    elif objective == "binary":
+        y = (logits + 0.3 * rng.randn(n) > 0).astype(float)
+    else:
+        y = logits + 0.1 * rng.randn(n)
+    return X, y
+
+
+def _cli(conf_path):
+    """Run the reference CLI surfacing its own stderr on failure."""
+    proc = subprocess.run([BIN, f"config={conf_path}"], capture_output=True,
+                          text=True)
+    assert proc.returncode == 0, (
+        f"reference CLI failed ({proc.returncode}): {proc.stderr[-2000:]}")
+
+
+def _run_reference(X, y, params, pred_X):
+    d = tempfile.mkdtemp()
+    try:
+        def save(path, X_, y_):
+            np.savetxt(path, np.column_stack([y_, X_]), delimiter=",",
+                       fmt="%.7g")
+
+        save(f"{d}/tr.csv", X[:N_TRAIN], y[:N_TRAIN])
+        save(f"{d}/va.csv", pred_X, np.zeros(len(pred_X)))
+        conf = "".join(f"{k} = {v}\n" for k, v in params.items())
+        with open(f"{d}/train.conf", "w") as fh:
+            fh.write(conf + f"data = {d}/tr.csv\noutput_model = {d}/m.txt\n")
+        _cli(f"{d}/train.conf")
+        with open(f"{d}/pred.conf", "w") as fh:
+            fh.write(f"task = predict\ndata = {d}/va.csv\n"
+                     f"input_model = {d}/m.txt\noutput_result = {d}/p.txt\n"
+                     "predict_raw_score = true\n")
+        _cli(f"{d}/pred.conf")
+        return np.loadtxt(f"{d}/p.txt")
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
+def _run_ours(X, y, params):
+    ds = lgb.Dataset(X[:N_TRAIN], label=y[:N_TRAIN])
+    return lgb.train(dict(params), ds, params["num_iterations"])
+
+
+BASE = {"num_leaves": 31, "learning_rate": 0.1, "num_iterations": 30,
+        "min_data_in_leaf": 20, "verbosity": -1, "seed": 1}
+
+
+@pytest.mark.parametrize("case, params, tol", [
+    ("binary", {"objective": "binary"}, 3e-3),
+    ("binary_options", {"objective": "binary", "bagging_fraction": 0.7,
+                        "bagging_freq": 1, "feature_fraction": 0.8,
+                        "lambda_l1": 0.5, "lambda_l2": 2.0}, 8e-3),
+    ("binary_monotone", {"objective": "binary",
+                         "monotone_constraints": "1,-1,0,0,0,0,0,0,0,0,0,0"},
+     5e-3),
+], ids=lambda v: v if isinstance(v, str) else "")
+def test_binary_auc_parity(case, params, tol):
+    """Holdout AUC must track the genuine binary within tolerance on the
+    same data/params (bagging RNG differs by design, hence wider tol)."""
+    full = dict(BASE, **params)
+    X, y = _data("binary")
+    yva = y[N_TRAIN:]
+    ref_raw = _run_reference(X, y, full, X[N_TRAIN:])
+    ref_auc = _auc(yva, ref_raw, None, None)
+    ours = _run_ours(X, y, full)
+    our_auc = _auc(yva, ours.predict(X[N_TRAIN:], raw_score=True),
+                   None, None)
+    assert abs(our_auc - ref_auc) < tol, (case, our_auc, ref_auc)
+
+
+@pytest.mark.parametrize("objective, tol", [
+    ("regression", 0.03), ("regression_l1", 0.05), ("huber", 0.05)])
+def test_regression_rmse_parity(objective, tol):
+    """Holdout RMSE ratio vs the genuine binary within tolerance."""
+    full = dict(BASE, objective=objective)
+    X, y = _data(objective)
+    yva = y[N_TRAIN:]
+    ref_pred = _run_reference(X, y, full, X[N_TRAIN:])
+    ref_rmse = float(np.sqrt(np.mean((yva - ref_pred) ** 2)))
+    ours = _run_ours(X, y, full)
+    our_rmse = float(np.sqrt(np.mean(
+        (yva - ours.predict(X[N_TRAIN:], raw_score=True)) ** 2)))
+    assert our_rmse < ref_rmse * (1 + tol), (our_rmse, ref_rmse)
+
+
+def test_multiclass_accuracy_parity():
+    full = dict(BASE, objective="multiclass", num_class=3)
+    X, y = _data("multiclass")
+    yva = y[N_TRAIN:]
+    ref_raw = _run_reference(X, y, full, X[N_TRAIN:])  # (n, 3) raw scores
+    ref_acc = (ref_raw.reshape(len(yva), 3).argmax(1) == yva).mean()
+    ours = _run_ours(X, y, full)
+    our_acc = (ours.predict(X[N_TRAIN:]).argmax(1) == yva).mean()
+    assert abs(our_acc - ref_acc) < 5e-3, (our_acc, ref_acc)
