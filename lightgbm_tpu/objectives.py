@@ -381,6 +381,13 @@ class MulticlassSoftmax(ObjectiveFunction):
     def init(self, label, weight, group, cfg, position=None):
         super().init(label, weight, group, cfg, position)
         self.num_model_per_iteration = cfg.num_class
+        lab = np.asarray(label, np.int64)
+        if lab.size and (lab.min() < 0 or lab.max() >= cfg.num_class):
+            # reference Log::Fatal (multiclass_objective.hpp:62-64); a
+            # negative label would otherwise wrap in the prior counts.
+            raise ValueError(
+                f"multiclass labels must be in [0, {cfg.num_class}); found "
+                f"range [{lab.min()}, {lab.max()}]")
         self.onehot = jax.nn.one_hot(
             jnp.asarray(label, jnp.int32), cfg.num_class, dtype=jnp.float32)
         # Friedman's redundant->non-redundant rescale (reference
@@ -391,7 +398,7 @@ class MulticlassSoftmax(ObjectiveFunction):
         w = (np.ones(len(label)) if weight is None
              else np.asarray(weight, np.float64))
         counts = np.zeros(cfg.num_class)
-        np.add.at(counts, np.asarray(label, np.int64), w)
+        np.add.at(counts, lab, w)
         self.class_init_probs = counts / max(w.sum(), 1e-300)
 
     def get_gradients(self, score):  # score: (N, K)
@@ -421,6 +428,11 @@ class MulticlassOVA(ObjectiveFunction):
     def init(self, label, weight, group, cfg, position=None):
         super().init(label, weight, group, cfg, position)
         self.num_model_per_iteration = cfg.num_class
+        lab = np.asarray(label, np.int64)
+        if lab.size and (lab.min() < 0 or lab.max() >= cfg.num_class):
+            raise ValueError(
+                f"multiclassova labels must be in [0, {cfg.num_class}); "
+                f"found range [{lab.min()}, {lab.max()}]")
         self.onehot = jax.nn.one_hot(
             jnp.asarray(label, jnp.int32), cfg.num_class, dtype=jnp.float32)
 

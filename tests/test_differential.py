@@ -54,15 +54,22 @@ def _cli(conf_path):
         f"reference CLI failed ({proc.returncode}): {proc.stderr[-2000:]}")
 
 
-def _run_reference(X, y, params, pred_X):
+def _run_reference(X, y, params, pred_X, n_train=None, query=None):
+    """Train + raw-predict through the reference CLI.  ``query`` is an
+    optional (train_groups, pred_groups) pair written as .query sidecars
+    (ranking objectives)."""
+    n_train = N_TRAIN if n_train is None else n_train
     d = tempfile.mkdtemp()
     try:
         def save(path, X_, y_):
             np.savetxt(path, np.column_stack([y_, X_]), delimiter=",",
                        fmt="%.7g")
 
-        save(f"{d}/tr.csv", X[:N_TRAIN], y[:N_TRAIN])
+        save(f"{d}/tr.csv", X[:n_train], y[:n_train])
         save(f"{d}/va.csv", pred_X, np.zeros(len(pred_X)))
+        if query is not None:
+            np.savetxt(f"{d}/tr.csv.query", query[0], fmt="%d")
+            np.savetxt(f"{d}/va.csv.query", query[1], fmt="%d")
         conf = "".join(f"{k} = {v}\n" for k, v in params.items())
         with open(f"{d}/train.conf", "w") as fh:
             fh.write(conf + f"data = {d}/tr.csv\noutput_model = {d}/m.txt\n")
@@ -133,3 +140,129 @@ def test_multiclass_accuracy_parity():
     ours = _run_ours(X, y, full)
     our_acc = (ours.predict(X[N_TRAIN:]).argmax(1) == yva).mean()
     assert abs(our_acc - ref_acc) < 5e-3, (our_acc, ref_acc)
+
+
+def test_quantile_pinball_parity():
+    alpha = 0.7
+    full = dict(BASE, objective="quantile", alpha=alpha)
+    X, y = _data("quantile")
+    yva = y[N_TRAIN:]
+
+    def pinball(pred):
+        d = yva - pred
+        return float(np.mean(np.where(d >= 0, alpha * d, (alpha - 1) * d)))
+
+    ref = pinball(_run_reference(X, y, full, X[N_TRAIN:]))
+    ours = _run_ours(X, y, full)
+    got = pinball(ours.predict(X[N_TRAIN:], raw_score=True))
+    assert got < ref * 1.05, (got, ref)
+
+
+@pytest.mark.parametrize("objective", ["poisson", "tweedie"])
+def test_positive_regression_parity(objective):
+    full = dict(BASE, objective=objective)
+    rng = np.random.RandomState(SEED)
+    n = N_TRAIN + N_VALID
+    X = rng.randn(n, 10)
+    rate = np.exp(0.5 * X[:, 0] - 0.4 * X[:, 1])
+    y = rng.poisson(rate).astype(np.float64)
+    yva = y[N_TRAIN:]
+    # both emit raw log-rate scores; compare Poisson deviance
+    ref_raw = _run_reference(X, y, full, X[N_TRAIN:])
+    ours = _run_ours(X, y, full)
+    our_raw = ours.predict(X[N_TRAIN:], raw_score=True)
+
+    def dev(raw):
+        mu = np.exp(raw)
+        return float(np.mean(mu - yva * raw))
+
+    assert dev(our_raw) < dev(ref_raw) * 1.03, (dev(our_raw), dev(ref_raw))
+
+
+def test_xentropy_parity():
+    full = dict(BASE, objective="xentropy")
+    X, y = _data("binary")
+    y = np.clip(y * 0.8 + 0.1, 0, 1)   # soft labels in [0,1]
+    yva = y[N_TRAIN:]
+
+    def ll(raw):
+        p = 1 / (1 + np.exp(-raw))
+        p = np.clip(p, 1e-15, 1 - 1e-15)
+        return float(-np.mean(yva * np.log(p) + (1 - yva) * np.log(1 - p)))
+
+    ref = ll(_run_reference(X, y, full, X[N_TRAIN:]))
+    ours = _run_ours(X, y, full)
+    got = ll(ours.predict(X[N_TRAIN:], raw_score=True))
+    assert got < ref * 1.03, (got, ref)
+
+
+def test_categorical_feature_parity():
+    """Integer categorical columns declared via categorical_feature must
+    track the reference's categorical split quality."""
+    rng = np.random.RandomState(SEED)
+    n = N_TRAIN + N_VALID
+    Xnum = rng.randn(n, 6)
+    cat1 = rng.randint(0, 12, n)
+    cat2 = rng.randint(0, 5, n)
+    effect = np.where(np.isin(cat1, [2, 5, 7]), 1.5, -0.5)
+    y = (Xnum[:, 0] + effect + 0.4 * (cat2 == 3)
+         + 0.3 * rng.randn(n) > 0).astype(float)
+    X = np.column_stack([cat1, cat2, Xnum]).astype(np.float64)
+    full = dict(BASE, objective="binary", categorical_feature="0,1")
+    yva = y[N_TRAIN:]
+    ref_auc = _auc(yva, _run_reference(X, y, full, X[N_TRAIN:]), None, None)
+    ds = lgb.Dataset(X[:N_TRAIN], label=y[:N_TRAIN],
+                     categorical_feature=[0, 1])
+    ours = lgb.train({k: v for k, v in full.items()
+                      if k != "categorical_feature"}, ds,
+                     full["num_iterations"])
+    our_auc = _auc(yva, ours.predict(X[N_TRAIN:], raw_score=True),
+                   None, None)
+    assert abs(our_auc - ref_auc) < 5e-3, (our_auc, ref_auc)
+
+
+def test_quantized_training_parity():
+    """int8-gradient training (use_quantized_grad) quality must track the
+    reference's quantized mode."""
+    full = dict(BASE, objective="binary", use_quantized_grad="true",
+                num_grad_quant_bins=4)
+    X, y = _data("binary")
+    yva = y[N_TRAIN:]
+    ref_auc = _auc(yva, _run_reference(X, y, full, X[N_TRAIN:]), None, None)
+    ours = _run_ours(X, y, dict(full, use_quantized_grad=True))
+    our_auc = _auc(yva, ours.predict(X[N_TRAIN:], raw_score=True),
+                   None, None)
+    assert abs(our_auc - ref_auc) < 8e-3, (our_auc, ref_auc)
+
+
+def test_lambdarank_ndcg_parity():
+    """LambdaRank NDCG@5 vs the genuine binary (query sidecar file)."""
+    from lightgbm_tpu.metrics import _ndcg_multi
+    rng = np.random.RandomState(SEED)
+    n_q, per_q = 1200, 10
+    n = n_q * per_q
+    X = rng.randn(n, 8)
+    rel = X[:, 0] + 0.6 * X[:, 1] + 0.4 * rng.randn(n)
+    y = np.zeros(n, np.int64)
+    for q in range(n_q):
+        sl = slice(q * per_q, (q + 1) * per_q)
+        y[sl] = np.minimum(4, np.argsort(np.argsort(rel[sl])) * 5 // per_q)
+    n_tr_q = 1000
+    ntr = n_tr_q * per_q
+    full = dict(BASE, objective="lambdarank", num_iterations=40)
+    ref_scores = _run_reference(
+        X, y, full, X[ntr:], n_train=ntr,
+        query=(np.full(n_tr_q, per_q), np.full(n_q - n_tr_q, per_q)))
+
+    ds = lgb.Dataset(X[:ntr], label=y[:ntr], group=np.full(n_tr_q, per_q))
+    ours = lgb.train(full, ds, full["num_iterations"])
+    our_scores = ours.predict(X[ntr:], raw_score=True)
+
+    va_group = np.full(n_q - n_tr_q, per_q)
+
+    gains = np.array([(1 << i) - 1 for i in range(32)], np.float64)
+
+    def ndcg5(scores):
+        return _ndcg_multi(y[ntr:], scores, va_group, (5,), gains)[0]
+
+    assert abs(ndcg5(our_scores) - ndcg5(ref_scores)) < 0.02
