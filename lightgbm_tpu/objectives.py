@@ -371,6 +371,18 @@ class Binary(ObjectiveFunction):
         return 1.0 / (1.0 + jnp.exp(-self.cfg.sigmoid * score))
 
 
+def _check_multiclass_labels(label, num_class: int, name: str) -> np.ndarray:
+    """Labels must lie in [0, num_class) (reference Log::Fatal,
+    multiclass_objective.hpp:62-64); a negative label would otherwise
+    wrap in prior counts / produce an all-zero one-hot row silently."""
+    lab = np.asarray(label, np.int64)
+    if lab.size and (lab.min() < 0 or lab.max() >= num_class):
+        raise ValueError(
+            f"{name} labels must be in [0, {num_class}); found "
+            f"range [{lab.min()}, {lab.max()}]")
+    return lab
+
+
 # -------------------------------------------------------------------- multiclass
 class MulticlassSoftmax(ObjectiveFunction):
     """reference ``MulticlassSoftmax`` — K trees per iteration."""
@@ -381,13 +393,7 @@ class MulticlassSoftmax(ObjectiveFunction):
     def init(self, label, weight, group, cfg, position=None):
         super().init(label, weight, group, cfg, position)
         self.num_model_per_iteration = cfg.num_class
-        lab = np.asarray(label, np.int64)
-        if lab.size and (lab.min() < 0 or lab.max() >= cfg.num_class):
-            # reference Log::Fatal (multiclass_objective.hpp:62-64); a
-            # negative label would otherwise wrap in the prior counts.
-            raise ValueError(
-                f"multiclass labels must be in [0, {cfg.num_class}); found "
-                f"range [{lab.min()}, {lab.max()}]")
+        lab = _check_multiclass_labels(label, cfg.num_class, self.name)
         self.onehot = jax.nn.one_hot(
             jnp.asarray(label, jnp.int32), cfg.num_class, dtype=jnp.float32)
         # Friedman's redundant->non-redundant rescale (reference
@@ -428,11 +434,7 @@ class MulticlassOVA(ObjectiveFunction):
     def init(self, label, weight, group, cfg, position=None):
         super().init(label, weight, group, cfg, position)
         self.num_model_per_iteration = cfg.num_class
-        lab = np.asarray(label, np.int64)
-        if lab.size and (lab.min() < 0 or lab.max() >= cfg.num_class):
-            raise ValueError(
-                f"multiclassova labels must be in [0, {cfg.num_class}); "
-                f"found range [{lab.min()}, {lab.max()}]")
+        _check_multiclass_labels(label, cfg.num_class, self.name)
         self.onehot = jax.nn.one_hot(
             jnp.asarray(label, jnp.int32), cfg.num_class, dtype=jnp.float32)
 

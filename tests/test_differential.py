@@ -54,10 +54,11 @@ def _cli(conf_path):
         f"reference CLI failed ({proc.returncode}): {proc.stderr[-2000:]}")
 
 
-def _run_reference(X, y, params, pred_X, n_train=None, query=None):
+def _run_reference(X, y, params, pred_X, n_train=None, query=None,
+                   weight=None):
     """Train + raw-predict through the reference CLI.  ``query`` is an
     optional (train_groups, pred_groups) pair written as .query sidecars
-    (ranking objectives)."""
+    (ranking objectives); ``weight`` an optional train-weight sidecar."""
     n_train = N_TRAIN if n_train is None else n_train
     d = tempfile.mkdtemp()
     try:
@@ -70,6 +71,8 @@ def _run_reference(X, y, params, pred_X, n_train=None, query=None):
         if query is not None:
             np.savetxt(f"{d}/tr.csv.query", query[0], fmt="%d")
             np.savetxt(f"{d}/va.csv.query", query[1], fmt="%d")
+        if weight is not None:
+            np.savetxt(f"{d}/tr.csv.weight", weight[:n_train], fmt="%.7g")
         conf = "".join(f"{k} = {v}\n" for k, v in params.items())
         with open(f"{d}/train.conf", "w") as fh:
             fh.write(conf + f"data = {d}/tr.csv\noutput_model = {d}/m.txt\n")
@@ -229,7 +232,7 @@ def test_quantized_training_parity():
     X, y = _data("binary")
     yva = y[N_TRAIN:]
     ref_auc = _auc(yva, _run_reference(X, y, full, X[N_TRAIN:]), None, None)
-    ours = _run_ours(X, y, dict(full, use_quantized_grad=True))
+    ours = _run_ours(X, y, full)
     our_auc = _auc(yva, ours.predict(X[N_TRAIN:], raw_score=True),
                    None, None)
     assert abs(our_auc - ref_auc) < 8e-3, (our_auc, ref_auc)
@@ -266,3 +269,53 @@ def test_lambdarank_ndcg_parity():
         return _ndcg_multi(y[ntr:], scores, va_group, (5,), gains)[0]
 
     assert abs(ndcg5(our_scores) - ndcg5(ref_scores)) < 0.02
+
+
+def test_linear_tree_parity():
+    """linear_tree leaves fit per-leaf linear models (Eigen in the
+    reference, normal equations here); holdout RMSE must track."""
+    full = dict(BASE, objective="regression", linear_tree="true",
+                linear_lambda=0.01)
+    X, y = _data("regression")
+    yva = y[N_TRAIN:]
+    ref_pred = _run_reference(X, y, full, X[N_TRAIN:])
+    ref_rmse = float(np.sqrt(np.mean((yva - ref_pred) ** 2)))
+    ours = _run_ours(X, y, full)
+    our_rmse = float(np.sqrt(np.mean(
+        (yva - ours.predict(X[N_TRAIN:], raw_score=True)) ** 2)))
+    assert our_rmse < ref_rmse * 1.05, (our_rmse, ref_rmse)
+
+
+@pytest.mark.parametrize("case, extra, tol", [
+    ("goss", {"data_sample_strategy": "goss"}, 1e-2),
+    ("dart", {"boosting": "dart", "drop_rate": 0.1}, 1.5e-2),
+    ("extra_path_smooth", {"extra_trees": "true", "path_smooth": 1.0,
+                           "max_depth": 8}, 1.5e-2),
+])
+def test_stochastic_mode_auc_parity(case, extra, tol):
+    """Sampling/drop RNG differs across implementations by design; the
+    holdout AUC of each mode must still land in the same band."""
+    full = dict(BASE, objective="binary", **extra)
+    X, y = _data("binary")
+    yva = y[N_TRAIN:]
+    ref_auc = _auc(yva, _run_reference(X, y, full, X[N_TRAIN:]), None, None)
+    ours = _run_ours(X, y, full)
+    our_auc = _auc(yva, ours.predict(X[N_TRAIN:], raw_score=True),
+                   None, None)
+    assert abs(our_auc - ref_auc) < tol, (case, our_auc, ref_auc)
+
+
+def test_weighted_binary_parity():
+    """Sample weights flow through gradients, hessians, min_sum_hessian
+    and boost-from-average; weighted AUC must track the reference."""
+    full = dict(BASE, objective="binary")
+    X, y = _data("binary")
+    rng = np.random.RandomState(3)
+    w = np.exp(rng.randn(len(y)) * 0.5)
+    yva, wva = y[N_TRAIN:], w[N_TRAIN:]
+    ref_raw = _run_reference(X, y, full, X[N_TRAIN:], weight=w)
+    ref_auc = _auc(yva, ref_raw, wva, None)
+    ds = lgb.Dataset(X[:N_TRAIN], label=y[:N_TRAIN], weight=w[:N_TRAIN])
+    ours = lgb.train(dict(full), ds, full["num_iterations"])
+    our_auc = _auc(yva, ours.predict(X[N_TRAIN:], raw_score=True), wva, None)
+    assert abs(our_auc - ref_auc) < 5e-3, (our_auc, ref_auc)
