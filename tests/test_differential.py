@@ -64,7 +64,7 @@ def _run_reference(X, y, params, pred_X, n_train=None, query=None,
     try:
         def save(path, X_, y_):
             np.savetxt(path, np.column_stack([y_, X_]), delimiter=",",
-                       fmt="%.7g")
+                       fmt="%.17g")
 
         save(f"{d}/tr.csv", X[:n_train], y[:n_train])
         save(f"{d}/va.csv", pred_X, np.zeros(len(pred_X)))
@@ -72,7 +72,7 @@ def _run_reference(X, y, params, pred_X, n_train=None, query=None,
             np.savetxt(f"{d}/tr.csv.query", query[0], fmt="%d")
             np.savetxt(f"{d}/va.csv.query", query[1], fmt="%d")
         if weight is not None:
-            np.savetxt(f"{d}/tr.csv.weight", weight[:n_train], fmt="%.7g")
+            np.savetxt(f"{d}/tr.csv.weight", weight[:n_train], fmt="%.17g")
         conf = "".join(f"{k} = {v}\n" for k, v in params.items())
         with open(f"{d}/train.conf", "w") as fh:
             fh.write(conf + f"data = {d}/tr.csv\noutput_model = {d}/m.txt\n")
@@ -238,8 +238,12 @@ def test_quantized_training_parity():
     assert abs(our_auc - ref_auc) < 8e-3, (our_auc, ref_auc)
 
 
-def test_lambdarank_ndcg_parity():
-    """LambdaRank NDCG@5 vs the genuine binary (query sidecar file)."""
+@pytest.mark.parametrize("objective, tol", [
+    ("lambdarank", 0.02),
+    ("rank_xendcg", 0.03),   # stochastic gradients by design — wider band
+])
+def test_ranking_ndcg_parity(objective, tol):
+    """Ranking NDCG@5 vs the genuine binary (query sidecar files)."""
     from lightgbm_tpu.metrics import _ndcg_multi
     rng = np.random.RandomState(SEED)
     n_q, per_q = 1200, 10
@@ -252,23 +256,20 @@ def test_lambdarank_ndcg_parity():
         y[sl] = np.minimum(4, np.argsort(np.argsort(rel[sl])) * 5 // per_q)
     n_tr_q = 1000
     ntr = n_tr_q * per_q
-    full = dict(BASE, objective="lambdarank", num_iterations=40)
+    full = dict(BASE, objective=objective, num_iterations=40)
     ref_scores = _run_reference(
         X, y, full, X[ntr:], n_train=ntr,
         query=(np.full(n_tr_q, per_q), np.full(n_q - n_tr_q, per_q)))
-
     ds = lgb.Dataset(X[:ntr], label=y[:ntr], group=np.full(n_tr_q, per_q))
     ours = lgb.train(full, ds, full["num_iterations"])
-    our_scores = ours.predict(X[ntr:], raw_score=True)
-
-    va_group = np.full(n_q - n_tr_q, per_q)
-
     gains = np.array([(1 << i) - 1 for i in range(32)], np.float64)
+    va_group = np.full(n_q - n_tr_q, per_q)
 
     def ndcg5(scores):
         return _ndcg_multi(y[ntr:], scores, va_group, (5,), gains)[0]
 
-    assert abs(ndcg5(our_scores) - ndcg5(ref_scores)) < 0.02
+    assert abs(ndcg5(ours.predict(X[ntr:], raw_score=True))
+               - ndcg5(ref_scores)) < tol
 
 
 def test_linear_tree_parity():
@@ -319,3 +320,38 @@ def test_weighted_binary_parity():
     ours = lgb.train(dict(full), ds, full["num_iterations"])
     our_auc = _auc(yva, ours.predict(X[N_TRAIN:], raw_score=True), wva, None)
     assert abs(our_auc - ref_auc) < 5e-3, (our_auc, ref_auc)
+
+
+def test_leaf_and_contrib_prediction_parity():
+    """Load OUR model file into the genuine binary and compare leaf-index
+    and SHAP-contribution predictions element-wise — same model, so
+    traversal and TreeSHAP must agree exactly (not just in quality)."""
+    full = dict(BASE, objective="binary", num_iterations=12)
+    X, y = _data("binary")
+    Xva = X[N_TRAIN:N_TRAIN + 500]
+    ours = _run_ours(X, y, full)
+
+    d = tempfile.mkdtemp()
+    try:
+        ours.save_model(f"{d}/m.txt")
+        np.savetxt(f"{d}/va.csv",
+                   np.column_stack([np.zeros(len(Xva)), Xva]),
+                   delimiter=",", fmt="%.17g")
+        for mode, flag in [("leaf", "predict_leaf_index"),
+                           ("contrib", "predict_contrib")]:
+            with open(f"{d}/{mode}.conf", "w") as fh:
+                fh.write(f"task = predict\ndata = {d}/va.csv\n"
+                         f"input_model = {d}/m.txt\n"
+                         f"output_result = {d}/{mode}.txt\n"
+                         f"{flag} = true\n")
+            _cli(f"{d}/{mode}.conf")
+        ref_leaf = np.loadtxt(f"{d}/leaf.txt")
+        ref_contrib = np.loadtxt(f"{d}/contrib.txt")
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+    our_leaf = ours.predict(Xva, pred_leaf=True)
+    np.testing.assert_array_equal(our_leaf, ref_leaf)
+    our_contrib = ours.predict(Xva, pred_contrib=True)
+    np.testing.assert_allclose(our_contrib, ref_contrib,
+                               rtol=1e-5, atol=1e-6)
