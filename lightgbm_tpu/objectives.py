@@ -395,7 +395,7 @@ class MulticlassSoftmax(ObjectiveFunction):
         self.num_model_per_iteration = cfg.num_class
         lab = _check_multiclass_labels(label, cfg.num_class, self.name)
         self.onehot = jax.nn.one_hot(
-            jnp.asarray(label, jnp.int32), cfg.num_class, dtype=jnp.float32)
+            jnp.asarray(lab, jnp.int32), cfg.num_class, dtype=jnp.float32)
         # Friedman's redundant->non-redundant rescale (reference
         # multiclass_objective.hpp:31): 2.0 only in the K=2 case.
         self.factor = cfg.num_class / (cfg.num_class - 1.0)

@@ -126,6 +126,15 @@ def histogram_segment(
     return hist.reshape(f, num_bins, 3)
 
 
+def resolve_impl(impl: str, platform: Optional[str] = None) -> str:
+    """Resolve the ``auto`` histogram impl for a backend platform (the
+    single source of truth — bench reporting uses it too)."""
+    if impl != "auto":
+        return impl
+    platform = jax.default_backend() if platform is None else platform
+    return "pallas" if platform == "tpu" else "segment"
+
+
 def histogram_from_vals(
     bins: jnp.ndarray,
     vals: jnp.ndarray,
@@ -137,8 +146,7 @@ def histogram_from_vals(
     features: int = 0,
 ) -> jnp.ndarray:
     """Histogram from pre-packed (N, 3) channel values."""
-    if impl == "auto":
-        impl = "pallas" if jax.default_backend() == "tpu" else "segment"
+    impl = resolve_impl(impl)
     if impl in ("pallas", "flat", "flat_bf16"):
         from .pallas_histogram import histogram_flat
         if jnp.issubdtype(vals.dtype, jnp.integer):
