@@ -11,6 +11,7 @@ mapped onto the canonical name).
 from __future__ import annotations
 
 import dataclasses
+import re
 from typing import Any, Dict, List, Optional, Tuple
 
 # (name, type, default, aliases, check)
@@ -234,7 +235,15 @@ def _coerce(name: str, typ: Any, value: Any) -> Any:
         if value is None:
             return None
         if isinstance(value, str):
-            parts = [p for p in value.replace(";", ",").split(",") if p != ""]
+            if "[" in value:
+                # Bracket-grouped form (reference Config::Str2FeatureVec,
+                # e.g. interaction_constraints="[0,1],[2,3]"): each
+                # bracketed group is ONE list element — a bare comma split
+                # would shred the groups into singletons.
+                parts = re.findall(r"\[([^\]]*)\]", value)
+            else:
+                parts = [p for p in value.replace(";", ",").split(",")
+                         if p != ""]
         elif isinstance(value, (list, tuple)):
             parts = list(value)
         else:

@@ -80,3 +80,17 @@ def test_bin_dataset_respects_max_bin(rng):
     bd = bin_dataset(X, max_bin=16)
     assert bd.max_num_bins <= 16
     assert (bd.num_bins_per_feature <= 16).all()
+
+
+def test_interaction_constraints_bracket_string_parses_as_groups():
+    """The reference CLI form '[0,1],[2,3]' must parse as TWO groups, not
+    be shredded into singleton fragments on every comma (config
+    Str2FeatureVec semantics; caught by the differential harness)."""
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.sampling import FeatureSampler
+    cfg = Config({"interaction_constraints": "[0,1],[2,3,4]"})
+    fs = FeatureSampler(cfg, 6)
+    assert fs.interaction_groups == ((0, 1), (2, 3, 4))
+    # list-of-lists (python API) parses identically
+    cfg2 = Config({"interaction_constraints": [[0, 1], [2, 3, 4]]})
+    assert FeatureSampler(cfg2, 6).interaction_groups == ((0, 1), (2, 3, 4))

@@ -104,6 +104,14 @@ BASE = {"num_leaves": 31, "learning_rate": 0.1, "num_iterations": 30,
     ("binary_monotone", {"objective": "binary",
                          "monotone_constraints": "1,-1,0,0,0,0,0,0,0,0,0,0"},
      5e-3),
+    # groups keep the generator's X2*X3 interaction within one set, so
+    # both implementations can express the signal and the comparison is
+    # not dominated by how each routes around a forbidden interaction
+    ("interaction", {"objective": "binary",
+                     "interaction_constraints":
+                         "[0,1],[2,3,4,5,6,7,8,9,10,11]"}, 5e-3),
+    ("cegb", {"objective": "binary", "cegb_penalty_split": 0.05,
+              "cegb_tradeoff": 0.8}, 8e-3),
 ], ids=lambda v: v if isinstance(v, str) else "")
 def test_binary_auc_parity(case, params, tol):
     """Holdout AUC must track the genuine binary within tolerance on the
@@ -355,3 +363,21 @@ def test_leaf_and_contrib_prediction_parity():
     our_contrib = ours.predict(Xva, pred_contrib=True)
     np.testing.assert_allclose(our_contrib, ref_contrib,
                                rtol=1e-5, atol=1e-6)
+
+
+def test_forced_splits_parity(tmp_path):
+    """forcedsplits_filename pins the tree's top splits on both sides; the
+    forced structure plus learned remainder must match in quality."""
+    import json as _json
+    spec = {"feature": 0, "threshold": 0.0,
+            "left": {"feature": 1, "threshold": -0.5}}
+    fs = tmp_path / "forced.json"
+    fs.write_text(_json.dumps(spec))
+    full = dict(BASE, objective="binary", forcedsplits_filename=str(fs))
+    X, y = _data("binary")
+    yva = y[N_TRAIN:]
+    ref_auc = _auc(yva, _run_reference(X, y, full, X[N_TRAIN:]), None, None)
+    ours = _run_ours(X, y, full)
+    our_auc = _auc(yva, ours.predict(X[N_TRAIN:], raw_score=True),
+                   None, None)
+    assert abs(our_auc - ref_auc) < 5e-3, (our_auc, ref_auc)
