@@ -126,7 +126,10 @@ def dataset_create_from_file(filename, params, reference):
     p = _parse_params(params)
     X, y, weight, group = load_data_file(
         filename, label_column=p.get("label_column", p.get("label", "")),
-        header=str(p.get("header", "false")).lower() in ("true", "1"))
+        header=str(p.get("header", "false")).lower() in ("true", "1"),
+        weight_column=str(p.get("weight_column", p.get("weight", ""))),
+        group_column=str(p.get("group_column", p.get("group", ""))),
+        ignore_column=str(p.get("ignore_column", "")))
     ref = reference.dataset if reference is not None else None
     ds = Dataset(X, label=y, weight=weight, group=group, params=p,
                  reference=ref)
@@ -609,8 +612,14 @@ def booster_predict_for_file(handle, data_filename, data_has_header,
                              params, result_filename):
     from ..io.parser import load_data_file
 
-    X, _y, _w, _g = load_data_file(data_filename,
-                                   header=bool(data_has_header))
+    p = _parse_params(params)
+    # the predict matrix must drop the same in-data columns training did
+    X, _y, _w, _g = load_data_file(
+        data_filename, header=bool(data_has_header),
+        label_column=str(p.get("label_column", p.get("label", ""))),
+        weight_column=str(p.get("weight_column", p.get("weight", ""))),
+        group_column=str(p.get("group_column", p.get("group", ""))),
+        ignore_column=str(p.get("ignore_column", "")))
     raw, size = booster_predict_for_mat(
         handle, memoryview(np.ascontiguousarray(X, np.float64)),
         C_API_DTYPE_FLOAT64, X.shape[0], X.shape[1], 1, predict_type,

@@ -68,6 +68,11 @@ def run(argv: List[str]) -> int:
         if is_binary_dataset_file(data_path):
             ds = Dataset(data_path, params=params)
         elif cfg.two_round:
+            if cfg.weight_column or cfg.group_column or cfg.ignore_column:
+                Log.fatal(
+                    "two_round does not support in-data weight/group/"
+                    "ignore column specs; use <data>.weight/<data>.query "
+                    "side files or two_round=false")
             # two-round streaming load (reference two_round=true): never
             # materializes the raw f64 matrix
             from .dataset import load_train_data_two_round
@@ -76,8 +81,11 @@ def run(argv: List[str]) -> int:
                          params=params)
             ds._train_data = td
         else:
-            X, y, w, g = load_data_file(data_path, cfg.label_column,
-                                        cfg.header)
+            X, y, w, g = load_data_file(
+                data_path, cfg.label_column, cfg.header,
+                weight_column=cfg.weight_column,
+                group_column=cfg.group_column,
+                ignore_column=cfg.ignore_column)
             ds = Dataset(X, label=y, weight=w, group=g, params=params)
         if task == "save_binary" or cfg.save_binary:
             # reference application task=save_binary / save_binary=true:
@@ -97,7 +105,11 @@ def run(argv: List[str]) -> int:
         valid_sets, valid_names = [], []
         valid = params.pop("valid", params.pop("valid_data", ""))
         for i, vp in enumerate(p for p in valid.split(",") if p):
-            Xv, yv, wv, gv = load_data_file(vp, cfg.label_column, cfg.header)
+            Xv, yv, wv, gv = load_data_file(
+                vp, cfg.label_column, cfg.header,
+                weight_column=cfg.weight_column,
+                group_column=cfg.group_column,
+                ignore_column=cfg.ignore_column)
             valid_sets.append(Dataset(Xv, label=yv, weight=wv, group=gv,
                                       reference=ds, params=params))
             valid_names.append(f"valid_{i}")
@@ -128,7 +140,11 @@ def run(argv: List[str]) -> int:
         if not data_path:
             Log.fatal("task=predict requires data=<file>")
         bst = Booster(model_file=model_path)
-        X, _, _, _ = load_data_file(data_path, cfg.label_column, cfg.header)
+        # predict data must drop the same in-data columns training dropped
+        X, _, _, _ = load_data_file(
+            data_path, cfg.label_column, cfg.header,
+            weight_column=cfg.weight_column, group_column=cfg.group_column,
+            ignore_column=cfg.ignore_column)
         pred = bst.predict(
             X, raw_score=cfg.predict_raw_score,
             start_iteration=cfg.start_iteration_predict,
@@ -157,7 +173,10 @@ def run(argv: List[str]) -> int:
         data_path = params.get("data")
         if not data_path:
             Log.fatal("task=refit requires data=<file>")
-        X, y, w, g = load_data_file(data_path, cfg.label_column, cfg.header)
+        X, y, w, g = load_data_file(
+            data_path, cfg.label_column, cfg.header,
+            weight_column=cfg.weight_column, group_column=cfg.group_column,
+            ignore_column=cfg.ignore_column)
         new_bst = Booster(model_file=model_path).refit(
             X, y, decay_rate=cfg.refit_decay_rate, weight=w, group=g)
         out = cfg.output_model or "LightGBM_model.txt"

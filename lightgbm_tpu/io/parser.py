@@ -59,32 +59,107 @@ def load_data_file(
     label_column: str = "",
     header: bool = False,
     num_features: Optional[int] = None,
+    weight_column: str = "",
+    group_column: str = "",
+    ignore_column: str = "",
 ) -> Tuple[np.ndarray, np.ndarray, Optional[np.ndarray], Optional[np.ndarray]]:
-    """Returns (X, y, weight, group).  Weight/group come from ``<path>.weight``
-    and ``<path>.query`` side files when present (reference metadata.cpp)."""
+    """Returns (X, y, weight, group).
+
+    ``weight_column`` / ``group_column`` / ``ignore_column`` follow the
+    reference's in-data column specs (docs/Parameters.rst: integer indices
+    do NOT count the label column; ``name:<col>`` uses the header; the
+    group column carries per-row query ids over grouped data).  Absent
+    column specs, weight/group come from ``<path>.weight`` /
+    ``<path>.query`` side files (reference metadata.cpp)."""
     from .. import native
 
+    X = y = None
     if native.available():
         res = native.parse_file(path, header=header,
                                 label_column=label_column,
                                 num_features=num_features or 0)
         if res is not None:
             X, y = res
-            return (X, y) + _side_files(path)
-    with open(path) as fh:
-        lines = fh.read().splitlines()
-    start = 1 if header else 0
-    fmt, sep, label_idx = _resolve_format_and_label(lines[:11], label_column,
-                                                    header)
-    if fmt == "libsvm":
-        X, y = _parse_libsvm(lines[start:], num_features)
-    else:
-        data = np.asarray(
-            [[_atof(v) for v in line.split(sep)]
-             for line in lines[start:] if line.strip()])
-        y = data[:, label_idx]
-        X = np.delete(data, label_idx, axis=1)
-    return (X, y) + _side_files(path)
+    if X is None:
+        with open(path) as fh:
+            lines = fh.read().splitlines()
+        start = 1 if header else 0
+        fmt, sep, label_idx = _resolve_format_and_label(
+            lines[:11], label_column, header)
+        if fmt == "libsvm":
+            X, y = _parse_libsvm(lines[start:], num_features)
+        else:
+            data = np.asarray(
+                [[_atof(v) for v in line.split(sep)]
+                 for line in lines[start:] if line.strip()])
+            y = data[:, label_idx]
+            X = np.delete(data, label_idx, axis=1)
+    X, weight, group = _apply_column_specs(
+        X, path, header, label_column, weight_column, group_column,
+        ignore_column)
+    # side files load independently (reference metadata.cpp); an in-data
+    # column wins only for its own field
+    sw, sg = _side_files(path)
+    return X, y, weight if weight is not None else sw, \
+        group if group is not None else sg
+
+
+def _apply_column_specs(X, path, header, label_column, weight_column,
+                        group_column, ignore_column):
+    """Extract in-data weight/query columns and drop ignored columns
+    (reference semantics: integer indices do NOT count the label column;
+    ``name:`` specs resolve against the header, read once)."""
+    if not (weight_column or group_column or ignore_column):
+        return X, None, None
+    specs = [str(weight_column), str(group_column), str(ignore_column)]
+    names = label_idx = None
+    if any(sp.startswith("name:") for sp in specs):
+        if not header:
+            raise ValueError("name: column specs need header=true")
+        with open(path) as fh:
+            first = fh.readline().rstrip("\n")
+        sep = "\t" if "\t" in first else ","
+        names = [c.strip() for c in first.split(sep)]
+        lc = str(label_column)
+        label_idx = (names.index(lc[5:]) if lc.startswith("name:")
+                     else int(lc) if lc else 0)
+
+    def to_idx(spec):
+        spec = spec.strip()
+        if not spec.startswith("name:"):
+            return int(spec)
+        fidx = names.index(spec[5:])
+        if fidx == label_idx:
+            raise ValueError(f"{spec!r} is the label column")
+        return fidx - (1 if fidx > label_idx else 0)
+
+    weight = group = None
+    drop = []
+    if weight_column:
+        wi = to_idx(str(weight_column))
+        weight = X[:, wi].copy()
+        drop.append(wi)
+    if group_column:
+        gi = to_idx(str(group_column))
+        qid = X[:, gi]
+        drop.append(gi)
+        # per-row query ids over grouped data -> group sizes (reference
+        # metadata.cpp query-id run-length conversion)
+        if len(qid):
+            boundaries = np.flatnonzero(np.diff(qid)) + 1
+            bounds = np.concatenate([[0], boundaries, [len(qid)]])
+            group = np.diff(bounds).astype(np.int64)
+    if ignore_column:
+        ic = str(ignore_column)
+        if ic.startswith("name:"):
+            # name: prefix applies once, then comma-separated names
+            # (reference docs/Parameters.rst ignore_column)
+            drop.extend(to_idx(f"name:{nm.strip()}")
+                        for nm in ic[5:].split(",") if nm.strip())
+        else:
+            drop.extend(int(tok) for tok in ic.replace(";", ",").split(",")
+                        if tok.strip())
+    return np.delete(X, sorted(set(drop)), axis=1), weight, group
 
 
 def _side_files(path: str):

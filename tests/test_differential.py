@@ -381,3 +381,51 @@ def test_forced_splits_parity(tmp_path):
     our_auc = _auc(yva, ours.predict(X[N_TRAIN:], raw_score=True),
                    None, None)
     assert abs(our_auc - ref_auc) < 5e-3, (our_auc, ref_auc)
+
+
+def test_weight_column_cli_parity(tmp_path):
+    """weight_column=<idx> in-data weights through BOTH CLIs: ours and the
+    genuine binary must produce matching weighted-AUC on the holdout."""
+    import subprocess as sp
+    X, y = _data("binary")
+    rng = np.random.RandomState(5)
+    w = np.exp(rng.randn(len(y)) * 0.5)
+    yva, wva = y[N_TRAIN:], w[N_TRAIN:]
+    full = dict(BASE, objective="binary", weight_column="0")
+
+    def run_cli(cmd_prefix, out_model):
+        tr = tmp_path / f"{out_model}_tr.csv"
+        va = tmp_path / f"{out_model}_va.csv"
+        # file columns: label, weight, features  (weight_column=0 in
+        # X-space = first post-label column)
+        np.savetxt(tr, np.column_stack([y[:N_TRAIN], w[:N_TRAIN],
+                                        X[:N_TRAIN]]),
+                   delimiter=",", fmt="%.17g")
+        np.savetxt(va, np.column_stack([np.zeros(N_VALID), w[N_TRAIN:],
+                                        X[N_TRAIN:]]),
+                   delimiter=",", fmt="%.17g")
+        conf = tmp_path / f"{out_model}.conf"
+        conf.write_text("".join(f"{k} = {v}\n" for k, v in full.items())
+                        + f"data = {tr}\noutput_model = "
+                        f"{tmp_path}/{out_model}.txt\n")
+        env = dict(os.environ, LIGHTGBM_TPU_PLATFORM="cpu")
+        r = sp.run([*cmd_prefix, f"config={conf}"], capture_output=True,
+                   text=True, env=env)
+        assert r.returncode == 0, r.stderr[-1500:]
+        pconf = tmp_path / f"{out_model}_p.conf"
+        pconf.write_text(
+            f"task = predict\ndata = {va}\ninput_model = "
+            f"{tmp_path}/{out_model}.txt\noutput_result = "
+            f"{tmp_path}/{out_model}_p.txt\npredict_raw_score = true\n"
+            f"weight_column = 0\nlabel_column = 0\n")
+        r = sp.run([*cmd_prefix, f"config={pconf}"], capture_output=True,
+                   text=True, env=env)
+        assert r.returncode == 0, r.stderr[-1500:]
+        return np.loadtxt(f"{tmp_path}/{out_model}_p.txt")
+
+    ref_raw = run_cli([BIN], "ref")
+    import sys
+    ours_raw = run_cli([sys.executable, "-m", "lightgbm_tpu"], "ours")
+    ref_auc = _auc(yva, ref_raw, wva, None)
+    our_auc = _auc(yva, ours_raw, wva, None)
+    assert abs(our_auc - ref_auc) < 5e-3, (our_auc, ref_auc)

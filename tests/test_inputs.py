@@ -221,3 +221,72 @@ def test_dataset_subset_and_add_features():
     assert ds2.num_feature() == 6
     td = ds2.construct({"objective": "binary", "verbosity": -1})
     assert td.num_features == 6
+
+
+def test_in_data_column_specs(tmp_path):
+    """weight_column / group_column / ignore_column reference semantics:
+    int indices don't count the label column; name: uses the header; the
+    query column holds per-row ids; ignored columns leave the matrix."""
+    import numpy as np
+    from lightgbm_tpu.io.parser import load_data_file
+
+    rng = np.random.RandomState(0)
+    n = 40
+    y = rng.randint(0, 2, n).astype(float)
+    f0 = rng.randn(n)
+    w = rng.rand(n) + 0.5
+    qid = np.repeat(np.arange(8), 5).astype(float)
+    junk = np.full(n, 9.9)
+    f1 = rng.randn(n)
+    # file columns: label, f0, weight, qid, junk, f1
+    mat = np.column_stack([y, f0, w, qid, junk, f1])
+    path = tmp_path / "d.csv"
+    np.savetxt(path, mat, delimiter=",", fmt="%.10g",
+               header="lab,f0,wt,q,junk,f1", comments="")
+
+    X, yy, ww, gg = load_data_file(
+        str(path), label_column="0", header=True,
+        weight_column="1",     # X-space: w is file col 2 -> X col 1
+        group_column="2",      # X-space: qid is file col 3 -> X col 2
+        ignore_column="3")     # X-space: junk is file col 4 -> X col 3
+    np.testing.assert_allclose(yy, y)
+    np.testing.assert_allclose(ww, w, rtol=1e-9)
+    np.testing.assert_array_equal(gg, np.full(8, 5))
+    assert X.shape == (n, 2)
+    np.testing.assert_allclose(X[:, 0], f0, rtol=1e-9)
+    np.testing.assert_allclose(X[:, 1], f1, rtol=1e-9)
+
+    # name: form resolves through the header identically
+    X2, _, ww2, gg2 = load_data_file(
+        str(path), label_column="name:lab", header=True,
+        weight_column="name:wt", group_column="name:q",
+        ignore_column="name:junk")
+    np.testing.assert_allclose(ww2, w, rtol=1e-9)
+    np.testing.assert_array_equal(gg2, np.full(8, 5))
+    np.testing.assert_allclose(X2, X, rtol=1e-9)
+
+
+def test_column_specs_tsv_and_sidefile_independence(tmp_path):
+    """name: specs must work on TSV headers, and a .query side file loads
+    even when weight comes from an in-data column (independent fields,
+    reference metadata.cpp)."""
+    import numpy as np
+    from lightgbm_tpu.io.parser import load_data_file
+
+    rng = np.random.RandomState(1)
+    n = 20
+    y = rng.randint(0, 2, n).astype(float)
+    f0 = rng.randn(n)
+    w = rng.rand(n) + 0.5
+    mat = np.column_stack([y, f0, w])
+    path = tmp_path / "d.tsv"
+    np.savetxt(path, mat, delimiter="\t", fmt="%.10g",
+               header="lab\tf0\twt", comments="")
+    np.savetxt(str(path) + ".query", np.array([5, 5, 10]), fmt="%d")
+
+    X, yy, ww, gg = load_data_file(str(path), label_column="name:lab",
+                                   header=True, weight_column="name:wt")
+    np.testing.assert_allclose(ww, w, rtol=1e-9)
+    np.testing.assert_array_equal(gg, [5, 5, 10])   # side file still loads
+    assert X.shape == (n, 1)
+    np.testing.assert_allclose(X[:, 0], f0, rtol=1e-9)
