@@ -147,6 +147,69 @@ def _greedy_find_boundaries(
     return bounds
 
 
+def load_forced_bins(path: str, num_features: int,
+                     categorical: Sequence[int] = ()) -> Optional[dict]:
+    """Parse a forcedbins_filename JSON file into {feature: [bounds]}
+    (reference ``DatasetLoader::GetForcedBins``, dataset_loader.cpp:1493:
+    array of {"feature": i, "bin_upper_bound": [...]}; categorical
+    features are warned and skipped; missing file warns and is ignored)."""
+    if not path:
+        return None
+    import json
+    from .utils.log import Log
+    try:
+        with open(path) as fh:
+            spec = json.load(fh)
+    except OSError:
+        Log.warning(f"Could not open {path}. Will ignore.")
+        return None
+    cats = set(int(c) for c in categorical)
+    out: dict = {}
+    for entry in spec:
+        fi = int(entry["feature"])
+        if fi >= num_features:
+            raise ValueError(
+                f"forced bins feature {fi} out of range ({num_features})")
+        if fi in cats:
+            Log.warning(f"Feature {fi} is categorical. Will ignore forced "
+                        "bins for this feature.")
+            continue
+        out[fi] = [float(b) for b in entry["bin_upper_bound"]]
+    return out or None
+
+
+def _bounds_with_forced(distinct, counts, max_bins, total_cnt,
+                        min_data_in_bin, forced) -> List[float]:
+    """Bin boundaries honoring user-forced upper bounds (reference
+    ``FindBinWithPredefinedBin``, bin.cpp:157): the forced bounds become
+    boundaries first, then each segment between them gets a greedy-
+    equal-count refill proportional to its sample mass, the last segment
+    absorbing the remaining budget."""
+    forced = sorted({float(b) for b in forced if np.isfinite(b)})
+    bounds = forced[: max(max_bins - 1, 0)] + [np.inf]
+    free_bins = max_bins - len(bounds)
+    to_add: List[float] = []
+    vi = 0
+    for i, ub in enumerate(bounds):
+        seg_start = vi
+        cnt_in_bin = 0
+        while vi < len(distinct) and distinct[vi] < ub:
+            cnt_in_bin += int(counts[vi])
+            vi += 1
+        remaining = free_bins - len(to_add)
+        if i == len(bounds) - 1:
+            num_sub = remaining + 1
+        else:
+            num_sub = min(int(round(cnt_in_bin * free_bins
+                                    / max(total_cnt, 1))), remaining) + 1
+        if num_sub > 1 and vi > seg_start:
+            sub = _greedy_find_boundaries(
+                distinct[seg_start:vi], counts[seg_start:vi], num_sub,
+                cnt_in_bin, min_data_in_bin)
+            to_add.extend(sub[:-1])   # last sub-bound is +inf
+    return sorted(bounds[:-1] + to_add) + [np.inf]
+
+
 def find_bin(
     sample_values: np.ndarray,
     max_bin: int,
@@ -156,6 +219,7 @@ def find_bin(
     use_missing: bool = True,
     zero_as_missing: bool = False,
     min_data_per_category: int = 1,
+    forced_upper_bounds: Optional[Sequence[float]] = None,
 ) -> BinMapper:
     """Construct a :class:`BinMapper` from sampled values (reference ``FindBin``,
     ``bin.cpp:~150``)."""
@@ -201,14 +265,19 @@ def find_bin(
         distinct, counts = uc
     else:
         distinct, counts = np.unique(vv, return_counts=True)
-    nb = native.find_boundaries(distinct, counts, max_value_bins, len(vv),
-                                min_data_in_bin)
-    if nb is not None:
-        bounds = list(nb)
+    if forced_upper_bounds:
+        bounds = _bounds_with_forced(distinct, counts, max_value_bins,
+                                     len(vv), min_data_in_bin,
+                                     forced_upper_bounds)
     else:
-        bounds = _greedy_find_boundaries(
-            distinct, counts, max_value_bins, len(vv), min_data_in_bin
-        )
+        nb = native.find_boundaries(distinct, counts, max_value_bins,
+                                    len(vv), min_data_in_bin)
+        if nb is not None:
+            bounds = list(nb)
+        else:
+            bounds = _greedy_find_boundaries(
+                distinct, counts, max_value_bins, len(vv), min_data_in_bin
+            )
     num_bins = len(bounds) + (1 if has_nan_bin else 0)
     trivial = num_bins <= 1 or (len(distinct) <= 1 and not has_nan_bin)
     ub = np.asarray(bounds, dtype=np.float64)
@@ -238,6 +307,7 @@ def bin_dataset(
     sample_cnt: int = 200000,
     random_state: int = 1,
     max_bin_by_feature: Optional[Sequence[int]] = None,
+    forced_bins: Optional[dict] = None,
 ) -> "BinnedData":
     """Bin a full feature matrix. Sampling mirrors the reference's
     ``DatasetLoader::SampleTextDataFromFile`` (``dataset_loader.cpp:1022``): bin
@@ -287,6 +357,7 @@ def bin_dataset(
                 col, mb, min_data_in_bin,
                 is_categorical=(j in cat_set),
                 use_missing=use_missing, zero_as_missing=zero_as_missing,
+                forced_upper_bounds=(forced_bins or {}).get(j),
             )
         )
     return BinnedData.from_mappers(X, mappers)

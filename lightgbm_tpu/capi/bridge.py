@@ -1158,6 +1158,8 @@ def dataset_create_from_sampled_column(col_vals_mvs, col_idx_mvs,
     p = _parse_params(params)
     cfg = Config(dict(p))
     ncol = len(col_vals_mvs)
+    from ..binning import load_forced_bins
+    fbins = load_forced_bins(cfg.forcedbins_filename, ncol) or {}
     mappers = []
     for j in range(ncol):
         k = int(num_per_col[j])
@@ -1166,7 +1168,8 @@ def dataset_create_from_sampled_column(col_vals_mvs, col_idx_mvs,
         col[:k] = vals                        # order-invariant for find_bin
         mappers.append(find_bin(col, cfg.max_bin, cfg.min_data_in_bin,
                                 use_missing=cfg.use_missing,
-                                zero_as_missing=cfg.zero_as_missing))
+                                zero_as_missing=cfg.zero_as_missing,
+                                forced_upper_bounds=fbins.get(j)))
     max_b = max(max(m.num_bins for m in mappers), 2)
     dtype = np.uint8 if max_b <= 256 else np.uint16
     skeleton = BinnedData.from_prebinned(

@@ -75,6 +75,7 @@ class TrainData:
             binned = dataclasses.replace(
                 reference.binned, bins=reference.binned.apply(X))
         else:
+            from .binning import load_forced_bins
             binned = bin_dataset(
                 X,
                 max_bin=cfg.max_bin,
@@ -85,6 +86,9 @@ class TrainData:
                 sample_cnt=cfg.bin_construct_sample_cnt,
                 random_state=cfg.data_random_seed,
                 max_bin_by_feature=cfg.max_bin_by_feature,
+                forced_bins=load_forced_bins(cfg.forcedbins_filename,
+                                             X.shape[1],
+                                             categorical_features),
             )
         mono = None
         if cfg.monotone_constraints:
@@ -289,12 +293,15 @@ def load_train_data_two_round(path: str, cfg: Config, *,
         raise ValueError(
             f"max_bin_by_feature has {len(mbf)} entries for {max_f} "
             "features (reference requires an exact match)")
+    from .binning import load_forced_bins
+    fbins = load_forced_bins(cfg.forcedbins_filename, max_f, cats) or {}
     mappers = [find_bin(sample[:, j],
                         int(mbf[j]) if mbf is not None else cfg.max_bin,
                         cfg.min_data_in_bin,
                         is_categorical=(j in set(cats)),
                         use_missing=cfg.use_missing,
-                        zero_as_missing=cfg.zero_as_missing)
+                        zero_as_missing=cfg.zero_as_missing,
+                        forced_upper_bounds=fbins.get(j))
                for j in range(max_f)]
     del sample, reservoir
     max_b = max(max(m.num_bins for m in mappers), 2)

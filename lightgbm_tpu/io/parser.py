@@ -74,6 +74,7 @@ def load_data_file(
     from .. import native
 
     X = y = None
+    header_line = None
     if native.available():
         res = native.parse_file(path, header=header,
                                 label_column=label_column,
@@ -83,6 +84,8 @@ def load_data_file(
     if X is None:
         with open(path) as fh:
             lines = fh.read().splitlines()
+        if header and lines:
+            header_line = lines[0]
         start = 1 if header else 0
         fmt, sep, label_idx = _resolve_format_and_label(
             lines[:11], label_column, header)
@@ -96,7 +99,7 @@ def load_data_file(
             X = np.delete(data, label_idx, axis=1)
     X, weight, group = _apply_column_specs(
         X, path, header, label_column, weight_column, group_column,
-        ignore_column)
+        ignore_column, header_line=header_line)
     # side files load independently (reference metadata.cpp); an in-data
     # column wins only for its own field
     sw, sg = _side_files(path)
@@ -105,10 +108,10 @@ def load_data_file(
 
 
 def _apply_column_specs(X, path, header, label_column, weight_column,
-                        group_column, ignore_column):
+                        group_column, ignore_column, header_line=None):
     """Extract in-data weight/query columns and drop ignored columns
     (reference semantics: integer indices do NOT count the label column;
-    ``name:`` specs resolve against the header, read once)."""
+    ``name:`` specs resolve against the header, read at most once)."""
     if not (weight_column or group_column or ignore_column):
         return X, None, None
     specs = [str(weight_column), str(group_column), str(ignore_column)]
@@ -116,13 +119,22 @@ def _apply_column_specs(X, path, header, label_column, weight_column,
     if any(sp.startswith("name:") for sp in specs):
         if not header:
             raise ValueError("name: column specs need header=true")
-        with open(path) as fh:
-            first = fh.readline().rstrip("\n")
+        if header_line is None:      # native fast path skipped the read
+            with open(path) as fh:
+                header_line = fh.readline().rstrip("\n")
+        first = header_line
         sep = "\t" if "\t" in first else ","
         names = [c.strip() for c in first.split(sep)]
         lc = str(label_column)
-        label_idx = (names.index(lc[5:]) if lc.startswith("name:")
-                     else int(lc) if lc else 0)
+        if lc.startswith("name:"):
+            label_idx = names.index(lc[5:])
+        else:
+            # same tolerance as _resolve_format_and_label: a bare
+            # non-numeric label spec falls back to column 0
+            try:
+                label_idx = int(lc) if lc else 0
+            except ValueError:
+                label_idx = 0
 
     def to_idx(spec):
         spec = spec.strip()

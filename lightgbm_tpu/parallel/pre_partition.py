@@ -84,7 +84,8 @@ def _mappers_from_fixed(d: dict) -> List[BinMapper]:
 def sync_bin_mappers(X_local: np.ndarray, *, max_bin: int = 255,
                      min_data_in_bin: int = 3,
                      categorical_features: Sequence[int] = (),
-                     sample_cnt: int = 200000) -> List[BinMapper]:
+                     sample_cnt: int = 200000,
+                     forced_bins=None) -> List[BinMapper]:
     """Feature-partitioned mapper construction + allgather.
 
     Every rank calls this with ITS local rows; all ranks return the SAME
@@ -97,7 +98,7 @@ def sync_bin_mappers(X_local: np.ndarray, *, max_bin: int = 255,
     local = bin_dataset(np.asarray(X_local), max_bin=max_bin,
                         min_data_in_bin=min_data_in_bin,
                         categorical_features=categorical_features,
-                        sample_cnt=sample_cnt)
+                        sample_cnt=sample_cnt, forced_bins=forced_bins)
     if jax.process_count() <= 1:
         return local.mappers
     from jax.experimental import multihost_utils

@@ -94,3 +94,35 @@ def test_interaction_constraints_bracket_string_parses_as_groups():
     # list-of-lists (python API) parses identically
     cfg2 = Config({"interaction_constraints": [[0, 1], [2, 3, 4]]})
     assert FeatureSampler(cfg2, 6).interaction_groups == ((0, 1), (2, 3, 4))
+
+
+def test_forced_bins(tmp_path):
+    """forcedbins_filename pins user bounds as bin boundaries (reference
+    FindBinWithPredefinedBin + GetForcedBins): forced bounds appear
+    exactly; remaining budget refills by equal count; categorical features
+    warn and ignore; trees then split exactly at forced bounds."""
+    import json
+    import numpy as np
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.binning import find_bin
+
+    rng = np.random.RandomState(0)
+    v = rng.randn(5000)
+    m = find_bin(v, 16, 1, forced_upper_bounds=[-0.5, 0.5])
+    ub = np.asarray(m.upper_bounds)
+    assert np.isclose(ub, -0.5).any() and np.isclose(ub, 0.5).any()
+    assert len(ub) <= 16
+
+    # end to end: a forced boundary becomes an exact split threshold
+    spec = [{"feature": 0, "bin_upper_bound": [0.123]}]
+    path = tmp_path / "fb.json"
+    path.write_text(json.dumps(spec))
+    X = rng.randn(3000, 3)
+    y = (X[:, 0] > 0.123).astype(float)
+    bst = lgb.train({"objective": "binary", "num_leaves": 3,
+                     "verbosity": -1, "forcedbins_filename": str(path)},
+                    lgb.Dataset(X, label=y), 5)
+    model = bst.model_to_string()
+    assert "0.123" in model   # the forced bound is a real threshold
+    pred = bst.predict(X)
+    assert ((pred > 0.5) == (y > 0.5)).mean() > 0.99
