@@ -81,12 +81,14 @@ def run(argv: List[str]) -> int:
                          params=params)
             ds._train_data = td
         else:
-            X, y, w, g = load_data_file(
+            X, y, w, g, names = load_data_file(
                 data_path, cfg.label_column, cfg.header,
                 weight_column=cfg.weight_column,
                 group_column=cfg.group_column,
-                ignore_column=cfg.ignore_column)
-            ds = Dataset(X, label=y, weight=w, group=g, params=params)
+                ignore_column=cfg.ignore_column,
+                with_feature_names=True)
+            ds = Dataset(X, label=y, weight=w, group=g, params=params,
+                         feature_name=names or "auto")
         if task == "save_binary" or cfg.save_binary:
             # reference application task=save_binary / save_binary=true:
             # write "<data>.bin" next to the input and, for the standalone

@@ -245,10 +245,14 @@ def load_train_data_two_round(path: str, cfg: Config, *,
     matrix never materializes.
     """
     from .binning import BinnedData, find_bin
-    from .io.parser import _side_files, iter_file_blocks
+    from .io.parser import _resolve_header, _side_files, iter_file_blocks
 
     sample_cnt = cfg.bin_construct_sample_cnt
     rng = np.random.RandomState(cfg.data_random_seed)
+    header_names = None
+    if cfg.header:
+        cols, li, _ = _resolve_header(path, cfg.label_column)
+        header_names = [c for i, c in enumerate(cols) if i != li]
 
     # ---- pass 1: count rows, collect labels + a uniform reservoir sample
     n_total = 0
@@ -331,6 +335,9 @@ def load_train_data_two_round(path: str, cfg: Config, *,
         weight=None if weight is None else np.asarray(weight, np.float32),
         group=None if group is None else np.asarray(group, np.int64),
         monotone_constraints=mono,
+        feature_names=(header_names
+                       if header_names and len(header_names) == max_f
+                       else None),
     )
     td._two_round_loaded = True
     return td

@@ -62,8 +62,11 @@ def load_data_file(
     weight_column: str = "",
     group_column: str = "",
     ignore_column: str = "",
+    with_feature_names: bool = False,
 ) -> Tuple[np.ndarray, np.ndarray, Optional[np.ndarray], Optional[np.ndarray]]:
-    """Returns (X, y, weight, group).
+    """Returns (X, y, weight, group) — plus the feature-name list (header
+    minus label/extracted columns, None without a header) when
+    ``with_feature_names`` is set.
 
     ``weight_column`` / ``group_column`` / ``ignore_column`` follow the
     reference's in-data column specs (docs/Parameters.rst: integer indices
@@ -97,14 +100,45 @@ def load_data_file(
                  for line in lines[start:] if line.strip()])
             y = data[:, label_idx]
             X = np.delete(data, label_idx, axis=1)
-    X, weight, group = _apply_column_specs(
+    X, weight, group, dropped = _apply_column_specs(
         X, path, header, label_column, weight_column, group_column,
         ignore_column, header_line=header_line)
     # side files load independently (reference metadata.cpp); an in-data
     # column wins only for its own field
     sw, sg = _side_files(path)
-    return X, y, weight if weight is not None else sw, \
-        group if group is not None else sg
+    out = (X, y, weight if weight is not None else sw,
+           group if group is not None else sg)
+    if not with_feature_names:
+        return out
+    names = None
+    if header:
+        cols, label_idx, _ = _resolve_header(path, label_column,
+                                             header_line)
+        names = [c for i, c in enumerate(cols) if i != label_idx]
+        names = [c for i, c in enumerate(names) if i not in dropped]
+        if len(names) != X.shape[1]:
+            names = None              # header malformed; fall back to auto
+    return out + (names,)
+
+
+def _resolve_header(path, label_column, header_line=None):
+    """(names, label_idx, sep) from the header line, read at most once.
+    Label tolerance matches _resolve_format_and_label: bare non-numeric
+    specs fall back to column 0."""
+    if header_line is None:
+        with open(path) as fh:
+            header_line = fh.readline().rstrip("\n")
+    sep = "\t" if "\t" in header_line else ","
+    names = [c.strip() for c in header_line.split(sep)]
+    lc = str(label_column)
+    if lc.startswith("name:") and lc[5:] in names:
+        label_idx = names.index(lc[5:])
+    else:
+        try:
+            label_idx = int(lc) if lc else 0
+        except ValueError:
+            label_idx = 0
+    return names, label_idx, sep
 
 
 def _apply_column_specs(X, path, header, label_column, weight_column,
@@ -113,28 +147,14 @@ def _apply_column_specs(X, path, header, label_column, weight_column,
     (reference semantics: integer indices do NOT count the label column;
     ``name:`` specs resolve against the header, read at most once)."""
     if not (weight_column or group_column or ignore_column):
-        return X, None, None
+        return X, None, None, set()
     specs = [str(weight_column), str(group_column), str(ignore_column)]
     names = label_idx = None
     if any(sp.startswith("name:") for sp in specs):
         if not header:
             raise ValueError("name: column specs need header=true")
-        if header_line is None:      # native fast path skipped the read
-            with open(path) as fh:
-                header_line = fh.readline().rstrip("\n")
-        first = header_line
-        sep = "\t" if "\t" in first else ","
-        names = [c.strip() for c in first.split(sep)]
-        lc = str(label_column)
-        if lc.startswith("name:"):
-            label_idx = names.index(lc[5:])
-        else:
-            # same tolerance as _resolve_format_and_label: a bare
-            # non-numeric label spec falls back to column 0
-            try:
-                label_idx = int(lc) if lc else 0
-            except ValueError:
-                label_idx = 0
+        names, label_idx, _ = _resolve_header(path, label_column,
+                                              header_line)
 
     def to_idx(spec):
         spec = spec.strip()
@@ -171,7 +191,8 @@ def _apply_column_specs(X, path, header, label_column, weight_column,
         else:
             drop.extend(int(tok) for tok in ic.replace(";", ",").split(",")
                         if tok.strip())
-    return np.delete(X, sorted(set(drop)), axis=1), weight, group
+    drop = set(drop)
+    return np.delete(X, sorted(drop), axis=1), weight, group, drop
 
 
 def _side_files(path: str):

@@ -159,6 +159,10 @@ class GBDT:
                     f"{pname} has no effect on the TPU build: bins are "
                     "stored as one dense (rows, features) device array and "
                     "sparse columns are handled by EFB (enable_bundle)")
+        if cfg.parser_config_file:
+            Log.warning(
+                "parser_config_file (pluggable custom parsers) is not "
+                "supported; the built-in CSV/TSV/LibSVM parsers are used")
         if (cfg.two_round
                 and not getattr(train, "_two_round_loaded", False)):
             Log.warning(

@@ -290,3 +290,28 @@ def test_column_specs_tsv_and_sidefile_independence(tmp_path):
     np.testing.assert_array_equal(gg, [5, 5, 10])   # side file still loads
     assert X.shape == (n, 1)
     np.testing.assert_allclose(X[:, 0], f0, rtol=1e-9)
+
+
+def test_header_names_propagate_to_model(tmp_path):
+    """CSV header names must survive into the saved model's feature_names
+    (reference DatasetLoader reads them from the header), accounting for
+    extracted weight columns."""
+    import subprocess, sys, os
+    import numpy as np
+    rng = np.random.RandomState(0)
+    n = 200
+    mat = np.column_stack([rng.randint(0, 2, n), rng.rand(n) + 0.5,
+                           rng.randn(n), rng.randn(n)])
+    path = tmp_path / "d.csv"
+    np.savetxt(path, mat, delimiter=",", fmt="%.8g",
+               header="lab,wt,alpha,beta", comments="")
+    out = tmp_path / "m.txt"
+    env = dict(os.environ, LIGHTGBM_TPU_PLATFORM="cpu")
+    r = subprocess.run(
+        [sys.executable, "-m", "lightgbm_tpu", "task=train",
+         "objective=binary", "header=true", f"data={path}",
+         "weight_column=0", "num_iterations=2", "num_leaves=4",
+         f"output_model={out}"], capture_output=True, text=True, env=env)
+    assert r.returncode == 0, r.stderr[-1500:]
+    model = out.read_text()
+    assert "feature_names=alpha beta" in model
