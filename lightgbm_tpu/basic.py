@@ -165,14 +165,21 @@ class Dataset:
         free_raw_data: bool = False,
     ):
         self._binary_path = None
+        self._text_path = None
         if isinstance(data, str):
-            # Binary dataset cache (reference Dataset(path) +
-            # CheckCanLoadFromBin, dataset_loader.cpp:1466).
+            # Binary cache fast path (reference Dataset(path) +
+            # CheckCanLoadFromBin, dataset_loader.cpp:1466); any other
+            # path is a CSV/TSV/LibSVM text file, loaded with the params'
+            # column specs like the reference python package delegates to
+            # DatasetLoader.
             from .dataset import is_binary_dataset_file
-            if not is_binary_dataset_file(data):
-                raise ValueError(f"{data!r} is not a lightgbm_tpu binary "
-                                 "dataset file (see Dataset.save_binary)")
-            self._binary_path = data
+            if is_binary_dataset_file(data):
+                self._binary_path = data
+            else:
+                # Text file: defer the parse to construct() so params
+                # passed to train() (header, label/column specs) apply,
+                # like the binary path and the reference's lazy loader.
+                self._text_path = data
             data = np.zeros((0, 0))
         df = _pandas_df(data)
         if df is not None:
@@ -218,6 +225,27 @@ class Dataset:
             self.label = self._train_data.label
             self.weight = self._train_data.weight
             self.group = self._train_data.group
+        if self._train_data is None and self._text_path is not None:
+            from .io.parser import load_data_file
+            merged0 = dict(self.params)
+            merged0.update(params or {})
+            cfg0 = Config(merged0)
+            X, fy, fw, fg, names = load_data_file(
+                self._text_path, cfg0.label_column, cfg0.header,
+                weight_column=cfg0.weight_column,
+                group_column=cfg0.group_column,
+                ignore_column=cfg0.ignore_column,
+                with_feature_names=True)
+            self.data = X
+            self._text_path = None
+            if self.label is None:
+                self.label = fy
+            if self.weight is None:
+                self.weight = fw
+            if self.group is None:
+                self.group = fg
+            if self.feature_name == "auto" and names:
+                self.feature_name = names
         if self._train_data is None:
             merged = dict(self.params)
             merged.update(params or {})
@@ -229,16 +257,30 @@ class Dataset:
                     cat_param = merged.pop(key)
             cfg = Config(merged)
             cats: TypingSequence[int] = ()
+            # The constructor arg wins whenever given (list OR string —
+            # a bare/name: string used to be silently dropped); "auto"
+            # defers to the params key.
             cat_spec = (self.categorical_feature
-                        if isinstance(self.categorical_feature, (list, tuple))
+                        if not (isinstance(self.categorical_feature, str)
+                                and self.categorical_feature == "auto")
                         else cat_param)
             if cat_spec == "auto":
                 cat_spec = None
+            force_names = False
             if isinstance(cat_spec, str) and cat_spec:
-                cat_spec = cat_spec.split(",")
+                if cat_spec.startswith("name:"):
+                    # reference form: the prefix applies once to the whole
+                    # comma-separated name list, and declares every token
+                    # a NAME even if it looks numeric
+                    cat_spec = cat_spec[5:]
+                    force_names = True
+                cat_spec = [t.strip() for t in cat_spec.split(",")
+                            if t.strip()]
             if isinstance(cat_spec, (list, tuple)):
                 names = self._feature_names()
-                cats = [int(c) if not isinstance(c, str) or c.lstrip("-").isdigit()
+                cats = [names.index(c) if force_names
+                        else int(c) if not isinstance(c, str)
+                        or c.lstrip("-").isdigit()
                         else names.index(c) for c in cat_spec]
             elif cfg.categorical_feature:
                 cats = [int(c) for c in cfg.categorical_feature.split(",")]

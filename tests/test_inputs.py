@@ -315,3 +315,48 @@ def test_header_names_propagate_to_model(tmp_path):
     assert r.returncode == 0, r.stderr[-1500:]
     model = out.read_text()
     assert "feature_names=alpha beta" in model
+
+
+def test_dataset_accepts_text_file_path(tmp_path):
+    """lgb.Dataset('train.csv') must load text files like the reference
+    python package (binary caches remain the fast path), honoring header
+    names and params column specs."""
+    import numpy as np
+    import lightgbm_tpu as lgb
+    rng = np.random.RandomState(0)
+    n = 500
+    Xf = rng.randn(n, 3)
+    y = (Xf[:, 0] > 0).astype(float)
+    path = tmp_path / "tr.csv"
+    np.savetxt(path, np.column_stack([y, Xf]), delimiter=",", fmt="%.8g",
+               header="lab,a,b,c", comments="")
+    ds = lgb.Dataset(str(path), params={"header": True})
+    bst = lgb.train({"objective": "binary", "verbosity": -1,
+                     "num_leaves": 7, "header": True}, ds, 5)
+    assert bst.feature_name() == ["a", "b", "c"]
+    acc = ((bst.predict(Xf) > 0.5) == (y > 0.5)).mean()
+    assert acc > 0.95
+
+
+def test_categorical_feature_name_prefix(tmp_path):
+    """categorical_feature='name:c1,c2' (reference form: one prefix for
+    the whole list) resolves through feature names."""
+    import numpy as np
+    import lightgbm_tpu as lgb
+    rng = np.random.RandomState(0)
+    n = 600
+    cat = rng.randint(0, 6, n).astype(float)
+    num = rng.randn(n)
+    y = (np.isin(cat, [1, 4]) ^ (num > 0)).astype(float)
+    X = np.column_stack([cat, num])
+    ds = lgb.Dataset(X, label=y, feature_name=["kind", "score"],
+                     categorical_feature="name:kind")
+    bst = lgb.train({"objective": "binary", "verbosity": -1,
+                     "num_leaves": 7}, ds, 15)
+    model = bst.model_to_string()
+    # trees record categorical split counts in num_cat (reference
+    # gbdt_model_text format)
+    assert any(line.startswith("num_cat=") and set(line[8:].split()) != {"0"}
+               for line in model.splitlines())
+    acc = ((bst.predict(X) > 0.5) == (y > 0.5)).mean()
+    assert acc > 0.9
