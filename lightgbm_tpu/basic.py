@@ -172,10 +172,21 @@ class Dataset:
             # path is a CSV/TSV/LibSVM text file, loaded with the params'
             # column specs like the reference python package delegates to
             # DatasetLoader.
+            import os as _os
             from .dataset import is_binary_dataset_file
+            if not _os.path.exists(data):
+                raise FileNotFoundError(f"no such data file: {data!r}")
             if is_binary_dataset_file(data):
                 self._binary_path = data
             else:
+                with open(data, "rb") as fh:
+                    magic = fh.read(2)
+                if magic == b"PK":
+                    # zip container that failed binary validation: a
+                    # truncated/corrupt cache must not be parsed as text
+                    raise ValueError(
+                        f"{data!r} looks like a corrupt lightgbm_tpu "
+                        "binary dataset file")
                 # Text file: defer the parse to construct() so params
                 # passed to train() (header, label/column specs) apply,
                 # like the binary path and the reference's lazy loader.
@@ -219,6 +230,11 @@ class Dataset:
         self.free_raw_data = free_raw_data
         self._train_data: Optional[TrainData] = None
 
+    def _merged_params(self, params: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+        merged = dict(self.params)
+        merged.update(params or {})
+        return merged
+
     def construct(self, params: Optional[Dict[str, Any]] = None) -> "TrainData":
         if self._train_data is None and self._binary_path is not None:
             self._train_data = TrainData.load_binary(self._binary_path)
@@ -227,9 +243,7 @@ class Dataset:
             self.group = self._train_data.group
         if self._train_data is None and self._text_path is not None:
             from .io.parser import load_data_file
-            merged0 = dict(self.params)
-            merged0.update(params or {})
-            cfg0 = Config(merged0)
+            cfg0 = Config(self._merged_params(params))
             X, fy, fw, fg, names = load_data_file(
                 self._text_path, cfg0.label_column, cfg0.header,
                 weight_column=cfg0.weight_column,
@@ -247,8 +261,7 @@ class Dataset:
             if self.feature_name == "auto" and names:
                 self.feature_name = names
         if self._train_data is None:
-            merged = dict(self.params)
-            merged.update(params or {})
+            merged = self._merged_params(params)
             cat_param = None
             for key in ("categorical_feature", "cat_feature",
                         "categorical_column", "cat_column",
@@ -257,13 +270,12 @@ class Dataset:
                     cat_param = merged.pop(key)
             cfg = Config(merged)
             cats: TypingSequence[int] = ()
-            # The constructor arg wins whenever given (list OR string —
-            # a bare/name: string used to be silently dropped); "auto"
-            # defers to the params key.
-            cat_spec = (self.categorical_feature
-                        if not (isinstance(self.categorical_feature, str)
-                                and self.categorical_feature == "auto")
-                        else cat_param)
+            # The constructor arg wins whenever actually given (list OR
+            # string — a bare/name: string used to be silently dropped);
+            # "auto"/None/empty defer to the params key.
+            given = self.categorical_feature
+            cat_spec = cat_param if given in ("auto", None, "", [],
+                                              ()) else given
             if cat_spec == "auto":
                 cat_spec = None
             force_names = False

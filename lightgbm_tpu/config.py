@@ -132,6 +132,7 @@ _PARAMS: List[Tuple[str, Any, Any, Tuple[str, ...], Optional[Tuple[Any, Any]]]] 
     ("categorical_feature", str, "", ("cat_feature", "categorical_column", "cat_column", "categorical_features"), None),
     ("forcedbins_filename", str, "", (), None),
     ("save_binary", bool, False, ("is_save_binary", "is_save_binary_file"), None),
+    ("saved_feature_importance_type", int, 0, (), (0, 1)),
     ("precise_float_parser", bool, False, (), None),
     ("parser_config_file", str, "", (), None),
     # ---- Predict parameters ----

@@ -73,6 +73,13 @@ def train(
         def _fold_init(ds: Dataset) -> Dataset:
             # Work on a shallow copy: the caller's Dataset must keep its own
             # init_score (re-running train() on it would otherwise compound).
+            if getattr(ds, "_text_path", None) is not None:
+                ds.construct(params)   # load raw rows before predicting
+            if not getattr(ds, "data", np.zeros(0)).size:
+                raise ValueError(
+                    "init_model continuation needs raw feature data to "
+                    "fold base predictions; binary dataset caches hold "
+                    "only binned columns — pass arrays or a text file")
             out = copy.copy(ds)
             from .binning import _is_sparse, predict_dense_chunks
             if _is_sparse(ds.data):

@@ -219,11 +219,15 @@ def model_to_string(gbdt, num_iteration: Optional[int] = None,
             idx += 1
     out.append("end of trees")
     out.append("")
-    imp = gbdt.feature_importance("split")
+    # saved_feature_importance_type=1 writes gain importances (reference
+    # GBDT::FeatureImportance via saved_feature_importance_type)
+    by_gain = getattr(cfg, "saved_feature_importance_type", 0) == 1
+    imp = gbdt.feature_importance("gain" if by_gain else "split")
     names = td.feature_names or [f"Column_{i}" for i in range(td.num_features)]
     pairs = sorted(zip(imp, names), reverse=True)
     out.append("feature_importances:")
-    out.extend(f"{n}={int(v)}" for v, n in pairs if v > 0)
+    out.extend((f"{n}={v:g}" if by_gain else f"{n}={int(v)}")
+               for v, n in pairs if v > 0)
     out.append("")
     out.append("parameters:")
     for key, val in sorted(cfg.raw_params.items()):

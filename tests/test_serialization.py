@@ -74,3 +74,26 @@ def test_num_iteration_predict():
     p5 = bst.predict(X, num_iteration=5)
     p20 = bst.predict(X)
     assert np.abs(p20 - y).mean() < np.abs(p5 - y).mean()
+
+
+def test_saved_feature_importance_type_gain():
+    """saved_feature_importance_type=1 writes gain importances (floats)
+    into the model file; default 0 writes split counts (reference
+    GBDT::SaveModelToFile FeatureImportance selection)."""
+    import numpy as np
+    import lightgbm_tpu as lgb
+    X = np.random.RandomState(0).randn(500, 4)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(float)
+    p = {"objective": "binary", "verbosity": -1, "num_leaves": 7}
+    m0 = lgb.train(p, lgb.Dataset(X, label=y), 5).model_to_string()
+    m1 = lgb.train({**p, "saved_feature_importance_type": 1},
+                   lgb.Dataset(X, label=y), 5).model_to_string()
+
+    def importances(txt):
+        lines = txt.split("feature_importances:")[1].split("\n\n")[0]
+        return [ln.split("=")[1] for ln in lines.strip().splitlines()]
+
+    assert all(float(v) == int(float(v)) for v in importances(m0))
+    gains = importances(m1)
+    assert any("." in v or "e" in v for v in gains)   # float gains
+    assert all(float(v) > 0 for v in gains)
