@@ -179,11 +179,10 @@ class Dataset:
             if is_binary_dataset_file(data):
                 self._binary_path = data
             else:
-                with open(data, "rb") as fh:
-                    magic = fh.read(2)
-                if magic == b"PK":
-                    # zip container that failed binary validation: a
-                    # truncated/corrupt cache must not be parsed as text
+                import zipfile
+                if zipfile.is_zipfile(data):
+                    # a real zip container that failed binary validation
+                    # is a truncated/corrupt cache, not a text file
                     raise ValueError(
                         f"{data!r} looks like a corrupt lightgbm_tpu "
                         "binary dataset file")
@@ -250,6 +249,10 @@ class Dataset:
                 group_column=cfg0.group_column,
                 ignore_column=cfg0.ignore_column,
                 with_feature_names=True)
+            if self.position is None:
+                from .io.parser import position_side_file
+                self.position = position_side_file(self._text_path,
+                                                   expected_rows=len(X))
             self.data = X
             self._text_path = None
             if self.label is None:
@@ -274,8 +277,11 @@ class Dataset:
             # string — a bare/name: string used to be silently dropped);
             # "auto"/None/empty defer to the params key.
             given = self.categorical_feature
-            cat_spec = cat_param if given in ("auto", None, "", [],
-                                              ()) else given
+            deferred = (given is None
+                        or (isinstance(given, str) and given in ("auto", ""))
+                        or (isinstance(given, (list, tuple))
+                            and len(given) == 0))
+            cat_spec = cat_param if deferred else given
             if cat_spec == "auto":
                 cat_spec = None
             force_names = False

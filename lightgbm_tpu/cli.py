@@ -87,7 +87,10 @@ def run(argv: List[str]) -> int:
                 group_column=cfg.group_column,
                 ignore_column=cfg.ignore_column,
                 with_feature_names=True)
+            from .io.parser import position_side_file
             ds = Dataset(X, label=y, weight=w, group=g, params=params,
+                         position=position_side_file(data_path,
+                                                     expected_rows=len(y)),
                          feature_name=names or "auto")
         if task == "save_binary" or cfg.save_binary:
             # reference application task=save_binary / save_binary=true:

@@ -245,7 +245,8 @@ def load_train_data_two_round(path: str, cfg: Config, *,
     matrix never materializes.
     """
     from .binning import BinnedData, find_bin
-    from .io.parser import _resolve_header, _side_files, iter_file_blocks
+    from .io.parser import (_resolve_header, _side_files, iter_file_blocks,
+                            position_side_file)
 
     sample_cnt = cfg.bin_construct_sample_cnt
     rng = np.random.RandomState(cfg.data_random_seed)
@@ -335,6 +336,7 @@ def load_train_data_two_round(path: str, cfg: Config, *,
         weight=None if weight is None else np.asarray(weight, np.float32),
         group=None if group is None else np.asarray(group, np.int64),
         monotone_constraints=mono,
+        position=position_side_file(path, expected_rows=n_total),
         feature_names=(header_names
                        if header_names and len(header_names) == max_f
                        else None),

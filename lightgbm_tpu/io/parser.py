@@ -204,6 +204,21 @@ def _side_files(path: str):
     return weight, group
 
 
+def position_side_file(path: str, expected_rows: Optional[int] = None):
+    """``<data>.position`` auto-load (reference Advanced-Topics.rst:108,
+    metadata.cpp): one position per row; arbitrary identifiers factorize
+    to dense ids like the reference's position string mapping."""
+    if not os.path.exists(path + ".position"):
+        return None
+    raw = np.loadtxt(path + ".position", dtype=str, ndmin=1)
+    if expected_rows is not None and len(raw) != expected_rows:
+        raise ValueError(
+            f"{path}.position has {len(raw)} rows; data has "
+            f"{expected_rows}")
+    _, ids = np.unique(raw, return_inverse=True)
+    return ids.astype(np.int32)
+
+
 def _atof(tok: str) -> float:
     tok = tok.strip()
     if tok == "" or tok.lower() in ("na", "nan", "null", "none"):

@@ -100,3 +100,32 @@ def test_unbiased_lambdarank_positions():
     nd_blind = _ndcg_multi(true_rel, blind.predict(X, raw_score=True),
                            group, [5], gains)[0]
     assert nd_unbiased >= nd_blind - 1e-3
+
+
+def test_position_side_file_autoload(tmp_path):
+    """<data>.position loads automatically (reference Advanced-Topics:108)
+    and drives unbiased LambdaRank; constructor positions win over it."""
+    import numpy as np
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.io.parser import position_side_file
+
+    rng = np.random.RandomState(0)
+    n_q, per_q = 120, 10
+    n = n_q * per_q
+    X = rng.randn(n, 5)
+    y = np.clip((X[:, 0] * 2 + rng.randn(n) * 0.3).astype(int) % 5, 0, 4)
+    path = tmp_path / "tr.csv"
+    np.savetxt(path, np.column_stack([y, X]), delimiter=",", fmt="%.8g")
+    np.savetxt(str(path) + ".query", np.full(n_q, per_q), fmt="%d")
+    pos = np.tile(np.arange(per_q), n_q)
+    np.savetxt(str(path) + ".position", pos, fmt="%d")
+
+    loaded = position_side_file(str(path))
+    np.testing.assert_array_equal(loaded, pos)
+
+    ds = lgb.Dataset(str(path))
+    bst = lgb.train({"objective": "lambdarank", "verbosity": -1,
+                     "num_leaves": 7, "lambdarank_position_bias_regularization": 0.1},
+                    ds, 5)
+    assert bst.num_trees() == 5
+    assert ds.position is not None
