@@ -9,6 +9,7 @@ programs.
 from __future__ import annotations
 
 import copy
+import os
 from typing import Any, Dict, List, Optional, Tuple, Union
 from typing import Sequence as TypingSequence
 
@@ -172,9 +173,8 @@ class Dataset:
             # path is a CSV/TSV/LibSVM text file, loaded with the params'
             # column specs like the reference python package delegates to
             # DatasetLoader.
-            import os as _os
             from .dataset import is_binary_dataset_file
-            if not _os.path.exists(data):
+            if not os.path.exists(data):
                 raise FileNotFoundError(f"no such data file: {data!r}")
             if is_binary_dataset_file(data):
                 self._binary_path = data
@@ -241,7 +241,7 @@ class Dataset:
             self.weight = self._train_data.weight
             self.group = self._train_data.group
         if self._train_data is None and self._text_path is not None:
-            from .io.parser import load_data_file
+            from .io.parser import load_data_file, position_side_file
             cfg0 = Config(self._merged_params(params))
             X, fy, fw, fg, names = load_data_file(
                 self._text_path, cfg0.label_column, cfg0.header,
@@ -250,7 +250,6 @@ class Dataset:
                 ignore_column=cfg0.ignore_column,
                 with_feature_names=True)
             if self.position is None:
-                from .io.parser import position_side_file
                 self.position = position_side_file(self._text_path,
                                                    expected_rows=len(X))
             self.data = X
@@ -296,10 +295,14 @@ class Dataset:
                             if t.strip()]
             if isinstance(cat_spec, (list, tuple)):
                 names = self._feature_names()
-                cats = [names.index(c) if force_names
-                        else int(c) if not isinstance(c, str)
-                        or c.lstrip("-").isdigit()
-                        else names.index(c) for c in cat_spec]
+
+                def cat_idx(c):
+                    if not force_names and (not isinstance(c, str)
+                                            or c.lstrip("-").isdigit()):
+                        return int(c)
+                    return names.index(c)
+
+                cats = [cat_idx(c) for c in cat_spec]
             elif cfg.categorical_feature:
                 cats = [int(c) for c in cfg.categorical_feature.split(",")]
             ref_td = (self.reference.construct(params)

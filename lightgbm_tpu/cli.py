@@ -45,6 +45,15 @@ def run(argv: List[str]) -> int:
     params = parse_cli_params(argv)
     task = params.pop("task", "train")
     cfg = Config(dict(params))
+
+    def _load(path, with_feature_names=False):
+        """Text load with the config's column specs — every task must
+        drop/extract the SAME in-data columns (train/valid/predict/refit)."""
+        return load_data_file(path, cfg.label_column, cfg.header,
+                              weight_column=cfg.weight_column,
+                              group_column=cfg.group_column,
+                              ignore_column=cfg.ignore_column,
+                              with_feature_names=with_feature_names)
     if task in ("train", "save_binary"):
         # Distributed bootstrap (reference Application::Train ->
         # Network::Init from machines/machine_list_file): num_machines > 1
@@ -81,12 +90,7 @@ def run(argv: List[str]) -> int:
                          params=params)
             ds._train_data = td
         else:
-            X, y, w, g, names = load_data_file(
-                data_path, cfg.label_column, cfg.header,
-                weight_column=cfg.weight_column,
-                group_column=cfg.group_column,
-                ignore_column=cfg.ignore_column,
-                with_feature_names=True)
+            X, y, w, g, names = _load(data_path, with_feature_names=True)
             from .io.parser import position_side_file
             ds = Dataset(X, label=y, weight=w, group=g, params=params,
                          position=position_side_file(data_path,
@@ -110,11 +114,7 @@ def run(argv: List[str]) -> int:
         valid_sets, valid_names = [], []
         valid = params.pop("valid", params.pop("valid_data", ""))
         for i, vp in enumerate(p for p in valid.split(",") if p):
-            Xv, yv, wv, gv = load_data_file(
-                vp, cfg.label_column, cfg.header,
-                weight_column=cfg.weight_column,
-                group_column=cfg.group_column,
-                ignore_column=cfg.ignore_column)
+            Xv, yv, wv, gv = _load(vp)
             valid_sets.append(Dataset(Xv, label=yv, weight=wv, group=gv,
                                       reference=ds, params=params))
             valid_names.append(f"valid_{i}")
@@ -146,10 +146,7 @@ def run(argv: List[str]) -> int:
             Log.fatal("task=predict requires data=<file>")
         bst = Booster(model_file=model_path)
         # predict data must drop the same in-data columns training dropped
-        X, _, _, _ = load_data_file(
-            data_path, cfg.label_column, cfg.header,
-            weight_column=cfg.weight_column, group_column=cfg.group_column,
-            ignore_column=cfg.ignore_column)
+        X, _, _, _ = _load(data_path)
         pred = bst.predict(
             X, raw_score=cfg.predict_raw_score,
             start_iteration=cfg.start_iteration_predict,
@@ -178,10 +175,7 @@ def run(argv: List[str]) -> int:
         data_path = params.get("data")
         if not data_path:
             Log.fatal("task=refit requires data=<file>")
-        X, y, w, g = load_data_file(
-            data_path, cfg.label_column, cfg.header,
-            weight_column=cfg.weight_column, group_column=cfg.group_column,
-            ignore_column=cfg.ignore_column)
+        X, y, w, g = _load(data_path)
         new_bst = Booster(model_file=model_path).refit(
             X, y, decay_rate=cfg.refit_decay_rate, weight=w, group=g)
         out = cfg.output_model or "LightGBM_model.txt"
