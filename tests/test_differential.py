@@ -112,6 +112,13 @@ BASE = {"num_leaves": 31, "learning_rate": 0.1, "num_iterations": 30,
                          "[0,1],[2,3,4,5,6,7,8,9,10,11]"}, 5e-3),
     ("cegb", {"objective": "binary", "cegb_penalty_split": 0.05,
               "cegb_tradeoff": 0.8}, 8e-3),
+    ("maxbin63", {"objective": "binary", "max_bin": 63,
+                  "min_gain_to_split": 0.01}, 5e-3),
+    # balanced bagging resamples with class-dependent rates (RNG differs
+    # across implementations by design)
+    ("posneg_bagging", {"objective": "binary", "pos_bagging_fraction": 0.5,
+                        "neg_bagging_fraction": 0.9, "bagging_freq": 1},
+     1.2e-2),
 ], ids=lambda v: v if isinstance(v, str) else "")
 def test_binary_auc_parity(case, params, tol):
     """Holdout AUC must track the genuine binary within tolerance on the
