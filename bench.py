@@ -42,6 +42,18 @@ QUANTIZED = os.environ.get("BENCH_QUANTIZED", "0") == "1"
 # line stays the fp32 config.
 QUANT_CHECK = os.environ.get("BENCH_QUANT_CHECK", "1") == "1"
 QUANT_ITERS = int(os.environ.get("BENCH_QUANT_ITERS", 20))
+# Iteration packing (docs/ITER_PACK.md): boosting rounds scanned into one
+# XLA dispatch.  0 disables (per-round update()); the effective size is
+# clamped to a divisor of the timed iteration count so the measured window
+# never recompiles a remainder pack.
+ITER_PACK = int(os.environ.get("BENCH_ITER_PACK", 12))
+
+
+def _pack_eff(iters, pack):
+    """Largest divisor of ``iters`` that is <= ``pack`` (1 = per-round)."""
+    if pack <= 1 or iters <= 0:
+        return 1
+    return max(d for d in range(1, min(pack, iters) + 1) if iters % d == 0)
 
 
 def bench_params():
@@ -147,6 +159,38 @@ def _probe_backend():
     return result["platform"], result["n"]
 
 
+def _timed_train(bst, iters, pack, jax):
+    """Warmup-compile one step, then time ``iters`` boosting rounds —
+    packed (Booster.update_pack) when the booster's own plan allows, else
+    per-round.  Returns ``(elapsed_s, dispatches, pack_eff)`` so callers
+    report the pack size that actually ran, never the one requested."""
+    if pack > 1 and not bst._gbdt.iter_pack_plan(pack)[1]:
+        pack = 1   # config cannot pack — report per-round honestly
+    # Warmup: compile the training step (excluded from timing, like the
+    # reference excludes data loading).  The pack warmup compiles the SAME
+    # scan length the timed window uses, so timing never pays a compile.
+    if pack > 1:
+        bst.update_pack(pack)
+    else:
+        bst.update()
+    # The tunneled backend's block_until_ready can return before compute
+    # finishes; a host readback of a score slice is the only reliable
+    # fence, so time against that.
+    np.array(jax.device_get(bst._gbdt.scores[:8]))
+    dispatches = 0
+    t0 = time.time()
+    if pack > 1:
+        for _ in range(iters // pack):
+            bst.update_pack(pack)
+            dispatches += 1
+    else:
+        for _ in range(iters):
+            bst.update()
+            dispatches += 1
+    np.array(jax.device_get(bst._gbdt.scores[:8]))
+    return time.time() - t0, dispatches, pack
+
+
 def run_bench(rows, iters):
     platform, n_dev = _probe_backend()
 
@@ -176,20 +220,9 @@ def run_bench(rows, iters):
     if fresh_bin and bin_cache:   # outside the timed window
         _cache_write(bin_cache, ds.save_binary)
 
-    # Warmup: compile the training step (excluded from timing, like the
-    # reference excludes data loading).
     bst = lgb.Booster(params=params, train_set=ds)
-    bst.update()
-    # The tunneled backend's block_until_ready can return before compute
-    # finishes; a host readback of a score slice is the only reliable
-    # fence, so time against that.
-    np.array(jax.device_get(bst._gbdt.scores[:8]))
-
-    t0 = time.time()
-    for _ in range(iters):
-        bst.update()
-    np.array(jax.device_get(bst._gbdt.scores[:8]))
-    elapsed = time.time() - t0
+    elapsed, dispatches, pack = _timed_train(
+        bst, iters, _pack_eff(iters, ITER_PACK), jax)
 
     iters_per_sec = iters / elapsed
     row_iters_per_sec = rows * iters_per_sec
@@ -220,6 +253,11 @@ def run_bench(rows, iters):
                 "histogram_impl": _resolve_impl(
                     bst._gbdt.grower_cfg.histogram_impl, platform),
                 "platform": platform, "devices": n_dev,
+                # Iteration packing: training dispatches per boosting round
+                # (1.0 = per-round loop; 1/K with K-round packs — the
+                # host-sync elimination the pack path is for).
+                "iter_pack": pack,
+                "dispatches_per_iter": round(dispatches / iters, 4),
                 "train_time_s": round(elapsed, 3),
                 "iters_per_sec": round(iters_per_sec, 3),
                 "bin_time_s": round(bin_time, 3),
@@ -242,13 +280,9 @@ def run_bench(rows, iters):
         try:
             qbst = lgb.Booster(params=dict(params, use_quantized_grad=True),
                                train_set=ds)
-            qbst.update()
-            np.array(jax.device_get(qbst._gbdt.scores[:8]))
-            tq = time.time()
-            for _ in range(QUANT_ITERS):
-                qbst.update()
-            np.array(jax.device_get(qbst._gbdt.scores[:8]))
-            quant_rate = rows * QUANT_ITERS / (time.time() - tq)
+            q_elapsed, _qd, _qp = _timed_train(
+                qbst, QUANT_ITERS, _pack_eff(QUANT_ITERS, ITER_PACK), jax)
+            quant_rate = rows * QUANT_ITERS / q_elapsed
         except Exception as e:  # noqa: BLE001
             quant_rate = f"failed: {e!r}"[:200]
     if quant_rate is not None:

@@ -488,6 +488,25 @@ class Booster:
             return self._gbdt.train_one_iter(np.asarray(grad), np.asarray(hess))
         return self._gbdt.train_one_iter()
 
+    def update_pack(self, num_rounds: int = 1):
+        """Train up to ``num_rounds`` boosting rounds in ONE scanned device
+        dispatch (the iteration-packed path, docs/ITER_PACK.md).  Returns
+        ``(rounds_done, finished)``.  Falls back to per-round :meth:`update`
+        when the config cannot pack (the plan's auto-degrade list)."""
+        k, use_pack = self._gbdt.iter_pack_plan(num_rounds)
+        if not use_pack:
+            done, finished = 0, False
+            for _ in range(num_rounds):
+                finished = self.update()
+                done += 1
+                if finished:
+                    break
+            return done, finished
+        rounds, finished = self._gbdt.train_pack(min(k, num_rounds))
+        for rnd in rounds:
+            self._gbdt.commit_round(rnd)
+        return len(rounds), finished
+
     def rollback_one_iter(self) -> "Booster":
         self._gbdt.rollback_one_iter()
         return self

@@ -33,6 +33,13 @@ def log_evaluation(period: int = 1, show_stdv: bool = True) -> Callable:
                 for name, metric, value, _ in env.evaluation_result_list)
             print(f"[{env.iteration + 1}]\t{result}")
     _callback.order = 10
+    # Eval-cadence contract (docs/ITER_PACK.md): this callback only consumes
+    # metrics on iterations where (it + 1) % eval_period == 0; the engine
+    # may skip metric computation (and the host sync it costs) on the other
+    # iterations, and the iteration-packed path aligns its auto pack size
+    # to this period.  Callbacks without the attribute default to period 1;
+    # period <= 0 (logging disabled) never consumes any metric.
+    _callback.eval_period = period if period > 0 else 0
     return _callback
 
 
@@ -46,6 +53,7 @@ def record_evaluation(eval_result: Dict[str, Dict[str, List[float]]]) -> Callabl
             eval_result[name].setdefault(metric, [])
             eval_result[name][metric].append(value)
     _callback.order = 20
+    _callback.eval_period = 1   # records every round (cadence contract)
     return _callback
 
 
@@ -121,4 +129,5 @@ def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
                           f"[{best_iter[i] + 1}]")
                 raise EarlyStopException(best_iter[i], best_score_list[i])
     _callback.order = 30
+    _callback.eval_period = 1   # the no-improvement counter ticks per round
     return _callback

@@ -187,6 +187,12 @@ _PARAMS: List[Tuple[str, Any, Any, Tuple[str, ...], Optional[Tuple[Any, Any]]]] 
     ("tpu_4bit_bins", bool, True, (), None),
     # Leaves split per growth step (wave growth); 1 = strict best-first.
     ("tpu_leaf_batch", int, 1, (), (1, 128)),
+    # Boosting rounds fused into ONE scanned XLA dispatch (iteration
+    # packing, docs/ITER_PACK.md).  0 = auto: pack whenever the config is
+    # pack-capable with static row/feature masks; explicit K >= 1 forces
+    # the pack path (bagging/feature-fraction masks move to key-folded
+    # device sampling there).
+    ("tpu_iter_pack", int, 0, (), (0, 4096)),
 ]
 
 _CANONICAL: Dict[str, Tuple[str, Any, Any, Optional[Tuple[Any, Any]]]] = {}

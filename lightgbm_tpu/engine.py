@@ -122,30 +122,105 @@ def train(
     snapshot_freq = booster.cfg.snapshot_freq
     snapshot_base = booster.cfg.output_model or "LightGBM_model.txt"
 
-    for it in range(num_boost_round):
-        for cb in cbs_before:
-            cb(CallbackEnv(booster, params, it, 0, num_boost_round, None))
-        finished = booster.update(fobj=fobj)
-        if snapshot_freq > 0 and (it + 1) % snapshot_freq == 0:
-            booster.save_model(f"{snapshot_base}.snapshot_iter_{it + 1}")
-        # Skip metric evaluation entirely when nothing consumes it — avoids a
-        # host transfer + metric sort per iteration.
-        if cbs_after or feval is not None:
-            evals = booster._evals(feval)
+    # Eval cadence (callback.py contract): a callback may declare the period
+    # at which it consumes metrics via ``cb.eval_period`` (default 1); the
+    # engine skips metric computation — and its host transfer — on rounds
+    # nothing consumes, and the pack plan below aligns to the cadence.
+    # eval_period <= 0 marks a callback that never consumes metrics (e.g.
+    # log_evaluation(period=0), the documented way to silence logging).
+    cb_periods = [p for p in (int(getattr(cb, "eval_period", 1))
+                              for cb in cbs_after) if p > 0]
+    if feval is not None:
+        cb_periods.append(1)
+    eval_period = min(cb_periods) if cb_periods else None
+
+    def _round_needs_eval(it: int) -> bool:
+        return any((it + 1) % p == 0 for p in cb_periods)
+
+    # Iteration packing (docs/ITER_PACK.md): scan K boosting rounds into ONE
+    # device dispatch when nothing demands per-round host access.  Per-round
+    # param resets (before-callbacks), snapshots, custom objectives and
+    # training-score consumers (feval / training metric — mid-pack train
+    # scores do not exist on the host) pin the per-round path; everything
+    # else is the booster's pack plan (auto-degrade list lives there).
+    needs_train_scores = feval is not None or (
+        bool(cbs_after) and booster.cfg.is_provide_training_metric)
+    pack_k, use_pack = 1, False
+    if (fobj is None and not cbs_before and snapshot_freq <= 0
+            and not needs_train_scores):
+        pack_k, use_pack = booster._gbdt.iter_pack_plan(
+            num_boost_round, eval_period)
+    if use_pack and num_boost_round % pack_k:
+        # A trailing remainder pack would compile a SECOND scan program
+        # (the pack cache keys on K).  Pack size is scheduling-only (models
+        # are bitwise identical across K), so snap to a divisor of the
+        # round count when one exists nearby; keep the remainder scheme
+        # when the only divisors are tiny (a prime round count must not
+        # degrade to per-round dispatching).
+        div = max((d for d in range(1, pack_k + 1)
+                   if num_boost_round % d == 0), default=1)
+        if div >= max(pack_k // 2, 2):
+            pack_k = div
+
+    # best_iteration counts over the COMBINED model (base trees first) so
+    # Booster.predict's num_iteration slicing keeps the full base ensemble.
+    n_base = base.iter_ if base is not None else 0
+
+    def _fire_after(it: int) -> bool:
+        """Eval + after-callbacks for round ``it``; True = early stop."""
+        if not _round_needs_eval(it):
+            return False
+        evals = booster._evals(feval)
+        try:
+            for cb in cbs_after:
+                cb(CallbackEnv(booster, params, it, 0, num_boost_round,
+                               evals))
+        except EarlyStopException as e:
+            booster.best_iteration = e.best_iteration + 1 + n_base
+            booster.best_score = e.best_score
+            return True
+        return False
+
+    it = 0
+    while it < num_boost_round:
+        if use_pack:
+            rounds, finished = booster._gbdt.train_pack(
+                min(pack_k, num_boost_round - it))
+            committed = 0
+            stopped = False
             try:
-                for cb in cbs_after:
-                    cb(CallbackEnv(booster, params, it, 0, num_boost_round,
-                                   evals))
-            except EarlyStopException as e:
-                # best_iteration counts over the COMBINED model (base trees
-                # first) so Booster.predict's num_iteration slicing keeps the
-                # full base ensemble.
-                n_base = base.iter_ if base is not None else 0
-                booster.best_iteration = e.best_iteration + 1 + n_base
-                booster.best_score = e.best_score
+                for j, rnd in enumerate(rounds):
+                    # Commit one round, then replay its callbacks/eval:
+                    # valid scores update per committed tree, so callbacks
+                    # observe the SAME per-iteration metric sequence as the
+                    # per-round loop (early stopping fires at the identical
+                    # iteration).
+                    booster._gbdt.commit_round(rnd)
+                    committed += 1
+                    if _fire_after(it + j):
+                        stopped = True
+                        break
+            finally:
+                # Uncommitted rounds were trained inside the same dispatch
+                # but never observed (mid-pack early stop, or a callback
+                # raising) — drop their score contributions so a caller who
+                # keeps training from this booster sees consistent state.
+                if committed < len(rounds):
+                    booster._gbdt.discard_rounds(rounds[committed:])
+            it += committed
+            if stopped or finished:
                 break
-        if finished:
-            break
+        else:
+            for cb in cbs_before:
+                cb(CallbackEnv(booster, params, it, 0, num_boost_round,
+                               None))
+            finished = booster.update(fobj=fobj)
+            if snapshot_freq > 0 and (it + 1) % snapshot_freq == 0:
+                booster.save_model(f"{snapshot_base}.snapshot_iter_{it + 1}")
+            stopped = _fire_after(it)
+            it += 1
+            if stopped or finished:
+                break
     return booster
 
 

@@ -30,6 +30,24 @@ def _sniff_format(lines) -> str:
     return "csv"
 
 
+def _sniff_sep(line: str) -> str:
+    """Separator of one delimited line — tab beats comma beats whitespace
+    (reference parser.cpp sniffs TSV before CSV; files with neither parse
+    as whitespace-delimited).  ONE shared helper, used by both the data
+    parser and the header resolver, so their sniffing can never disagree."""
+    if "\t" in line:
+        return "\t"
+    if "," in line:
+        return ","
+    return " "
+
+
+def _split_line(line: str, sep: str):
+    """Split one data/header line by the sniffed separator (whitespace runs
+    collapse under the space separator, like ``np.loadtxt``)."""
+    return line.split() if sep == " " else line.split(sep)
+
+
 def _parse_libsvm(lines, num_features: Optional[int] = None):
     labels, rows = [], []
     max_f = -1
@@ -78,7 +96,30 @@ def load_data_file(
 
     X = y = None
     header_line = None
-    if native.available():
+    # Sniff format + separator ONCE from the file head (shared with the
+    # native-parser path, which never reads the file in Python) — column
+    # specs and ``name:`` resolution below reuse the same resolved ``sep``.
+    first = []
+    with open(path) as fh:
+        for _ in range(11):
+            ln = fh.readline()
+            if not ln:
+                break
+            first.append(ln.rstrip("\n"))
+    if header and first:
+        header_line = first[0]
+    fmt, sep, label_idx = _resolve_format_and_label(
+        first, label_column, header)
+    if fmt == "libsvm" and (weight_column or group_column or ignore_column):
+        # Reference column specs index CSV/TSV columns; LibSVM rows are
+        # sparse feature:value pairs where a column index has no meaning.
+        raise ValueError(
+            "weight_column/group_column/ignore_column cannot be used with "
+            "LibSVM input (column indices have no meaning there); use the "
+            f"side files {path}.weight / {path}.query instead")
+    # The native parser speaks CSV/TSV/LibSVM; space-separated files go to
+    # the Python parser (whitespace split via the shared sniffer).
+    if native.available() and (fmt == "libsvm" or sep != " "):
         res = native.parse_file(path, header=header,
                                 label_column=label_column,
                                 num_features=num_features or 0)
@@ -87,22 +128,18 @@ def load_data_file(
     if X is None:
         with open(path) as fh:
             lines = fh.read().splitlines()
-        if header and lines:
-            header_line = lines[0]
         start = 1 if header else 0
-        fmt, sep, label_idx = _resolve_format_and_label(
-            lines[:11], label_column, header)
         if fmt == "libsvm":
             X, y = _parse_libsvm(lines[start:], num_features)
         else:
             data = np.asarray(
-                [[_atof(v) for v in line.split(sep)]
+                [[_atof(v) for v in _split_line(line, sep)]
                  for line in lines[start:] if line.strip()])
             y = data[:, label_idx]
             X = np.delete(data, label_idx, axis=1)
     X, weight, group, dropped = _apply_column_specs(
         X, path, header, label_column, weight_column, group_column,
-        ignore_column, header_line=header_line)
+        ignore_column, header_line=header_line, sep=sep)
     # side files load independently (reference metadata.cpp); an in-data
     # column wins only for its own field
     sw, sg = _side_files(path)
@@ -113,7 +150,7 @@ def load_data_file(
     names = None
     if header:
         cols, label_idx, _ = _resolve_header(path, label_column,
-                                             header_line)
+                                             header_line, sep)
         names = [c for i, c in enumerate(cols) if i != label_idx]
         names = [c for i, c in enumerate(names) if i not in dropped]
         if len(names) != X.shape[1]:
@@ -121,15 +158,20 @@ def load_data_file(
     return out + (names,)
 
 
-def _resolve_header(path, label_column, header_line=None):
+def _resolve_header(path, label_column, header_line=None, sep=None):
     """(names, label_idx, sep) from the header line, read at most once.
-    Label tolerance matches _resolve_format_and_label: bare non-numeric
-    specs fall back to column 0."""
+    ``sep`` should be the separator already resolved by
+    ``_resolve_format_and_label``; when absent it is sniffed with the SAME
+    shared helper (``_sniff_sep``), so space-separated files with headers
+    resolve ``name:`` column specs the same way the data parser splits
+    rows.  Label tolerance matches _resolve_format_and_label: bare
+    non-numeric specs fall back to column 0."""
     if header_line is None:
         with open(path) as fh:
             header_line = fh.readline().rstrip("\n")
-    sep = "\t" if "\t" in header_line else ","
-    names = [c.strip() for c in header_line.split(sep)]
+    if sep is None:
+        sep = _sniff_sep(header_line)
+    names = [c.strip() for c in _split_line(header_line, sep)]
     lc = str(label_column)
     if lc.startswith("name:") and lc[5:] in names:
         label_idx = names.index(lc[5:])
@@ -142,10 +184,12 @@ def _resolve_header(path, label_column, header_line=None):
 
 
 def _apply_column_specs(X, path, header, label_column, weight_column,
-                        group_column, ignore_column, header_line=None):
+                        group_column, ignore_column, header_line=None,
+                        sep=None):
     """Extract in-data weight/query columns and drop ignored columns
     (reference semantics: integer indices do NOT count the label column;
-    ``name:`` specs resolve against the header, read at most once)."""
+    ``name:`` specs resolve against the header, read at most once, split
+    with the caller's already-resolved separator)."""
     if not (weight_column or group_column or ignore_column):
         return X, None, None, set()
     specs = [str(weight_column), str(group_column), str(ignore_column)]
@@ -154,7 +198,7 @@ def _apply_column_specs(X, path, header, label_column, weight_column,
         if not header:
             raise ValueError("name: column specs need header=true")
         names, label_idx, _ = _resolve_header(path, label_column,
-                                              header_line)
+                                              header_line, sep)
 
     def to_idx(spec):
         spec = spec.strip()
@@ -229,13 +273,19 @@ def _atof(tok: str) -> float:
 def _resolve_format_and_label(first_lines, label_column: str,
                               header: bool):
     """Shared sniff + label-column resolution for the one-shot and
-    two-round loaders (keeps their semantics identical by construction)."""
+    two-round loaders (keeps their semantics identical by construction).
+    The separator comes from ``_sniff_sep`` on the first data line, so
+    space-separated files resolve consistently everywhere."""
     start = 1 if header else 0
     fmt = _sniff_format(first_lines[start: start + 10])
-    sep = "\t" if fmt == "tsv" else ","
+    sep = ","
+    for ln in first_lines[start:]:
+        if ln.strip():
+            sep = _sniff_sep(ln)
+            break
     label_idx = 0
     if label_column.startswith("name:") and header:
-        label_idx = first_lines[0].split(sep).index(label_column[5:])
+        label_idx = _split_line(first_lines[0], sep).index(label_column[5:])
     elif label_column:
         try:
             label_idx = int(label_column)
@@ -264,7 +314,7 @@ def iter_file_blocks(path: str, label_column: str = "", header: bool = False,
     def parse_block(lines):
         if fmt == "libsvm":
             return _parse_libsvm(lines, num_features)
-        data = np.asarray([[_atof(v) for v in ln.split(sep)]
+        data = np.asarray([[_atof(v) for v in _split_line(ln, sep)]
                            for ln in lines if ln.strip()])
         if data.size == 0:
             return np.zeros((0, 0)), np.zeros(0)

@@ -26,7 +26,8 @@ from ..metrics import Metric
 from ..objectives import ObjectiveFunction, create_objective
 from ..sampling import FeatureSampler, SampleStrategy
 from ..ops.split import SplitConfig
-from .grower import GrowerConfig, TreeArrays, make_grower
+from .grower import GrowerConfig, TreeArrays, make_grower, \
+    slice_tree_arrays
 from .tree import Tree, predict_tree_bins_device, stack_trees, \
     predict_ensemble_bins_device
 
@@ -106,6 +107,13 @@ class GBDT:
     # Subclasses that mutate scores between iterations (DART's drop/renorm)
     # clear this so the stop check never defers (see train_one_iter).
     _deterministic_iters = True
+    # Subclasses that do host work between rounds (DART drop/renorm, RF
+    # per-round re-bagging) clear this; the iteration-packed path
+    # (train_pack) is only offered when the plain GBDT round loop applies.
+    _supports_iter_pack = True
+    # Auto pack-size ceiling: bounds the (K, ...)-stacked TreeArrays a
+    # single scan emits (explicit tpu_iter_pack may exceed it).
+    _PACK_AUTO_CAP = 256
 
     def __init__(self, cfg: Config, train: TrainData,
                  valids: Sequence[Tuple[str, TrainData]] = (),
@@ -297,6 +305,9 @@ class GBDT:
         # PRNG for per-node randomness (extra_trees thresholds / bynode
         # feature sampling; reference extra_seed / feature_fraction_seed).
         self._goss_key = jax.random.PRNGKey(cfg.bagging_seed)
+        # Pack-path device sampling keys (docs/ITER_PACK.md): bagging shares
+        # the bagging_seed key above; feature_fraction gets its own stream.
+        self._ff_key = jax.random.PRNGKey(cfg.feature_fraction_seed)
         self._split_key = None
         if cfg.extra_trees or cfg.feature_fraction_bynode < 1.0:
             self._split_key = jax.random.PRNGKey(
@@ -396,7 +407,7 @@ class GBDT:
         objective gradients -> tree growth -> shrinkage -> score update as ONE
         XLA dispatch (reference: the CUDA learner's device-resident iteration,
         ``cuda_single_gpu_tree_learner.cpp:158`` — host sees only scalars)."""
-        grow = self.grow
+        grow = getattr(self.grow, "raw", self.grow)
         meta = self.meta_dev
         obj = self.objective
         num_class = self.num_class
@@ -422,6 +433,10 @@ class GBDT:
         self._grow_apply = jax.jit(grow_apply)
 
         self._fused_iter = None
+        self._fused_core = None
+        # Pack programs close over the (possibly rebuilt) grower; drop them
+        # whenever the iteration programs are rebuilt (histogram degrade).
+        self._pack_fns: Dict[int, object] = {}
         if (obj is not None and not obj.need_renew_tree_output
                 and not obj.stochastic_gradients):
             def fused(bins, scores, mask, fmask, shrink, quant_key=None,
@@ -446,6 +461,7 @@ class GBDT:
                                                   quant_key=quant_key,
                                                   split_key=split_key)
                 return ns, [(arrays, row_leaf)]
+            self._fused_core = fused      # scanned by the pack path
             self._fused_iter = jax.jit(fused)
 
     # ------------------------------------------------------------------ helpers
@@ -623,6 +639,198 @@ class GBDT:
         # reference GBDT::TrainOneIter's immediate check stores none.
         return all(int(x) <= 1 for x in jax.device_get(prev))
 
+    # ------------------------------------------------------ iteration packing
+    def iter_pack_degrade_reason(self) -> Optional[str]:
+        """Why this configuration cannot run the iteration-packed path
+        (None = pack-capable).  One enumerable list, mirrored by
+        docs/ITER_PACK.md's auto-degrade table."""
+        cfg = self.cfg
+        if not self._supports_iter_pack:
+            return "boosting mode does host work between rounds (dart/rf)"
+        if not self._deterministic_iters:
+            return "scores are mutated between iterations"
+        if self.objective is None:
+            return "custom-objective gradients arrive from the host each round"
+        if self._fused_iter is None:
+            return ("objective needs per-round host access (tree-output "
+                    "renewal or host-stochastic gradients)")
+        if cfg.linear_tree:
+            return "linear trees solve leaf models on the host each round"
+        if self._use_cegb:
+            return "CEGB tracks first-use feature penalties on the host"
+        if self.sample_strategy.is_goss:
+            return "GOSS resampling is derived outside the fused iteration"
+        if self.sample_strategy.is_balanced or cfg.bagging_by_query:
+            return "balanced / by-query bagging samples on the host"
+        return None
+
+    def iter_pack_plan(self, remaining: int,
+                       eval_period: Optional[int] = None):
+        """Resolve ``tpu_iter_pack`` into ``(pack_size, use_pack)`` for the
+        next ``remaining`` rounds.
+
+        ``eval_period`` is the cadence at which the caller needs per-round
+        host evaluation (None = never).  Auto mode (``tpu_iter_pack=0``)
+        packs only when it cannot change results: pack-capable configs with
+        STATIC row/feature masks (the host-RNG bagging / feature_fraction
+        streams are preserved by degrading to the per-round path) and no
+        per-round eval consumer.  An explicit ``tpu_iter_pack=K`` forces
+        the pack path — bagging / feature_fraction masks then move to
+        key-folded device sampling (sampling.bagging_mask_device)."""
+        remaining = max(int(remaining), 1)
+        requested = int(getattr(self.cfg, "tpu_iter_pack", 0) or 0)
+        reason = self.iter_pack_degrade_reason()
+        k, use = 1, False
+        if reason is not None:
+            if requested > 1:
+                from ..utils.log import Log
+                Log.warning(f"tpu_iter_pack={requested} ignored: {reason}")
+        elif requested >= 1:
+            k, use = min(requested, remaining), True
+        elif (self.sample_strategy.is_bagging
+                or self.cfg.feature_fraction < 1.0):
+            pass   # auto never swaps the host-RNG sampling streams
+        elif eval_period is not None and eval_period <= 1:
+            pass   # a per-round eval consumer pins the per-round path
+        else:
+            k = min(remaining, self._PACK_AUTO_CAP)
+            if eval_period is not None:
+                k = min(k, eval_period)
+            use = k > 1
+            if not use:
+                k = 1
+        # EVERY resolution passes the lockstep gate: a pack-vs-no-pack
+        # divergence across processes must fail fast at the allgather, not
+        # hang the packing processes inside it.
+        from ..parallel.distributed import assert_pack_lockstep
+        return assert_pack_lockstep(k, use), use
+
+    def _pack_fn(self, k: int):
+        """Compiled K-round program: ONE ``lax.scan`` over the fused
+        iteration (objective gradients -> grow -> shrinkage -> score
+        update), emitting (K, ...)-stacked TreeArrays — the whole boosting
+        LOOP stays device-resident (arXiv:1806.11248 / arXiv:2005.09148:
+        the next throughput factor lives in the loop, not the tree
+        build)."""
+        fn = self._pack_fns.get(k)
+        if fn is not None:
+            return fn
+        core = self._fused_core
+        cfg = self.cfg
+        strategy = self.sample_strategy
+        n = self.train_data.num_data
+        use_bag = strategy.is_bagging
+        bag_k = int(n * cfg.bagging_fraction)
+        bag_freq = max(cfg.bagging_freq, 1)
+        use_ff = cfg.feature_fraction < 1.0
+        ff_k = 0
+        if use_ff:
+            nvalid = int(np.count_nonzero(self.feature_sampler.used))
+            ff_k = max(int(np.ceil(nvalid * cfg.feature_fraction)), 1)
+        use_quant = self._quant_key is not None
+        use_split = self._split_key is not None
+        from ..sampling import bagging_mask_device, feature_mask_device
+
+        def packed(bins, scores, iter0, shrink, row_mask, base_fmask,
+                   bag_key, ff_key, quant_key, split_key):
+            def body(sc, it):
+                mask = (bagging_mask_device(bag_key, it // bag_freq, n,
+                                            bag_k)
+                        if use_bag else row_mask)
+                fmask = (feature_mask_device(ff_key, it, base_fmask, ff_k)
+                         if use_ff else base_fmask)
+                qk = (jax.random.fold_in(quant_key, it) if use_quant
+                      else None)
+                sk = (jax.random.fold_in(split_key, it) if use_split
+                      else None)
+                new_sc, outs = core(bins, sc, mask, fmask, shrink, qk, sk)
+                return new_sc, tuple(a for a, _rl in outs)
+
+            iters = iter0 + jnp.arange(k, dtype=jnp.int32)
+            scores2, stacked = jax.lax.scan(body, scores, iters)
+            nls = jnp.stack([t.num_leaves for t in stacked], axis=1)
+            return scores2, stacked, nls
+
+        fn = jax.jit(packed)
+        self._pack_fns[k] = fn
+        return fn
+
+    def train_pack(self, k: int):
+        """Run up to ``k`` boosting rounds in ONE scanned dispatch.
+
+        Returns ``(rounds, finished)``: ``rounds`` is a list (one entry per
+        KEPT round) of per-class TreeArrays, NOT yet stored — the caller
+        commits each via :meth:`commit_round`, which lets the engine fire
+        callbacks between commits so per-iteration semantics survive
+        packing.  The degenerate-stop check runs ONCE per pack from the
+        scanned ``num_leaves`` matrix; the stopping round's constant trees
+        (and everything after) are trimmed — the exact stop that the
+        deferred per-round check in train_one_iter approximates one
+        iteration late."""
+        if self._nls_pending is not None:   # drain a deferred legacy check
+            pend = jax.device_get(self._nls_pending)
+            self._nls_pending = None
+            if all(int(x) <= 1 for x in pend):
+                return [], True
+        cfg = self.cfg
+        shrink = cfg.learning_rate if cfg.boosting != "rf" else 1.0
+        base_fmask = (self._fmask_static if self._fmask_static is not None
+                      else jnp.asarray(self.feature_sampler.used))
+        args = (self.bins_dev, self.scores, np.int32(self.iter_), shrink,
+                self._full_mask, base_fmask, self._goss_key, self._ff_key,
+                self._quant_key, self._split_key)
+        try:
+            scores2, stacked, nls = self._pack_fn(k)(*args)
+        except Exception as e:  # noqa: BLE001 — degrade-and-retry (Mosaic)
+            if not self._degrade_histogram_impl(e):
+                raise
+            scores2, stacked, nls = self._pack_fn(k)(*args)
+        self.scores = scores2
+        nls_host = np.asarray(jax.device_get(nls))    # the ONE sync per pack
+        dead = np.all(nls_host <= 1, axis=1)
+        j0 = int(np.argmax(dead)) if dead.any() else k
+        finished = bool(dead.any())
+        rounds = [[slice_tree_arrays(stacked[c], j)
+                   for c in range(self.num_class)] for j in range(j0)]
+        # Rounds at/after the stop are dropped; any that still grew (a
+        # later bagging epoch can revive growth after a degenerate round —
+        # the reference stops at the FIRST degenerate round regardless)
+        # must surrender their in-scan score contributions.
+        for j in range(j0, k):
+            for c in range(self.num_class):
+                if nls_host[j, c] > 1:
+                    self._subtract_tree_scores(
+                        c, slice_tree_arrays(stacked[c], j))
+        return rounds, finished
+
+    def commit_round(self, round_arrays) -> None:
+        """Store one pack round's trees (device appends + valid-score
+        updates, no host sync) and advance the iteration counter."""
+        for c, arrays in enumerate(round_arrays):
+            self._store_tree(c, arrays, None)
+        self.iter_ += 1
+
+    def discard_rounds(self, rounds) -> None:
+        """Drop uncommitted pack rounds (mid-pack early stop): their trees
+        were trained inside the same dispatch but must vanish as if
+        training had halted per-round.  Stumps carry zero leaf values, so
+        subtracting every tree's prediction is exact."""
+        for rnd in rounds:
+            for c, arrays in enumerate(rnd):
+                self._subtract_tree_scores(c, arrays)
+
+    def _subtract_tree_scores(self, k: int, arrays: TreeArrays) -> None:
+        """Remove one uncommitted tree's contribution from the train scores
+        (same predict-and-subtract scheme as rollback_one_iter)."""
+        pred = predict_tree_bins_device(
+            _tree_dict(arrays), self.score_bins_dev,
+            self.meta_dev["nan_bins"])
+        pred = pred[: self.scores.shape[0]]
+        if self._shape_k:
+            self.scores = self.scores.at[:, k].add(-pred)
+        else:
+            self.scores = self.scores - pred
+
     @property
     def score_bins_dev(self):
         """ORIGINAL-feature-space train bins for on-device tree prediction
@@ -665,7 +873,13 @@ class GBDT:
         if "mosaic" not in msg.lower() and "pallas" not in msg.lower():
             return False
         if self.grower_cfg.histogram_impl not in ("auto", "pallas"):
-            return False   # an explicit impl choice should fail loudly
+            # Only NON-pallas explicit choices fail loudly: they never route
+            # into Mosaic, so a Mosaic/Pallas error under them is foreign.
+            # An explicit 'pallas' request degrades exactly like 'auto' —
+            # Mosaic layout legality is invisible until on-device runtime
+            # (docs/PERF.md round 5), so a hard fail would strand otherwise
+            # valid configs on real hardware.
+            return False
         Log.warning(
             "Pallas histogram kernel failed to compile; falling back to "
             f"tpu_histogram_impl=onehot ({msg.splitlines()[0][:160]})")

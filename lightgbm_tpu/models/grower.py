@@ -38,7 +38,6 @@ the reference's integer histogram reducers (``bin.h:48-81``).
 from __future__ import annotations
 
 import dataclasses
-import functools
 from typing import NamedTuple, Optional, Tuple
 
 import jax
@@ -170,6 +169,13 @@ class TreeArrays(NamedTuple):
     @property
     def max_leaves(self) -> int:
         return self.leaf_value.shape[0]
+
+
+def slice_tree_arrays(stacked: TreeArrays, j) -> TreeArrays:
+    """Round-``j`` view of a ``(K, ...)``-stacked :class:`TreeArrays` — the
+    shape the iteration-packed path's ``lax.scan`` emits (one stacked tree
+    per boosting round; see ``GBDT.train_pack``)."""
+    return jax.tree.map(lambda a: a[j], stacked)
 
 
 class _GrowState(NamedTuple):
@@ -2128,8 +2134,7 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
             **smap_kw,
         )(bins, vals, feature_mask, *meta, *extras)
 
-    @functools.partial(jax.jit, donate_argnums=())
-    def grow(
+    def _grow_impl(
         bins: jnp.ndarray,          # (N, F) uint8/16 — binned features
         grad: jnp.ndarray,          # (N,) f32
         hess: jnp.ndarray,          # (N,) f32
@@ -2231,6 +2236,11 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
                 leaf_weight=jnp.where(active, h_leaf, 0.0))
         return tree, row_leaf
 
+    grow = jax.jit(_grow_impl, donate_argnums=())
     # static dispatch facts, inspectable by tests/tools
     grow.fp_capable = fp_capable
+    # Scan-able handle: the iteration-packed path traces grow INSIDE a
+    # lax.scan body that is already under jit; the raw function skips the
+    # redundant inner-jit trace (semantics identical — nested jit inlines).
+    grow.raw = _grow_impl
     return grow

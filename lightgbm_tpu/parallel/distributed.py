@@ -111,6 +111,42 @@ def is_multi_process() -> bool:
     return jax.process_count() > 1
 
 
+def assert_pack_lockstep(pack_size: int, use_pack: bool = True) -> int:
+    """Validate an iteration-pack resolution under a multi-process mesh.
+
+    The pack path scans K boosting rounds inside ONE jitted dispatch whose
+    grower while_loops carry cross-shard collectives (psum per wave); every
+    process must therefore enter the SAME scan length or the mesh deadlocks
+    mid-collective — the pack analog of the reference's lockstep
+    requirement on its network reducers (``data_parallel_tree_learner.cpp``).
+    Pack plans derive from replicated config + round counts, so a mismatch
+    means diverging configs; fail fast here instead of hanging in ICI.
+
+    Every process must reach this allgather regardless of its OWN
+    resolution — a pack-vs-no-pack divergence would otherwise hang right
+    here, with the packing processes waiting on ones that never arrive —
+    so ``iter_pack_plan`` routes BOTH outcomes through it and the gathered
+    payload carries (pack_size, use_pack).  No-op in single-process mode."""
+    if not is_multi_process():
+        return pack_size
+    try:
+        from jax.experimental import multihost_utils
+        import numpy as _np
+        plans = _np.asarray(multihost_utils.process_allgather(
+            _np.asarray([pack_size, int(use_pack)], _np.int32)))
+        plans = plans.reshape(-1, 2)
+    except Exception as exc:  # noqa: BLE001 — allgather transport hiccup
+        log_warning(f"pack lockstep check skipped: {exc}")
+        return pack_size
+    uniq = {(int(k), int(u)) for k, u in plans}
+    if len(uniq) > 1:
+        raise ValueError(
+            f"tpu_iter_pack lockstep violation: processes resolved pack "
+            f"plans (size, packed) = {sorted(uniq)}; all processes must "
+            "train with identical pack configuration")
+    return pack_size
+
+
 def shutdown() -> None:
     """reference ``Network::Dispose`` / ``MpiFinalizeIfIsParallel``
     (``main.cpp:20``)."""

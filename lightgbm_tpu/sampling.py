@@ -131,6 +131,47 @@ def goss_mask_device(grad_sum, hess_sum, key, top_k: int, other_k: int,
     return mask
 
 
+def _rank_select_device(u, valid, k):
+    """Boolean mask keeping the k smallest draws among ``valid`` entries —
+    the device analog of ``rng.choice(valid, k, replace=False)`` (exact
+    subset size, matching the reference's index-subset bagging rather than
+    per-row Bernoulli)."""
+    import jax.numpy as jnp
+
+    n = u.shape[0]
+    u = jnp.where(valid, u, 2.0)              # invalid entries sort last
+    order = jnp.argsort(u)
+    rank = jnp.zeros(n, jnp.int32).at[order].set(
+        jnp.arange(n, dtype=jnp.int32))
+    return valid & (rank < k)
+
+
+def bagging_mask_device(key, epoch, num_data: int, bag_k: int):
+    """In-scan bagging row mask for the iteration-packed path: key-folded by
+    the resample epoch (``iteration // bagging_freq``), so every iteration
+    inside a pack derives the SAME mask its epoch demands — the device
+    analog of ``SampleStrategy.mask``'s host cache, with
+    ``jax.random.fold_in`` replacing the host RNG stream."""
+    import jax
+    import jax.numpy as jnp
+
+    k2 = jax.random.fold_in(key, epoch)
+    u = jax.random.uniform(k2, (num_data,))
+    sel = _rank_select_device(u, jnp.ones(num_data, bool), bag_k)
+    return sel.astype(jnp.float32)
+
+
+def feature_mask_device(key, iteration, base_mask, keep_k: int):
+    """In-scan per-tree ``feature_fraction`` mask (device analog of
+    ``FeatureSampler.tree_mask``): keep exactly ``keep_k`` of the base-mask
+    features, drawn from a key folded with the iteration number."""
+    import jax
+
+    k2 = jax.random.fold_in(key, iteration)
+    u = jax.random.uniform(k2, base_mask.shape)
+    return _rank_select_device(u, base_mask, keep_k)
+
+
 class FeatureSampler:
     """``feature_fraction`` per tree + interaction constraints
     (reference ``ColSampler``, ``col_sampler.hpp``)."""

@@ -327,14 +327,21 @@ def test_goss_device_mask_semantics():
 
 
 def test_degenerate_stop_deferred_exactly_one_extra():
-    """The deterministic fused path defers the degenerate-stop fetch by one
-    iteration (pipelining): a constant target stops the engine loop exactly
-    one iteration after the first degenerate tree — two stored trees, which
-    also pins that the deferral is actually active on this path."""
+    """The per-round deterministic fused path defers the degenerate-stop
+    fetch by one iteration (pipelining): driving update() directly, a
+    constant target stops exactly one iteration after the first degenerate
+    tree — two stored trees, which pins that the deferral is active on the
+    per-round path.  (engine.train now routes this config through the
+    iteration-packed path, whose pack-boundary check stores no stumps at
+    all — pinned in tests/test_iter_pack.py.)"""
     X = np.random.RandomState(0).randn(500, 4)
     y = np.zeros(500)
-    bst = lgb.train({"objective": "regression", "verbosity": -1,
-                     "num_leaves": 7}, lgb.Dataset(X, label=y), 10)
+    bst = lgb.Booster(params={"objective": "regression", "verbosity": -1,
+                              "num_leaves": 7},
+                      train_set=lgb.Dataset(X, label=y))
+    for _ in range(10):
+        if bst.update():
+            break
     assert bst.num_trees() == 2
 
 

@@ -1,6 +1,9 @@
 """Capture a jax.profiler trace of ONE bench-config training iteration and
 print the top time sinks (VERDICT r4 ask #1: if vs_baseline < 1.0, name
-the top-3 sinks in PERF.md).
+the top-3 sinks in PERF.md), plus a host-sync census: device_get calls per
+boosting iteration on the per-round path vs the iteration-packed path
+(docs/ITER_PACK.md), so the pack path's dispatch-elimination claim is
+measurable outside bench.py.
 
     python tools/profile_iter.py [rows] [iters]
 
@@ -13,6 +16,31 @@ import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _count_host_syncs(run, warmup):
+    """Run ``warmup()`` then ``run()`` with jax.device_get instrumented;
+    returns the number of device_get calls ``run`` performed.  Every
+    per-iteration host sync in the training loop goes through
+    jax.device_get (the deferred degenerate-stop fetch, linear/renew leaf
+    pulls, CEGB feature pulls), so this census captures exactly the
+    round-trips the pack path exists to eliminate."""
+    import jax
+
+    warmup()
+    counter = {"n": 0}
+    orig = jax.device_get
+
+    def counting(x):
+        counter["n"] += 1
+        return orig(x)
+
+    jax.device_get = counting
+    try:
+        run()
+    finally:
+        jax.device_get = orig
+    return counter["n"]
 
 
 def main():
@@ -47,6 +75,29 @@ def main():
     print(f"{iters} iters in {total:.3f}s "
           f"({rows * iters / total / 1e6:.2f} M row-iters/s)")
     print(f"trace: {trace_dir} (tensorboard --logdir {trace_dir})")
+
+    # ---- host-sync census: per-round loop vs iteration-packed loop ------
+    n = max(iters, 2)
+    legacy = lgb.Booster(params=params, train_set=ds)
+    syncs_legacy = _count_host_syncs(
+        run=lambda: [legacy.update() for _ in range(n)],
+        warmup=legacy.update)
+    packed = lgb.Booster(params=params, train_set=ds)
+    if not packed._gbdt.iter_pack_plan(n)[1]:
+        # update_pack would silently fall back to the per-round loop here;
+        # reporting that under a "packed" label would be a lie.
+        print(f"host syncs/iter: per-round={syncs_legacy / n:.2f} "
+              f"({syncs_legacy} device_get in {n} iters); pack path "
+              f"unavailable for this config "
+              f"({packed._gbdt.iter_pack_degrade_reason()})")
+        return
+    syncs_packed = _count_host_syncs(
+        run=lambda: packed.update_pack(n),
+        warmup=lambda: packed.update_pack(n))
+    print(f"host syncs/iter: per-round={syncs_legacy / n:.2f} "
+          f"({syncs_legacy} device_get in {n} iters), "
+          f"packed={syncs_packed / n:.2f} "
+          f"({syncs_packed} device_get in one {n}-round pack)")
 
 
 if __name__ == "__main__":
