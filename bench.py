@@ -47,6 +47,11 @@ QUANT_ITERS = int(os.environ.get("BENCH_QUANT_ITERS", 20))
 # clamped to a divisor of the timed iteration count so the measured window
 # never recompiles a remainder pack.
 ITER_PACK = int(os.environ.get("BENCH_ITER_PACK", 12))
+# Serving phase (docs/SERVING.md): warm QPS / p50 latency / compile census
+# for the compiled predict plan, reported inside detail.predict.
+PREDICT_CHECK = os.environ.get("BENCH_PREDICT", "1") == "1"
+PREDICT_CALLS = int(os.environ.get("BENCH_PREDICT_CALLS", 40))
+PREDICT_MAX_BATCH = int(os.environ.get("BENCH_PREDICT_MAX_BATCH", 8192))
 
 
 def _pack_eff(iters, pack):
@@ -237,7 +242,32 @@ def run_bench(rows, iters):
     except Exception:  # noqa: BLE001
         pass
 
-    def emit(quant_rate):
+    def bench_predict(bst):
+        """Warm serving stats from the compiled predict plan: warm QPS,
+        p50 latency and the compile count over a mixed-size request
+        stream (the serve subsystem's whole point is that this stays
+        O(log n) compiles and re-stacks nothing)."""
+        from lightgbm_tpu import serve
+        from tools.serve_bench import run_request_stream
+
+        pred = serve.Predictor(bst, raw_score=True)
+        t0 = time.time()
+        warmed = pred.warmup(PREDICT_MAX_BATCH)
+        warm_s = time.time() - t0
+        elapsed, served = run_request_stream(pred, X, PREDICT_CALLS,
+                                             PREDICT_MAX_BATCH)
+        snap = pred.metrics_snapshot()
+        return {
+            "warm_qps": round(PREDICT_CALLS / elapsed, 2),
+            "warm_rows_per_sec": round(served / elapsed, 1),
+            "p50_ms": round(snap["p50_ms"], 4),
+            "compiles": snap["compiles"],
+            "warmed_rungs": warmed,
+            "warmup_s": round(warm_s, 3),
+            "plan_cache_hits": snap["plan_cache"]["hits"],
+        }
+
+    def emit(quant_rate, predict_stats=None):
         print(json.dumps({
             "metric": "binary_255leaves_row_iters_per_sec",
             "value": round(row_iters_per_sec, 1),
@@ -265,15 +295,25 @@ def run_bench(rows, iters):
                 "quantized_row_iters_per_sec": (
                     round(quant_rate, 1) if isinstance(quant_rate, float)
                     else quant_rate),
+                "predict": predict_stats,
                 "reference": "LightGBM CPU 16t Higgs 10.5Mx28 500it in "
                              "130.094s (docs/Experiments.rst:113)",
             },
         }))
         sys.stdout.flush()
 
-    # Primary result FIRST: a wedged quant side-measurement must not forfeit
-    # a completed fp32 run (the outer runner salvages the last JSON line).
+    # Primary result FIRST: a wedged side-measurement (quant, predict) must
+    # not forfeit a completed fp32 run (the outer runner salvages the last
+    # JSON line).
     emit(None)
+
+    predict_stats = None
+    if PREDICT_CHECK:
+        try:
+            predict_stats = bench_predict(bst)
+        except Exception as e:  # noqa: BLE001
+            predict_stats = {"error": f"{e!r}"[:200]}
+        emit(None, predict_stats)
 
     quant_rate = None
     if QUANT_CHECK and not QUANTIZED:
@@ -286,7 +326,7 @@ def run_bench(rows, iters):
         except Exception as e:  # noqa: BLE001
             quant_rate = f"failed: {e!r}"[:200]
     if quant_rate is not None:
-        emit(quant_rate)
+        emit(quant_rate, predict_stats)
 
 
 def _scan_json(stdout):

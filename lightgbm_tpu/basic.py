@@ -587,6 +587,15 @@ class Booster:
                                   start_iteration=start_iteration,
                                   **es_kwargs)
 
+    def serving_predictor(self, **kwargs):
+        """A long-lived compiled :class:`~lightgbm_tpu.serve.Predictor` for
+        this booster (frozen slice, device-resident tree pack, shape-
+        bucketed batching, serving metrics — docs/SERVING.md).  Keyword
+        arguments are forwarded (``raw_score``, ``num_iteration``,
+        ``start_iteration``, ``ladder``, ``max_compiles``)."""
+        from .serve import Predictor
+        return Predictor(self, **kwargs)
+
     # -------------------------------------------------------------------- misc
     @property
     def current_iteration(self) -> int:

@@ -184,8 +184,19 @@ def _bounds_with_forced(distinct, counts, max_bins, total_cnt,
     ``FindBinWithPredefinedBin``, bin.cpp:157): the forced bounds become
     boundaries first, then each segment between them gets a greedy-
     equal-count refill proportional to its sample mass, the last segment
-    absorbing the remaining budget."""
-    forced = sorted({float(b) for b in forced if np.isfinite(b)})
+    absorbing the remaining budget.
+
+    Forced bounds within ``kZeroThreshold`` (1e-35) of zero are dropped,
+    as the reference skips any ``|bound| <= kZeroThreshold`` — it reserves
+    that band for its own ±kZeroThreshold boundaries so value 0.0 always
+    gets a dedicated bin.  Deviation note: this repo omits those implicit
+    zero boundaries REPO-WIDE (``_greedy_find_boundaries`` too, not just
+    here) — dense HBM histograms have no most-frequent-bin elision, so
+    zero earns a bin only when the data's own mass puts one there; what
+    must not differ is the forced-bound filter, else a user bound at/near
+    0.0 would create a sliver bin the reference refuses."""
+    forced = sorted({float(b) for b in forced
+                     if np.isfinite(b) and not (_KZERO_LO <= b <= _KZERO_HI)})
     bounds = forced[: max(max_bins - 1, 0)] + [np.inf]
     free_bins = max_bins - len(bounds)
     to_add: List[float] = []

@@ -737,6 +737,7 @@ def booster_set_leaf_value(handle, tree_idx, leaf_idx, value):
     lv = np.asarray(arrays.leaf_value).copy()
     lv[leaf_idx] = value
     gbdt.dev_models[k][it] = arrays._replace(leaf_value=jnp.asarray(lv))
+    gbdt._pred_version += 1   # invalidate cached serve plans
 
 
 def booster_get_bound_value(handle, upper):
@@ -885,6 +886,7 @@ def booster_refit(handle, leaf_preds_mv, nrow, ncol):
             f"leaf_preds has {ncol} columns, model has {n_iters * k_cls}")
     _refit_pass(nrow, k_cls, n_iters, gbdt.init_scores, objective,
                 gbdt.cfg, gbdt.cfg.refit_decay_rate, route, store)
+    gbdt._pred_version += 1   # invalidate cached serve plans
 
 
 def booster_reset_training_data(handle, train_handle):

@@ -193,6 +193,12 @@ _PARAMS: List[Tuple[str, Any, Any, Tuple[str, ...], Optional[Tuple[Any, Any]]]] 
     # the pack path (bagging/feature-fraction masks move to key-folded
     # device sampling there).
     ("tpu_iter_pack", int, 0, (), (0, 4096)),
+    # Predict batches up to this many rows take the native C++ host
+    # traversal (no device round-trip); larger batches go through the
+    # compiled serve plan (docs/SERVING.md).  0 routes everything to the
+    # device.  The LIGHTGBM_TPU_NATIVE_PREDICT_MAX_ROWS env var, where
+    # set, overrides this knob.
+    ("tpu_native_predict_max_rows", int, 262144, (), (0, None)),
 ]
 
 _CANONICAL: Dict[str, Tuple[str, Any, Any, Optional[Tuple[Any, Any]]]] = {}

@@ -141,6 +141,11 @@ class GBDT:
             [] for _ in range(self.num_class)]
         self.iter_ = 0
         self.best_iteration = -1
+        # Bumped by IN-PLACE leaf mutations that change predictions without
+        # touching iter_/num_trees (C-API SetLeafValue / Refit) — part of
+        # the serve PredictPlan cache key, so a mutated model can never be
+        # served from a stale device tree pack.
+        self._pred_version = 0
 
         # Distributed layout: sharding the inputs IS the parallel tree learner
         # (see parallel/mesh.py; reference §2.9 data/feature/voting learners).
@@ -978,17 +983,32 @@ class GBDT:
         return new_sk
 
     # ------------------------------------------------- host model materialization
-    @property
-    def models(self) -> List[List[Tree]]:
-        """Host Tree mirrors of the device ensemble (lazy, batched transfer)."""
+    def host_trees(self, start: int = 0,
+                   end: Optional[int] = None) -> List[List[Tree]]:
+        """Host Tree mirrors for iterations ``[start, end)`` of every class,
+        materializing ONLY that range in one batched transfer — a serve
+        plan freezing a 10-iteration slice of a 5000-iteration booster
+        must not pull the whole ensemble off the device."""
+        n = len(self.dev_models[0]) if self.dev_models else 0
+        start = max(int(start), 0)
+        end = n if end is None else min(int(end), n)
         pending = [(k, i)
                    for k in range(self.num_class)
-                   for i, t in enumerate(self._host_cache[k]) if t is None]
+                   for i in range(start, end)
+                   if self._host_cache[k][i] is None]
         if pending:
             host = jax.device_get([self.dev_models[k][i] for k, i in pending])
             ub = self.train_data.binned.upper_bounds_padded
             for (k, i), a in zip(pending, host):
                 self._host_cache[k][i] = Tree.from_arrays(a, ub)
+        return [self._host_cache[k][start:end]
+                for k in range(self.num_class)]
+
+    @property
+    def models(self) -> List[List[Tree]]:
+        """Host Tree mirrors of the device ensemble (lazy, batched transfer).
+        Returns the LIVE per-class lists (callers index/extend them)."""
+        self.host_trees()
         return self._host_cache
 
     # --------------------------------------------------------------- evaluation
@@ -1024,6 +1044,9 @@ class GBDT:
         """Raw scores for new data.  Iterations are indexed over the COMBINED
         model: a continuation base model's trees come first (reference
         ``GBDT::GetPredictAt`` over the full ensemble), then this booster's."""
+        # Negative starts would mean Python wraparound slicing on some paths
+        # and a clamp on others (serve plan) — normalize once, here.
+        start_iteration = max(int(start_iteration), 0)
         if self.base_model is not None:
             from ..binning import _is_sparse
             nb = self.base_model.iter_
@@ -1047,12 +1070,23 @@ class GBDT:
             return base + self._predict_raw_own(X, own_num, own_start)
         return self._predict_raw_own(X, num_iteration, start_iteration)
 
+    def _native_predict_cutoff(self) -> int:
+        """Row count at/below which prediction takes the native C++ host
+        traversal.  ``tpu_native_predict_max_rows`` is the config knob; the
+        LIGHTGBM_TPU_NATIVE_PREDICT_MAX_ROWS env var stays as an override
+        (deploy-time tuning without touching model params)."""
+        env = os.environ.get("LIGHTGBM_TPU_NATIVE_PREDICT_MAX_ROWS")
+        if env is not None:
+            return int(env)
+        return self.cfg.tpu_native_predict_max_rows
+
     def _predict_raw_own(self, X: np.ndarray,
                          num_iteration: Optional[int] = None,
                          start_iteration: int = 0) -> np.ndarray:
-        """This booster's own trees: host binning, then either the native C++
-        batch traversal (small batches; no device round-trip) or the device
-        ensemble scan (large batches)."""
+        """This booster's own trees: the native C++ batch traversal for
+        small batches (host binning, no device round-trip), the compiled
+        serve plan for large ones (device binning + resident tree pack,
+        docs/SERVING.md), and the legacy per-call device scan as fallback."""
         from .. import native
         from ..binning import _is_sparse, predict_dense_chunks
 
@@ -1066,12 +1100,25 @@ class GBDT:
             X = np.asarray(X)
         if self.cfg.linear_tree:
             return self._predict_raw_linear(X, num_iteration, start_iteration)
-        host_bins = self.train_data.binned.apply(X)
-        nan_bins_np = self.train_data.binned.nan_bins
         n = X.shape[0]
         k = self.num_class
-        use_native = native.available() and n <= int(os.environ.get(
-            "LIGHTGBM_TPU_NATIVE_PREDICT_MAX_ROWS", 262144))
+        use_native = native.available() and n <= self._native_predict_cutoff()
+        if not use_native and os.environ.get("LIGHTGBM_TPU_SERVE",
+                                             "1") != "0":
+            # Device path -> compiled serve plan: the stacked tree pack and
+            # binning tables are built once and cached (PredictPlan), so
+            # repeat predicts skip re-stacking, re-upload AND host binning.
+            from ..serve import plan_for_model
+            plan = plan_for_model(self, num_iteration, start_iteration)
+            if plan is not None:
+                if _is_sparse(X):
+                    raw = plan.raw_scores_binned(
+                        self.train_data.binned.apply(X))
+                else:
+                    raw = plan.raw_scores(X)
+                return raw[:, 0] if k == 1 else raw
+        host_bins = self.train_data.binned.apply(X)
+        nan_bins_np = self.train_data.binned.nan_bins
         bins = None if use_native else jnp.asarray(host_bins)
         nan_bins = None if use_native else self.meta_dev["nan_bins"]
         out = np.zeros((n, k), np.float64)
@@ -1124,14 +1171,15 @@ class GBDT:
             # Margin-based early exit runs on the host raw-threshold trees
             # (reference Predictor + prediction_early_stop.cpp); the
             # serialized mirror is cached and rebuilt only when trees were
-            # added/removed since.
+            # added/removed — or rewritten in place (_pred_version) — since.
             from ..binning import _is_sparse
             from ..serialization import load_model_string, model_to_string
             if _is_sparse(X):
                 X = np.asarray(X.todense(), np.float64)
+            mirror_key = (self.num_trees, self._pred_version)
             cache = getattr(self, "_loaded_mirror", None)
-            if cache is None or cache[0] != self.num_trees:
-                cache = (self.num_trees,
+            if cache is None or cache[0] != mirror_key:
+                cache = (mirror_key,
                          load_model_string(
                              model_to_string(self, fold_bias=False)))
                 self._loaded_mirror = cache
@@ -1150,6 +1198,10 @@ class GBDT:
         if self.iter_ == 0:
             return
         self._nls_pending = None   # handles refer to the dropped trees
+        # Rollback then retraining restores an earlier (iter_, num_trees)
+        # pair with DIFFERENT trees — the monotone version bump keeps every
+        # post-rollback state uniquely keyed for the serve plan cache.
+        self._pred_version += 1
         from .linear import predict_linear
         nan_bins_np = np.asarray(self.train_data.binned.nan_bins)
         for k in range(self.num_class):

@@ -185,10 +185,10 @@ def stack_trees(trees: List[Tree], max_leaves: int, num_bins: int):
     return {k: jnp.asarray(v) for k, v in out.items()}
 
 
-@jax.jit
-def predict_tree_bins_device(tree: dict, bins: jnp.ndarray,
-                             nan_bins: jnp.ndarray) -> jnp.ndarray:
-    """Single-tree vectorized traversal on device, bin space.
+def _tree_walk(tree: dict, bins: jnp.ndarray,
+               nan_bins: jnp.ndarray) -> jnp.ndarray:
+    """Single-tree vectorized traversal, bin space (trace-time body shared
+    by the jitted entry points and the serve plan's fused program).
 
     ``tree`` holds 1-D arrays (one tree's slice of :func:`stack_trees`).
     """
@@ -232,14 +232,35 @@ def predict_tree_bins_device(tree: dict, bins: jnp.ndarray,
     return jax.lax.cond(no_split, single, walk, operand=None)
 
 
-@jax.jit
-def predict_ensemble_bins_device(stacked: dict, bins: jnp.ndarray,
-                                 nan_bins: jnp.ndarray) -> jnp.ndarray:
-    """Sum of all stacked trees' outputs via ``lax.scan`` over the tree axis."""
+#: Single-tree traversal as its own XLA dispatch (training-side valid-score
+#: updates, rollback).
+predict_tree_bins_device = jax.jit(_tree_walk)
+
+
+def _ensemble_sum(stacked: dict, bins: jnp.ndarray,
+                  nan_bins: jnp.ndarray) -> jnp.ndarray:
+    """Sum of all stacked trees' outputs via ``lax.scan`` over the tree axis
+    (trace-time body: the scan's sequential f32 accumulation order is THE
+    prediction numerics, so every caller — the per-call jit below and the
+    serve plan's fused bin->score program — inlines this same function and
+    stays bitwise-identical)."""
     n = bins.shape[0]
 
     def body(acc, tree):
-        return acc + predict_tree_bins_device(tree, bins, nan_bins), None
+        return acc + _tree_walk(tree, bins, nan_bins), None
 
     acc, _ = jax.lax.scan(body, jnp.zeros(n, jnp.float32), stacked)
     return acc
+
+
+predict_ensemble_bins_device = jax.jit(_ensemble_sum)
+
+
+def forest_scores(stacked_by_class, bins: jnp.ndarray,
+                  nan_bins: jnp.ndarray) -> jnp.ndarray:
+    """(N, K) per-class ensemble sums; the class loop unrolls at trace time
+    so a multiclass forest still compiles into the caller's ONE program.
+    ``stacked_by_class`` entries may be None (a class slice with no trees)."""
+    cols = [jnp.zeros(bins.shape[0], jnp.float32) if s is None
+            else _ensemble_sum(s, bins, nan_bins) for s in stacked_by_class]
+    return jnp.stack(cols, axis=1)

@@ -555,6 +555,8 @@ class LoadedModel:
         X = np.asarray(X, np.float64)
         n = X.shape[0]
         k = self.num_class
+        # normalized like GBDT.predict_raw: no Python wraparound indexing
+        start_iteration = max(int(start_iteration), 0)
         out = np.tile(self.init_scores[None, :], (n, 1))
         per_class = [self.trees[i::k] if k > 1 else self.trees
                      for i in range(k)]

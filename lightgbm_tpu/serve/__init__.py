@@ -1,0 +1,30 @@
+"""lightgbm_tpu.serve — compiled inference serving.
+
+A trained Booster is frozen into a :class:`PredictPlan` (device-resident
+SoA tree pack + exact device binning tables + jitted raw-floats->scores
+program, cached per model slice), fronted by a :class:`Predictor` with
+shape-bucketed batching, an optional request-coalescing
+:class:`MicroBatcher`, and serving metrics.  See docs/SERVING.md.
+
+Quickstart::
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu import serve
+
+    bst = lgb.train(params, train_set, 100)
+    pred = serve.Predictor(bst)
+    pred.warmup(1024)                  # pre-compile the bucket ladder
+    scores = pred.predict(rows)        # == bst.predict(rows)
+    print(pred.metrics_snapshot())     # p50/p99, compiles, cache hits
+"""
+
+from .bucketing import BucketLadder
+from .metrics import ServeMetrics
+from .plan import (PredictPlan, cache_stats, clear_plan_cache,
+                   plan_for_model)
+from .predictor import MicroBatcher, Predictor
+
+__all__ = [
+    "BucketLadder", "MicroBatcher", "PredictPlan", "Predictor",
+    "ServeMetrics", "cache_stats", "clear_plan_cache", "plan_for_model",
+]

@@ -1,0 +1,57 @@
+"""Shape-bucketed batching: pad row counts onto a small geometric ladder.
+
+XLA compiles one executable per input shape; a serving process that sees
+arbitrary batch sizes would otherwise accumulate one compiled program per
+distinct row count (and stall a request on every new one).  Padding the
+row axis up to ``base * ratio^k`` bounds the compiled-program population
+at O(log max_batch) while wasting at most a ``ratio`` factor of compute on
+the padded rows — the standard bucketing trade every XLA serving stack
+makes (the feature axis is fixed by the model, so only rows bucket).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List
+
+
+@dataclasses.dataclass(frozen=True)
+class BucketLadder:
+    """Geometric row-count ladder: ``base, base*ratio, base*ratio^2, ...``.
+
+    Above ``exact_above`` rows, batches get their EXACT shape instead of a
+    rung: padding a multi-million-row one-shot predict by up to a
+    ``ratio`` factor costs real HBM and compute, and batches that large
+    are bulk scoring jobs (one compile each, like the legacy path), not
+    the repeated small-request traffic the ladder exists for."""
+
+    base: int = 32
+    ratio: int = 2
+    exact_above: int = 1 << 20
+
+    def __post_init__(self):
+        if self.base < 1 or self.ratio < 2:
+            raise ValueError("BucketLadder needs base >= 1 and ratio >= 2")
+
+    def bucket(self, n: int) -> int:
+        """Smallest rung >= n (n itself for n <= 0 -> base; exact for
+        n > exact_above)."""
+        if n > self.exact_above:
+            return n
+        m = self.base
+        while m < n:
+            m *= self.ratio
+        return m
+
+    def rungs_upto(self, n: int) -> List[int]:
+        """Every rung <= bucket(n), e.g. for warmup compilation (capped at
+        the first rung covering ``exact_above`` — exact-shape batches are
+        never pre-compiled)."""
+        out = [self.base]
+        while out[-1] < min(n, self.exact_above):
+            out.append(out[-1] * self.ratio)
+        return out
+
+    def max_compiles(self, max_rows: int) -> int:
+        """Upper bound on distinct padded shapes for batches <= max_rows."""
+        return len(self.rungs_upto(max_rows))

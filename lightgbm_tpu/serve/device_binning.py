@@ -1,0 +1,228 @@
+"""Device-side binning: raw f64 rows -> bin indices, bitwise-equal to the
+host :meth:`BinnedData.apply` path.
+
+The serving accelerator runs without 64-bit mode (the training stack is
+f32/int32 end-to-end), but bin boundaries are f64 midpoints of training
+values — a value and a boundary can be distinguishable ONLY in f64, so an
+f32 ``searchsorted`` would misbin rows near boundaries.  Instead of
+widening the device dtypes, binning is done in **bit space**: an IEEE-754
+double's total order equals the unsigned order of its bit pattern after a
+monotone transform (negative -> all bits flipped, positive -> sign bit
+set), so each f64 value travels to the device as two uint32 words and
+every ``bound < value`` decision is an exact 32-bit lexicographic compare.
+The whole pipeline — key transform, per-feature lower-bound search,
+NaN / zero-as-missing routing, categorical vocabulary lookup — is integer
+ALU work that fuses into the caller's single XLA program.
+
+Categorical columns replicate the host LUT semantics (truncate toward
+zero, unseen/negative/non-finite -> last bin) by extracting the integer
+part straight from the exponent/mantissa bits; vocabularies with category
+values >= 2^31 fall back to host binning (``build_bin_tables`` returns
+None), exactly mirroring the host LUT's practical range.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..binning import _KZERO_HI, _KZERO_LO, MISSING_ZERO, BinMapper
+
+_SIGN64 = np.uint64(1) << np.uint64(63)
+_U32 = np.uint64(0xFFFFFFFF)
+
+
+def f64_sort_keys(values: np.ndarray):
+    """Host side: f64 array -> (hi, lo) uint32 monotone sort keys.
+
+    For non-NaN a, b:  a < b  <=>  key(a) < key(b) lexicographically.
+    (The only widening is -0.0 < +0.0, which total-order treats as strict;
+    bin boundaries are midpoints of distinct values and can never be -0.0,
+    so the binning decisions are unaffected.)
+    """
+    b = np.ascontiguousarray(np.asarray(values, np.float64)).view(np.uint64)
+    key = np.where((b >> np.uint64(63)) == 1, ~b, b | _SIGN64)
+    return ((key >> np.uint64(32)).astype(np.uint32),
+            (key & _U32).astype(np.uint32))
+
+
+def float_bits(X: np.ndarray):
+    """Raw IEEE bit halves of a dense f64 matrix: ``(hi, lo)`` uint32 arrays
+    of X's shape.  This is the ONLY per-request host compute on the serve
+    hot path (a reinterpreting view + split); the monotone key transform
+    runs on device inside the compiled program."""
+    X = np.ascontiguousarray(np.asarray(X, np.float64))
+    w = X.view(np.uint32).reshape(X.shape + (2,))
+    if sys.byteorder == "little":
+        return np.ascontiguousarray(w[..., 1]), np.ascontiguousarray(w[..., 0])
+    return np.ascontiguousarray(w[..., 0]), np.ascontiguousarray(w[..., 1])
+
+
+def _scalar_key(v: float):
+    hi, lo = f64_sort_keys(np.asarray([v]))
+    return int(hi[0]), int(lo[0])
+
+
+def _steps_for(count: int) -> int:
+    """Fixed trip count for a lower-bound binary search over ``count``."""
+    return max(int(np.ceil(np.log2(count + 1))), 1)
+
+
+def build_bin_tables(mappers: List[BinMapper]) -> Optional[dict]:
+    """Flatten per-feature mappers into the device arrays ``bin_rows_device``
+    consumes.  Returns None when device binning cannot reproduce the host
+    path exactly (categorical values >= 2^31, outside the host LUT's
+    practical range) — callers fall back to host binning."""
+    f = len(mappers)
+    if f == 0:
+        return None
+    bv = 1   # padded bound axis (numeric searched-bound count)
+    cmax = 1  # padded categorical vocabulary axis
+    for m in mappers:
+        if m.is_categorical:
+            if m.categories is not None and len(m.categories):
+                if int(m.categories.max()) >= 2 ** 31:
+                    return None
+                cmax = max(cmax, len(m.categories))
+        elif m.upper_bounds is not None:
+            n_value_bins = m.num_bins - (1 if m.has_nan_bin else 0)
+            bv = max(bv, n_value_bins - 1)
+    ub = np.full((f, bv), np.inf, np.float64)
+    nvb = np.zeros(f, np.int32)        # searched bounds per feature
+    nan_target = np.zeros(f, np.int32)  # bin of NaN rows (nan_bin or 0)
+    last_bin = np.zeros(f, np.int32)
+    zam = np.zeros(f, bool)
+    is_cat = np.zeros(f, bool)
+    cat_vals = np.full((f, cmax), np.int32(2 ** 31 - 1), np.int32)
+    cat_bins = np.zeros((f, cmax), np.int32)
+    cat_n = np.zeros(f, np.int32)
+    for j, m in enumerate(mappers):
+        last_bin[j] = m.num_bins - 1
+        if m.has_nan_bin:
+            nan_target[j] = m.nan_bin
+        if m.is_categorical:
+            is_cat[j] = True
+            cats = (np.asarray(m.categories, np.int64)
+                    if m.categories is not None else np.zeros(0, np.int64))
+            order = np.argsort(cats, kind="stable")
+            cat_n[j] = len(cats)
+            cat_vals[j, : len(cats)] = cats[order].astype(np.int32)
+            cat_bins[j, : len(cats)] = order.astype(np.int32)
+            continue
+        zam[j] = m.missing_type == MISSING_ZERO
+        if m.upper_bounds is None:
+            continue
+        n_value_bins = m.num_bins - (1 if m.has_nan_bin else 0)
+        k = max(n_value_bins - 1, 0)
+        nvb[j] = k
+        ub[j, :k] = np.asarray(m.upper_bounds[:k], np.float64)
+    ub_hi, ub_lo = f64_sort_keys(ub)
+    return {
+        "ub_hi": jnp.asarray(ub_hi), "ub_lo": jnp.asarray(ub_lo),
+        "nvb": jnp.asarray(nvb),
+        "nan_target": jnp.asarray(nan_target),
+        "last_bin": jnp.asarray(last_bin),
+        "zam": jnp.asarray(zam), "is_cat": jnp.asarray(is_cat),
+        "cat_vals": jnp.asarray(cat_vals), "cat_bins": jnp.asarray(cat_bins),
+        "cat_n": jnp.asarray(cat_n),
+        # static (trace-time) scalars
+        "_steps_num": _steps_for(bv),
+        "_steps_cat": _steps_for(cmax),
+        "_kz_lo": _scalar_key(_KZERO_LO),
+        "_kz_hi": _scalar_key(_KZERO_HI),
+    }
+
+
+def _lex_lt(ahi, alo, bhi, blo):
+    return (ahi < bhi) | ((ahi == bhi) & (alo < blo))
+
+
+def _lower_bound(gather_lt, n_rows, num_feat, right0, steps):
+    """#{j < right0[f] : bound[f, j] < value} via a fixed-trip binary
+    search; ``gather_lt(f_idx, mid)`` answers bound[f, mid] < value."""
+    f_idx = jnp.broadcast_to(jnp.arange(num_feat, dtype=jnp.int32),
+                             (n_rows, num_feat))
+    lo_i = jnp.zeros((n_rows, num_feat), jnp.int32)
+    hi_i = jnp.broadcast_to(right0.astype(jnp.int32), (n_rows, num_feat))
+
+    def body(_, st):
+        lo_i, hi_i = st
+        act = lo_i < hi_i
+        mid = (lo_i + hi_i) >> 1
+        less = gather_lt(f_idx, mid)
+        lo_i = jnp.where(act & less, mid + 1, lo_i)
+        hi_i = jnp.where(act & ~less, mid, hi_i)
+        return lo_i, hi_i
+
+    lo_i, _ = jax.lax.fori_loop(0, steps, body, (lo_i, hi_i))
+    return lo_i, f_idx
+
+
+def _trunc_toward_zero(hi, lo):
+    """Integer part of the f64 encoded by bit halves (hi, lo), exactly,
+    for |v| < 2^31.  Returns (vi int32 >= 0, unseen bool) where ``unseen``
+    marks values the host LUT maps to the last bin (negative integer part,
+    |v| >= 2^31, inf, NaN)."""
+    e = ((hi >> jnp.uint32(20)) & jnp.uint32(0x7FF)).astype(jnp.int32)
+    exp = e - 1023
+    mhi = (hi & jnp.uint32(0xFFFFF)) | jnp.uint32(0x100000)
+    neg = (hi >> jnp.uint32(31)) == 1
+    shift = 52 - exp
+    in_small = (exp >= 0) & (exp <= 20)    # shift in [32, 52]: lo shifts out
+    in_big = (exp >= 21) & (exp <= 30)     # shift in [22, 31]
+    sh_s = jnp.clip(shift - 32, 0, 31).astype(jnp.uint32)
+    sh_b = jnp.clip(shift, 0, 31).astype(jnp.uint32)
+    sh_bl = jnp.clip(32 - shift, 0, 31).astype(jnp.uint32)
+    v_small = (mhi >> sh_s).astype(jnp.int32)
+    v_big = ((mhi << sh_bl) | (lo >> sh_b)).astype(jnp.int32)
+    vi = jnp.where(in_small, v_small, jnp.where(in_big, v_big, 0))
+    vi = jnp.where(exp < 0, 0, vi)         # |v| < 1 truncates to 0
+    non_finite = e == 0x7FF
+    too_big = (~non_finite) & (exp >= 31)
+    unseen = non_finite | too_big | (neg & (vi != 0))
+    return vi, unseen
+
+
+def bin_rows_device(tables: dict, hi: jnp.ndarray, lo: jnp.ndarray):
+    """(N, F) int32 bins from the f64 bit halves — trace-time function, no
+    host sync; meant to be inlined into one jitted predict program."""
+    n, f = hi.shape
+    neg = (hi >> jnp.uint32(31)) == 1
+    khi = jnp.where(neg, ~hi, hi ^ jnp.uint32(0x80000000))
+    klo = jnp.where(neg, ~lo, lo)
+    isnan = (((hi & jnp.uint32(0x7FF00000)) == jnp.uint32(0x7FF00000))
+             & (((hi & jnp.uint32(0xFFFFF)) | lo) != 0))
+
+    # ---- numeric: lower-bound over the feature's finite bound keys
+    ub_hi, ub_lo = tables["ub_hi"], tables["ub_lo"]
+
+    def num_lt(f_idx, mid):
+        return _lex_lt(ub_hi[f_idx, mid], ub_lo[f_idx, mid], khi, klo)
+
+    nbin, _ = _lower_bound(num_lt, n, f, tables["nvb"], tables["_steps_num"])
+    kz_lo, kz_hi = tables["_kz_lo"], tables["_kz_hi"]
+    in_zero = (_lex_lt(jnp.uint32(kz_lo[0]), jnp.uint32(kz_lo[1]), khi, klo)
+               & _lex_lt(khi, klo, jnp.uint32(kz_hi[0]), jnp.uint32(kz_hi[1])))
+    nbin = jnp.where(tables["zam"][None, :] & in_zero & ~isnan,
+                     tables["nan_target"][None, :], nbin)
+    nbin = jnp.where(isnan, tables["nan_target"][None, :], nbin)
+
+    # ---- categorical: truncate toward zero, sorted-vocabulary lookup
+    vi, unseen = _trunc_toward_zero(hi, lo)
+    cat_vals, cat_bins = tables["cat_vals"], tables["cat_bins"]
+
+    def cat_lt(f_idx, mid):
+        return cat_vals[f_idx, mid] < vi
+
+    pos, f_idx = _lower_bound(cat_lt, n, f, tables["cat_n"],
+                              tables["_steps_cat"])
+    at = jnp.minimum(pos, cat_vals.shape[1] - 1)
+    match = ((pos < tables["cat_n"][None, :])
+             & (cat_vals[f_idx, at] == vi) & ~unseen)
+    cbin = jnp.where(match, cat_bins[f_idx, at], tables["last_bin"][None, :])
+
+    return jnp.where(tables["is_cat"][None, :], cbin, nbin)

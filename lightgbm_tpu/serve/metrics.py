@@ -1,0 +1,88 @@
+"""Serving metrics: per-request latency, batch size, queue depth, plan
+cache hits and compile counts — the gauges a serving process exports.
+
+Pure host-side bookkeeping (a lock, two bounded reservoirs, a handful of
+counters); nothing here touches the device, so observing a request costs
+nanoseconds next to the dispatch it measures.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Dict, Optional
+
+import numpy as np
+
+
+class ServeMetrics:
+    """Thread-safe request/latency/queue accounting for one Predictor."""
+
+    def __init__(self, reservoir: int = 4096):
+        self._lock = threading.Lock()
+        self._latencies = deque(maxlen=reservoir)   # seconds
+        self._batch_sizes = deque(maxlen=reservoir)
+        self.requests = 0
+        self.rows = 0
+        self.batches = 0
+        self.queue_depth = 0
+        self.max_queue_depth = 0
+        self.padded_rows = 0
+
+    # ------------------------------------------------------------- recording
+    def observe_request(self, rows: int, seconds: float) -> None:
+        with self._lock:
+            self.requests += 1
+            self.rows += int(rows)
+            self._latencies.append(float(seconds))
+
+    def observe_batch(self, rows: int, padded_to: int) -> None:
+        with self._lock:
+            self.batches += 1
+            self._batch_sizes.append(int(rows))
+            self.padded_rows += max(int(padded_to) - int(rows), 0)
+
+    def observe_queue_depth(self, depth: int) -> None:
+        with self._lock:
+            self.queue_depth = int(depth)
+            self.max_queue_depth = max(self.max_queue_depth, int(depth))
+
+    # ------------------------------------------------------------ reporting
+    def latency_quantiles_ms(self) -> Dict[str, Optional[float]]:
+        with self._lock:
+            lat = np.asarray(self._latencies, np.float64)
+        if lat.size == 0:
+            return {"p50_ms": None, "p99_ms": None, "mean_ms": None}
+        return {
+            "p50_ms": float(np.percentile(lat, 50) * 1e3),
+            "p99_ms": float(np.percentile(lat, 99) * 1e3),
+            "mean_ms": float(lat.mean() * 1e3),
+        }
+
+    def snapshot(self, plan=None) -> Dict:
+        """One flat dict of every gauge; ``plan`` adds its cache/compile
+        counters (the fields docs/SERVING.md documents)."""
+        with self._lock:
+            bs = np.asarray(self._batch_sizes, np.float64)
+            out = {
+                "requests": self.requests,
+                "rows": self.rows,
+                "batches": self.batches,
+                "queue_depth": self.queue_depth,
+                "max_queue_depth": self.max_queue_depth,
+                "padded_rows": self.padded_rows,
+                "mean_batch_rows": float(bs.mean()) if bs.size else None,
+            }
+        out.update(self.latency_quantiles_ms())
+        if plan is not None:
+            out["compiles"] = plan.compile_count()
+            # PROCESS-GLOBAL cache counters (docs/SERVING.md): the plan
+            # cache is shared by every Predictor and routed Booster.predict
+            # in this process, so hits/misses here are not per-predictor.
+            out["plan_cache"] = dict(plan_cache_stats())
+        return out
+
+
+def plan_cache_stats() -> Dict[str, int]:
+    from .plan import cache_stats
+    return cache_stats()

@@ -1,0 +1,271 @@
+"""PredictPlan: a Booster slice frozen into a cached, device-resident
+inference program.
+
+Training-side prediction (``GBDT._predict_raw_own``) re-runs host binning
+and re-builds the SoA tree pack on EVERY call; the reference instead keeps
+a long-lived ``Predictor`` with pre-extracted traversal state
+(``src/application/predictor.cpp``), and the GPU-boosting literature
+(arXiv:1706.08359, arXiv:1806.11248) is blunt that batched device
+traversal only pays off once the model stays resident and dispatch
+overhead is amortized.  A PredictPlan is that resident state for the TPU
+build:
+
+- the ``(T, ...)`` stacked tree arrays per class (built ONCE from the host
+  mirrors, uploaded once),
+- the binning tables (bound sort keys, categorical vocabularies,
+  NaN / zero-as-missing routing — serve/device_binning.py),
+- two jitted programs: raw f64 bits -> bins -> per-class scores, and
+  pre-binned rows -> scores (the sparse-input path),
+- shape bucketing + compile accounting.
+
+Plans are cached per ``(model identity, iteration slice, model version)``
+so repeated predicts never re-stack or re-upload; the cache keeps hit /
+miss / build / eviction counters (assertable from tests and exported by
+the serving metrics).
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.tree import forest_scores, stack_trees
+from .bucketing import BucketLadder
+from .device_binning import bin_rows_device, build_bin_tables, float_bits
+
+
+class PredictPlan:
+    """Frozen, device-resident predict state for one Booster slice."""
+
+    def __init__(self, model, start_iteration: int, end_iteration: int,
+                 ladder: Optional[BucketLadder] = None):
+        binned = model.train_data.binned
+        self._model_ref = weakref.ref(model)
+        self.start_iteration = int(start_iteration)
+        self.end_iteration = int(end_iteration)
+        self.num_class = int(model.num_class)
+        self.num_features = int(binned.num_features)
+        self.init_scores = np.asarray(model.init_scores, np.float64).copy()
+        self.ladder = ladder or BucketLadder()
+        tables = build_bin_tables(binned.mappers)
+        if tables is None:
+            raise ValueError("device binning unavailable for this dataset")
+        self._tables = tables
+        # ONE batched host transfer for ONLY the sliced iterations
+        # (host_trees materializes lazily per range), then one stack+upload
+        # per class — the only time this plan touches the host mirrors.
+        trees_by_class = model.host_trees(self.start_iteration,
+                                          self.end_iteration)
+        self.num_trees = sum(len(t) for t in trees_by_class)
+        self._stacked = [
+            stack_trees(trees, model.cfg.num_leaves, binned.max_num_bins)
+            if trees else None
+            for trees in trees_by_class]
+        self._nan_bins = jnp.asarray(binned.nan_bins, jnp.int32)
+        self.stack_count = 1          # re-stacks would increment (never do)
+
+        def _from_bits(hi, lo):
+            bins = bin_rows_device(self._tables, hi, lo)
+            return forest_scores(self._stacked, bins, self._nan_bins)
+
+        def _from_bins(bins):
+            return forest_scores(self._stacked, bins, self._nan_bins)
+
+        self._predict_bits = jax.jit(_from_bits)
+        self._predict_binned = jax.jit(_from_bins)
+        self._shapes = set()          # padded (kind, rows) this plan compiled
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------ accounting
+    def compile_count(self) -> int:
+        """Distinct compiled programs behind this plan.  Prefers the jit
+        executable-cache sizes (actual XLA compiles); falls back to the
+        padded-shape census when running on a jax without ``_cache_size``."""
+        n = 0
+        for fn in (self._predict_bits, self._predict_binned):
+            try:
+                n += int(fn._cache_size())
+            except Exception:  # noqa: BLE001 — older jax: census fallback
+                with self._lock:
+                    return len(self._shapes)
+        return n
+
+    def _note_shape(self, kind: str, padded: int) -> None:
+        with self._lock:
+            self._shapes.add((kind, padded))
+
+    def is_for(self, model) -> bool:
+        return self._model_ref() is model
+
+    # ------------------------------------------------------------ prediction
+    def _pad(self, arrs, n: int):
+        padded = self.ladder.bucket(n)
+        if padded == n:
+            return arrs, padded
+        return [np.pad(a, ((0, padded - n), (0, 0))) for a in arrs], padded
+
+    def raw_scores(self, X, metrics=None) -> np.ndarray:
+        """(N, K) f64 raw scores (init scores included) for dense rows —
+        host work is one bit-split view + ladder pad; binning, traversal
+        and per-class accumulation run as ONE jitted dispatch."""
+        X = np.asarray(X)
+        n = X.shape[0]
+        if X.ndim != 2 or X.shape[1] != self.num_features:
+            raise ValueError(
+                f"plan expects (N, {self.num_features}) rows, got {X.shape}")
+        if n == 0:
+            return np.zeros((0, self.num_class), np.float64) \
+                + self.init_scores[None, :]
+        hi, lo = float_bits(X)
+        (hi, lo), padded = self._pad([hi, lo], n)
+        self._note_shape("bits", padded)
+        scores = self._predict_bits(jnp.asarray(hi), jnp.asarray(lo))
+        if metrics is not None:
+            metrics.observe_batch(n, padded)
+        out = np.asarray(jax.device_get(scores), np.float64)[:n]
+        out += self.init_scores[None, :]
+        return out
+
+    def raw_scores_binned(self, bins: np.ndarray, metrics=None) -> np.ndarray:
+        """(N, K) f64 raw scores from PRE-BINNED rows (the sparse-input
+        path: host binning straight from CSC, device traversal from the
+        resident pack — still no re-stacking)."""
+        bins = np.asarray(bins)
+        n = bins.shape[0]
+        if n == 0:
+            return np.zeros((0, self.num_class), np.float64) \
+                + self.init_scores[None, :]
+        (bins,), padded = self._pad([bins], n)
+        self._note_shape("binned", padded)
+        scores = self._predict_binned(jnp.asarray(bins))
+        if metrics is not None:
+            metrics.observe_batch(n, padded)
+        out = np.asarray(jax.device_get(scores), np.float64)[:n]
+        out += self.init_scores[None, :]
+        return out
+
+    def warmup(self, max_rows: int) -> int:
+        """Pre-compile the dense-path program for every ladder rung up to
+        ``bucket(max_rows)``; returns the number of rungs warmed."""
+        rungs = self.ladder.rungs_upto(max_rows)
+        for m in rungs:
+            self.raw_scores(np.zeros((m, self.num_features)))
+        return len(rungs)
+
+
+# ---------------------------------------------------------------- plan cache
+_CACHE: "OrderedDict[tuple, PredictPlan]" = OrderedDict()
+_CACHE_LOCK = threading.Lock()
+_CACHE_CAP = 8
+_STATS = {"hits": 0, "misses": 0, "builds": 0, "evictions": 0}
+# Per-key in-flight build markers: N threads missing the same key must run
+# ONE stack+upload, not N (the losers wait on the winner's Event).
+_INFLIGHT: Dict[tuple, threading.Event] = {}
+
+
+def _stale_locked(key, plan) -> bool:
+    """A cache entry is stale when its model was garbage-collected or has
+    trained/rolled past the keyed (iter_, num_trees) state — the key can
+    never hit again, but the entry would pin a device-resident tree pack
+    until cap pressure evicted it."""
+    model = plan._model_ref()
+    if model is None:
+        return True
+    return (int(model.iter_), int(model.num_trees),
+            int(getattr(model, "_pred_version", 0))) != key[3:6]
+
+
+def _sweep_dead_locked() -> None:
+    """Drop stale entries (caller holds _CACHE_LOCK)."""
+    for k in [k for k, p in _CACHE.items() if _stale_locked(k, p)]:
+        del _CACHE[k]
+        _STATS["evictions"] += 1
+
+
+def _resolve_slice(model, num_iteration: Optional[int],
+                   start_iteration: int):
+    # dev_models (not the .models property): a cache HIT must not touch —
+    # let alone materialize — the host tree mirrors.
+    n = len(model.dev_models[0]) if model.dev_models else 0
+    start = max(int(start_iteration), 0)
+    end = n if num_iteration is None else min(n, start + int(num_iteration))
+    return start, max(end, start)
+
+
+def plan_for_model(model, num_iteration: Optional[int] = None,
+                   start_iteration: int = 0,
+                   ladder: Optional[BucketLadder] = None
+                   ) -> Optional[PredictPlan]:
+    """Fetch (or build) the cached PredictPlan for a GBDT slice.
+
+    The key carries the model's identity AND its mutation state (``iter_``,
+    ``num_trees``, ``_pred_version`` — the latter bumped by in-place leaf
+    mutations like the C-API's SetLeafValue/Refit): training another
+    round, rolling one back, or rewriting leaves changes the key, so a
+    stale pack can never serve.  Returns None when the dataset cannot be
+    device-binned exactly (callers fall back to the legacy host path);
+    that verdict is dataset-level and permanent, so it is memoized on the
+    model — the hot predict path must not re-derive the bin tables just
+    to fail again."""
+    if getattr(model, "_serve_unsupported", False):
+        return None
+    ladder = ladder or BucketLadder()
+    start, end = _resolve_slice(model, num_iteration, start_iteration)
+    key = (id(model), start, end, int(model.iter_), int(model.num_trees),
+           int(getattr(model, "_pred_version", 0)), ladder)
+    while True:
+        with _CACHE_LOCK:
+            plan = _CACHE.get(key)
+            # id() can be recycled after GC — the weakref check makes a
+            # hit structural, not just numeric.
+            if plan is not None and plan.is_for(model):
+                _STATS["hits"] += 1
+                _CACHE.move_to_end(key)
+                # sweep on hits too: a steady stream of cache hits must
+                # not pin dead models' tree packs until the next build
+                _sweep_dead_locked()
+                return plan
+            ev = _INFLIGHT.get(key)
+            if ev is None:
+                _INFLIGHT[key] = threading.Event()
+                _STATS["misses"] += 1
+                break
+        # Another thread is stacking/uploading this exact plan — wait for
+        # it, then re-check (if it failed, the loop makes us the builder).
+        ev.wait()
+    plan = None
+    try:
+        plan = PredictPlan(model, start, end, ladder=ladder)
+    except ValueError:
+        model._serve_unsupported = True
+        return None
+    finally:
+        with _CACHE_LOCK:
+            if plan is not None:
+                _STATS["builds"] += 1
+                _CACHE[key] = plan
+                _CACHE.move_to_end(key)
+                _sweep_dead_locked()
+                while len(_CACHE) > _CACHE_CAP:
+                    _CACHE.popitem(last=False)
+                    _STATS["evictions"] += 1
+            _INFLIGHT.pop(key).set()
+    return plan
+
+
+def cache_stats() -> Dict[str, int]:
+    with _CACHE_LOCK:
+        return dict(_STATS, size=len(_CACHE))
+
+
+def clear_plan_cache() -> None:
+    with _CACHE_LOCK:
+        _CACHE.clear()
+        for k in ("hits", "misses", "builds", "evictions"):
+            _STATS[k] = 0

@@ -1,0 +1,248 @@
+"""Serving front end: :class:`Predictor` (compiled predicts over a frozen
+plan, with metrics) and :class:`MicroBatcher` (a queue that coalesces
+small requests into one device dispatch up to a max wait).
+
+``Predictor.predict`` matches ``Booster.predict`` semantics for the slice
+it was frozen with (raw scores summed per class + init scores, then the
+objective's output transform) — the differential tests pin the two
+bitwise-equal on the device path.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future, InvalidStateError
+from queue import Empty, Queue
+from typing import Optional
+
+import numpy as np
+
+from ..binning import _is_sparse
+from ..utils.log import Log
+from .bucketing import BucketLadder
+from .metrics import ServeMetrics
+from .plan import plan_for_model
+
+
+class Predictor:
+    """Long-lived compiled inference handle for one Booster slice
+    (reference ``Predictor``, ``src/application/predictor.cpp``: extract
+    traversal state once, then only traverse)."""
+
+    def __init__(self, booster, *, raw_score: bool = False,
+                 num_iteration: Optional[int] = None,
+                 start_iteration: int = 0,
+                 ladder: Optional[BucketLadder] = None,
+                 max_compiles: int = 16):
+        model = getattr(booster, "_gbdt", booster)
+        if not hasattr(model, "train_data"):
+            raise ValueError(
+                "serve.Predictor needs a dataset-backed booster (training "
+                "Booster or GBDT); a text-loaded model carries no bin "
+                "mappers — retrain or keep its Booster.predict path")
+        if getattr(model, "base_model", None) is not None:
+            raise ValueError(
+                "serve.Predictor does not support continuation boosters "
+                "(base_model); save_model() and retrain, or use "
+                "Booster.predict")
+        if model.cfg.linear_tree:
+            raise ValueError(
+                "serve.Predictor does not support linear trees (leaf "
+                "models need raw-value host math); use Booster.predict")
+        if num_iteration is None and getattr(booster, "best_iteration", -1) > 0:
+            num_iteration = booster.best_iteration
+        self._model = model
+        self._raw_score = bool(raw_score)
+        self.plan = plan_for_model(model, num_iteration, start_iteration,
+                                   ladder=ladder)
+        if self.plan is None:
+            raise ValueError(
+                "device binning cannot reproduce this dataset's bin "
+                "mappers exactly (categorical values >= 2^31); use "
+                "Booster.predict")
+        self.metrics = ServeMetrics()
+        self.max_compiles = int(max_compiles)
+        self._compile_warned = False
+
+    # ------------------------------------------------------------------ API
+    @property
+    def num_features(self) -> int:
+        return self.plan.num_features
+
+    def predict(self, X, _record: bool = True) -> np.ndarray:
+        """Scores for a batch of rows — one compiled dispatch, recorded in
+        the serving metrics.  Accepts dense arrays (device binning) or
+        scipy sparse (host binning from CSC, device traversal)."""
+        t0 = time.perf_counter()
+        if _is_sparse(X):
+            if X.shape[1] != self.plan.num_features:
+                # same clear error the dense path raises, instead of an
+                # IndexError deep inside column-wise sparse binning
+                raise ValueError(
+                    f"plan expects (N, {self.plan.num_features}) rows, "
+                    f"got {X.shape}")
+            bins = self._model.train_data.binned.apply(X)
+            raw = self.plan.raw_scores_binned(bins, metrics=self.metrics)
+            n = bins.shape[0]
+        else:
+            X = np.asarray(X, np.float64)
+            if X.ndim == 1:
+                X = X.reshape(1, -1)
+            raw = self.plan.raw_scores(X, metrics=self.metrics)
+            n = X.shape[0]
+        out = raw[:, 0] if self.plan.num_class == 1 else raw
+        obj = getattr(self._model, "objective", None)
+        if not self._raw_score and obj is not None:
+            # The output transform runs EXACTLY as Booster.predict runs it
+            # (host f64 -> f32 upload -> eager convert_output): fusing it
+            # into the plan's jitted program would change the rounding
+            # sequence and break the pinned bitwise parity.  It is one
+            # extra small dispatch; latency-critical raw-margin serving
+            # should pass raw_score=True (docs/SERVING.md).
+            import jax
+            import jax.numpy as jnp
+            out = np.asarray(jax.device_get(
+                obj.convert_output(jnp.asarray(out))))
+        if _record:   # the microbatcher records per-CALLER requests itself
+            self.metrics.observe_request(n, time.perf_counter() - t0)
+        self._check_compile_guard()
+        return out
+
+    def warmup(self, max_rows: int = 1024) -> int:
+        """Compile every ladder rung up to ``max_rows`` ahead of traffic."""
+        return self.plan.warmup(max_rows)
+
+    def batcher(self, max_batch: int = 1024,
+                max_wait_ms: float = 2.0) -> "MicroBatcher":
+        return MicroBatcher(self, max_batch=max_batch,
+                            max_wait_ms=max_wait_ms)
+
+    def metrics_snapshot(self) -> dict:
+        return self.metrics.snapshot(plan=self.plan)
+
+    # ------------------------------------------------------------- internals
+    def _check_compile_guard(self) -> None:
+        """Compile-count guard: the ladder should hold compiles at
+        O(log max_batch); blowing past ``max_compiles`` means bucketing is
+        mis-sized (ratio too fine, pathological size mix) — warn once."""
+        if self._compile_warned:
+            return
+        n = self.plan.compile_count()
+        if n > self.max_compiles:
+            self._compile_warned = True
+            Log.warning(
+                f"serve: {n} compiled predict programs exceed the guard "
+                f"({self.max_compiles}); widen the BucketLadder ratio or "
+                "warmup() the expected sizes")
+
+
+class MicroBatcher:
+    """Coalesces small predict requests into one device dispatch.
+
+    ``submit`` returns a Future; a worker thread drains the queue, waits at
+    most ``max_wait_ms`` from the first queued request (or until
+    ``max_batch`` rows accumulate), predicts ONCE, and slices results back
+    per request.  Queue depth / batch sizes / per-request latency land in
+    the predictor's metrics.
+    """
+
+    def __init__(self, predictor: Predictor, *, max_batch: int = 1024,
+                 max_wait_ms: float = 2.0):
+        self.predictor = predictor
+        self.max_batch = int(max_batch)
+        self.max_wait_s = float(max_wait_ms) / 1e3
+        self._queue: Queue = Queue()
+        self._closed = False
+        # Serializes submits against close(): the None sentinel must be the
+        # LAST item ever enqueued, or a racing submit's Future would sit
+        # behind it on a dead queue and never resolve.
+        self._submit_lock = threading.Lock()
+        self._worker = threading.Thread(target=self._run, daemon=True)
+        self._worker.start()
+
+    def submit(self, X) -> Future:
+        """Enqueue rows (1-D row or small 2-D batch); resolves to the same
+        scores ``predictor.predict`` would return for them."""
+        X = np.asarray(X, np.float64)
+        if X.ndim == 1:
+            X = X.reshape(1, -1)
+        if X.ndim != 2 or X.shape[1] != self.predictor.num_features:
+            # reject HERE: a malformed request inside a coalesced batch
+            # would otherwise fail every innocent co-batched caller
+            raise ValueError(
+                f"expected rows with {self.predictor.num_features} "
+                f"features, got {X.shape}")
+        fut: Future = Future()
+        with self._submit_lock:
+            if self._closed:
+                raise RuntimeError("MicroBatcher is closed")
+            self._queue.put((X, fut, time.perf_counter()))
+        self.predictor.metrics.observe_queue_depth(self._queue.qsize())
+        return fut
+
+    def close(self) -> None:
+        with self._submit_lock:
+            if self._closed:
+                return
+            self._closed = True
+            self._queue.put(None)
+        self._worker.join(timeout=60)
+
+    # ------------------------------------------------------------- internals
+    def _run(self) -> None:
+        while True:
+            item = self._queue.get()
+            if item is None:
+                return
+            batch = [item]
+            rows = item[0].shape[0]
+            deadline = time.perf_counter() + self.max_wait_s
+            while rows < self.max_batch:
+                timeout = deadline - time.perf_counter()
+                if timeout <= 0:
+                    break
+                try:
+                    nxt = self._queue.get(timeout=timeout)
+                except Empty:
+                    break
+                if nxt is None:
+                    self._flush(batch)
+                    return
+                batch.append(nxt)
+                rows += nxt[0].shape[0]
+            self.predictor.metrics.observe_queue_depth(self._queue.qsize())
+            self._flush(batch)
+
+    @staticmethod
+    def _settle(fut: Future, value=None, exc=None) -> bool:
+        """Resolve a Future, tolerating callers that cancelled it while it
+        was queued — an InvalidStateError here must not kill the worker
+        loop (every later submit would then hang on a dead queue)."""
+        try:
+            if exc is not None:
+                fut.set_exception(exc)
+            else:
+                fut.set_result(value)
+            return True
+        except InvalidStateError:
+            return False
+
+    def _flush(self, batch) -> None:
+        xs = [x for x, _f, _t in batch]
+        try:
+            out = self.predictor.predict(np.concatenate(xs, axis=0),
+                                         _record=False)
+        except Exception as e:  # noqa: BLE001 — fail every caller, not the loop
+            for _x, fut, _t in batch:
+                self._settle(fut, exc=e)
+            return
+        done = time.perf_counter()
+        lo = 0
+        for x, fut, t_in in batch:
+            hi = lo + x.shape[0]
+            if self._settle(fut, out[lo:hi]):
+                # queue wait + coalesced dispatch, from the caller's view
+                self.predictor.metrics.observe_request(x.shape[0],
+                                                       done - t_in)
+            lo = hi

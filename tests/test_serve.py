@@ -1,0 +1,357 @@
+"""lightgbm_tpu.serve: compiled inference serving.
+
+Pins the subsystem's contract (ISSUE 2 acceptance criteria):
+- device binning bitwise-equal to the host ``BinnedData.apply`` path
+  (dense / NaN / categorical / zero_as_missing / f64-only boundary cases),
+- ``serve.Predictor`` bitwise-equal to ``Booster.predict``'s device path
+  (incl. NaN + categorical + multiclass),
+- <= 6 XLA compiles over 20 distinct warm batch sizes (bucket ladder),
+- zero re-stacking/re-upload on repeat calls (plan cache hit counter),
+- the microbatcher returns exactly what direct predicts would,
+- the native-cutoff config knob (env var still overrides).
+
+A module-scoped booster/plan is shared by the read-only tests (XLA:CPU
+compile time dominates; one plan serves them all through the cache);
+tests that mutate the model or assert cache counters run LAST and clear
+the cache explicitly.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import serve
+from lightgbm_tpu.binning import bin_dataset, find_bin
+from lightgbm_tpu.serve.bucketing import BucketLadder
+from lightgbm_tpu.serve.device_binning import (bin_rows_device,
+                                               build_bin_tables, float_bits)
+
+
+def _device_path(monkeypatch):
+    """Force Booster.predict onto the LEGACY device path (no serve routing,
+    no native traversal) — the pre-existing numerics serve must match."""
+    monkeypatch.setenv("LIGHTGBM_TPU_SERVE", "0")
+    monkeypatch.setenv("LIGHTGBM_TPU_NATIVE_PREDICT_MAX_ROWS", "0")
+
+
+def _messy_data(n=1600, f=6, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f) * np.array([1.0, 50.0, 1e-3, 1e5, 1.0, 1.0])[:f]
+    X[rng.rand(n, f) < 0.08] = np.nan
+    if f > 4:
+        X[:, 4] = rng.randint(0, 9, n)
+        X[rng.rand(n) < 0.04, 4] = 777    # unseen at predict for some rows
+    y = (X[:, 0] + np.nan_to_num(X[:, 1]) / 50.0 > 0).astype(np.float64)
+    return X, y
+
+
+P = {"objective": "binary", "num_leaves": 15, "min_data_in_leaf": 5,
+     "verbosity": -1, "categorical_feature": "4"}
+
+
+@pytest.fixture(scope="module")
+def messy():
+    return _messy_data()
+
+
+@pytest.fixture(scope="module")
+def bst(messy):
+    X, y = messy
+    return lgb.train(P, lgb.Dataset(X, label=y), 8)
+
+
+# ------------------------------------------------------------ device binning
+def test_device_binning_bitwise_messy(messy):
+    X, _ = messy
+    binned = bin_dataset(X, max_bin=63, categorical_features=[4])
+    tables = build_bin_tables(binned.mappers)
+    hi, lo = float_bits(X)
+    import jax.numpy as jnp
+    dev = np.asarray(bin_rows_device(tables, jnp.asarray(hi),
+                                     jnp.asarray(lo)))
+    np.testing.assert_array_equal(binned.apply(X).astype(np.int32), dev)
+
+
+def test_device_binning_bitwise_boundaries():
+    """Values distinguishable from a bound only in f64 (nextafter), +-0,
+    subnormals, inf — the cases an f32 device searchsorted would misbin."""
+    rng = np.random.RandomState(1)
+    X = rng.randn(4000, 2)
+    binned = bin_dataset(X, max_bin=127)
+    m = binned.mappers[0]
+    vals = []
+    for u in m.upper_bounds[:-1]:
+        vals += [u, np.nextafter(u, -np.inf), np.nextafter(u, np.inf)]
+    vals += [0.0, -0.0, 1e-300, -1e-300, 5e-324, np.inf, -np.inf, np.nan]
+    T = np.zeros((len(vals), 2))
+    T[:, 0] = vals
+    tables = build_bin_tables(binned.mappers)
+    hi, lo = float_bits(T)
+    import jax.numpy as jnp
+    dev = np.asarray(bin_rows_device(tables, jnp.asarray(hi),
+                                     jnp.asarray(lo)))
+    np.testing.assert_array_equal(binned.apply(T).astype(np.int32), dev)
+
+
+def test_device_binning_zero_as_missing():
+    rng = np.random.RandomState(2)
+    X = rng.randn(2000, 3)
+    X[rng.rand(2000, 3) < 0.3] = 0.0
+    X[rng.rand(2000, 3) < 0.05] = 5e-36   # inside the kZeroThreshold band
+    binned = bin_dataset(X, max_bin=31, zero_as_missing=True)
+    tables = build_bin_tables(binned.mappers)
+    hi, lo = float_bits(X)
+    import jax.numpy as jnp
+    dev = np.asarray(bin_rows_device(tables, jnp.asarray(hi),
+                                     jnp.asarray(lo)))
+    np.testing.assert_array_equal(binned.apply(X).astype(np.int32), dev)
+
+
+def test_device_binning_categorical_edges():
+    """Host LUT semantics: truncate toward zero, negative/huge/non-finite
+    -> last bin; fractional codes match their truncation."""
+    rng = np.random.RandomState(3)
+    X = np.zeros((14, 2))
+    X[:, 1] = rng.randn(14)
+    X[:, 0] = [3.0, 3.9, -0.5, 0.4, 7.0, 8.0, 2.0 ** 31, 2.0 ** 40,
+               1e18, -4.0, np.nan, np.inf, -np.inf, 6.0]
+    train = np.zeros((500, 2))
+    train[:, 0] = rng.randint(0, 9, 500)
+    train[:, 1] = rng.randn(500)
+    binned = bin_dataset(train, max_bin=31, categorical_features=[0])
+    tables = build_bin_tables(binned.mappers)
+    hi, lo = float_bits(X)
+    import jax.numpy as jnp
+    with np.errstate(invalid="ignore"):
+        host = binned.apply(X).astype(np.int32)
+    dev = np.asarray(bin_rows_device(tables, jnp.asarray(hi),
+                                     jnp.asarray(lo)))
+    np.testing.assert_array_equal(host, dev)
+
+
+# -------------------------------------------------------- predictor parity
+def test_predictor_bitwise_vs_booster_device_path(messy, bst, monkeypatch):
+    X, _ = messy
+    pred = serve.Predictor(bst)
+    got = pred.predict(X[:700])
+    raw = serve.Predictor(bst, raw_score=True).predict(X[:700])
+    _device_path(monkeypatch)
+    np.testing.assert_array_equal(got, bst.predict(X[:700]))
+    np.testing.assert_array_equal(raw, bst.predict(X[:700], raw_score=True))
+
+
+def test_predictor_bitwise_multiclass(monkeypatch):
+    rng = np.random.RandomState(4)
+    X = rng.randn(1200, 5)
+    X[rng.rand(1200, 5) < 0.05] = np.nan
+    y = rng.randint(0, 3, 1200)
+    bst3 = lgb.train({"objective": "multiclass", "num_class": 3,
+                      "num_leaves": 7, "verbosity": -1},
+                     lgb.Dataset(X, label=y), 6)
+    got = serve.Predictor(bst3).predict(X[:333])
+    assert got.shape == (333, 3)
+    _device_path(monkeypatch)
+    np.testing.assert_array_equal(got, bst3.predict(X[:333]))
+
+
+def test_predictor_matches_native_path_closely(messy, bst):
+    """The small-batch native path accumulates in f64 — not bitwise, but
+    the serve scores must agree to f32 rounding."""
+    X, _ = messy
+    got = serve.Predictor(bst, raw_score=True).predict(X[:500])
+    ref = bst.predict(X[:500], raw_score=True)
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_predictor_iteration_slice(messy, bst, monkeypatch):
+    X, _ = messy
+    got = serve.Predictor(bst, raw_score=True, num_iteration=4,
+                          start_iteration=2).predict(X[:200])
+    _device_path(monkeypatch)
+    ref = bst.predict(X[:200], raw_score=True, num_iteration=4,
+                      start_iteration=2)
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_predictor_sparse_input():
+    sp = pytest.importorskip("scipy.sparse")
+    rng = np.random.RandomState(5)
+    X = rng.randn(1200, 8) * (rng.rand(1200, 8) < 0.3)
+    y = (X[:, 0] > 0).astype(np.float64)
+    bsp = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "verbosity": -1}, lgb.Dataset(X, label=y), 6)
+    pred = serve.Predictor(bsp)
+    got = pred.predict(sp.csr_matrix(X[:400]))
+    np.testing.assert_array_equal(got, pred.predict(X[:400]))
+
+
+def test_predictor_untrained_booster_and_empty_batch():
+    X, y = _messy_data(n=400)
+    b0 = lgb.Booster(params=dict(P), train_set=lgb.Dataset(X, label=y))
+    pred = serve.Predictor(b0, raw_score=True)
+    out = pred.predict(X[:10])
+    np.testing.assert_allclose(out, np.full(10, b0._gbdt.init_scores[0]))
+    assert pred.predict(X[:0]).shape == (0,)
+
+
+# -------------------------------------------------- compile + cache budgets
+def test_compile_budget_20_batch_sizes(messy, bst):
+    """<= 6 XLA compiles across 20 distinct batch sizes in [1, 1024]: the
+    geometric ladder (base 32, ratio 2) has exactly 6 rungs there."""
+    X, _ = messy
+    pred = serve.Predictor(bst)
+    rng = np.random.RandomState(6)
+    sizes = rng.choice(np.arange(1, 1025), size=20, replace=False)
+    for s in sizes:
+        pred.predict(X[: int(s)])
+    assert pred.plan.compile_count() <= 6, pred.metrics_snapshot()
+    snap = pred.metrics_snapshot()
+    assert snap["requests"] == 20
+    assert snap["p50_ms"] is not None
+
+
+def test_bucket_ladder():
+    lad = BucketLadder(base=32, ratio=2)
+    assert lad.bucket(1) == 32
+    assert lad.bucket(32) == 32
+    assert lad.bucket(33) == 64
+    assert lad.bucket(1000) == 1024
+    assert lad.rungs_upto(1024) == [32, 64, 128, 256, 512, 1024]
+    assert lad.max_compiles(1024) == 6
+    # one-shot bulk batches above exact_above take their EXACT shape —
+    # no ratio-factor padding blowup on multi-million-row predicts
+    assert lad.bucket(lad.exact_above + 12345) == lad.exact_above + 12345
+    with pytest.raises(ValueError):
+        BucketLadder(base=0)
+
+
+# ------------------------------------------------------------- microbatcher
+def test_microbatcher_coalesces_and_matches(messy, bst):
+    X, _ = messy
+    pred = serve.Predictor(bst)
+    ref = pred.predict(X[:60])
+    mb = pred.batcher(max_batch=64, max_wait_ms=20)
+    futs = [mb.submit(X[i:i + 3]) for i in range(0, 60, 3)]
+    got = np.concatenate([f.result(timeout=60) for f in futs])
+    mb.close()
+    np.testing.assert_array_equal(got, ref)
+    snap = pred.metrics_snapshot()
+    assert snap["requests"] >= 21            # 20 coalesced + 1 direct
+    assert snap["batches"] >= 2
+    assert snap["max_queue_depth"] >= 1
+    with pytest.raises(RuntimeError):
+        mb.submit(X[:1])
+
+
+def test_predictor_rejects_unsupported():
+    X, y = _messy_data(n=600, f=4)
+    blin = lgb.train(dict(P, linear_tree=True, categorical_feature=""),
+                     lgb.Dataset(X, label=y), 3)
+    with pytest.raises(ValueError, match="linear"):
+        serve.Predictor(blin)
+    loaded = lgb.Booster(model_str=lgb.train(
+        {"objective": "binary", "num_leaves": 7, "verbosity": -1},
+        lgb.Dataset(X, label=y), 3).model_to_string())
+    with pytest.raises(ValueError, match="dataset-backed"):
+        serve.Predictor(loaded)
+
+
+# ------------------------------------------------- forced-bound zero filter
+def test_forced_bounds_near_zero_filtered():
+    """Satellite (ADVICE round 5): forced bounds within kZeroThreshold
+    (1e-35) of zero are dropped, as the reference
+    FindBinWithPredefinedBin skips |bound| <= kZeroThreshold."""
+    rng = np.random.RandomState(7)
+    v = rng.randn(5000)
+    base = find_bin(v, 16, forced_upper_bounds=[0.5])
+    for z in (0.0, 1e-36, -1e-36, 1e-35, -1e-35):
+        m = find_bin(v, 16, forced_upper_bounds=[z, 0.5])
+        np.testing.assert_array_equal(m.upper_bounds, base.upper_bounds)
+    # a bound OUTSIDE the band is honored
+    kept = find_bin(v, 16, forced_upper_bounds=[1e-30, 0.5])
+    assert 1e-30 in kept.upper_bounds
+
+
+# -------------------- cache-counter tests (mutate global cache: run LAST)
+def test_plan_cache_no_restack(messy, bst, monkeypatch):
+    """Repeat Booster.predict calls routed through the plan must reuse ONE
+    build (no re-stacking / re-upload), asserted via the cache counters."""
+    monkeypatch.setenv("LIGHTGBM_TPU_NATIVE_PREDICT_MAX_ROWS", "0")
+    X, _ = messy
+    serve.clear_plan_cache()
+    for _ in range(5):
+        bst.predict(X[:300])
+    stats = serve.cache_stats()
+    assert stats["builds"] == 1
+    assert stats["hits"] == 4
+    plan = serve.plan_for_model(bst._gbdt)
+    assert plan.stack_count == 1
+
+
+def test_native_cutoff_config_knob(monkeypatch):
+    """tpu_native_predict_max_rows=0 routes everything to the device plan;
+    the env var, where set, overrides the knob."""
+    monkeypatch.delenv("LIGHTGBM_TPU_NATIVE_PREDICT_MAX_ROWS", raising=False)
+    X, y = _messy_data(n=800, f=4)
+    bk = lgb.train({"objective": "binary", "num_leaves": 15,
+                    "verbosity": -1, "tpu_native_predict_max_rows": 0},
+                   lgb.Dataset(X, label=y), 4)
+    assert bk._gbdt._native_predict_cutoff() == 0
+    serve.clear_plan_cache()
+    ref = bk.predict(X[:100], raw_score=True)
+    assert serve.cache_stats()["builds"] == 1     # device plan was used
+    # env override wins over the config knob
+    monkeypatch.setenv("LIGHTGBM_TPU_NATIVE_PREDICT_MAX_ROWS", "12345")
+    assert bk._gbdt._native_predict_cutoff() == 12345
+    np.testing.assert_allclose(bk.predict(X[:100], raw_score=True), ref,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_plan_invalidation_on_leaf_mutation(messy, bst, monkeypatch):
+    """In-place leaf rewrites (C-API SetLeafValue/Refit) change neither
+    iter_ nor num_trees — the _pred_version bump must still invalidate
+    cached plans so the device pack is rebuilt with the new leaf."""
+    import types
+    from lightgbm_tpu.capi.bridge import (booster_get_leaf_value,
+                                          booster_set_leaf_value)
+    monkeypatch.setenv("LIGHTGBM_TPU_NATIVE_PREDICT_MAX_ROWS", "0")
+    X, _ = messy
+    serve.clear_plan_cache()
+    # full training matrix: leaf 0 of tree 0 is guaranteed populated there
+    before = bst.predict(X, raw_score=True)
+    handle = types.SimpleNamespace(bst=bst)
+    old = booster_get_leaf_value(handle, 0, 0)
+    booster_set_leaf_value(handle, 0, 0, old + 5.0)
+    try:
+        after = bst.predict(X, raw_score=True)
+        assert serve.cache_stats()["builds"] == 2    # plan was rebuilt
+        diff = after - before
+        assert np.count_nonzero(diff) > 0
+        assert np.abs(diff[diff != 0] - 5.0).max() < 1e-5
+    finally:
+        booster_set_leaf_value(handle, 0, 0, old)
+    np.testing.assert_array_equal(bst.predict(X, raw_score=True), before)
+
+
+def test_plan_invalidation_on_update_and_rollback(messy, bst, monkeypatch):
+    monkeypatch.setenv("LIGHTGBM_TPU_NATIVE_PREDICT_MAX_ROWS", "0")
+    X, _ = messy
+    serve.clear_plan_cache()
+    p8 = bst.predict(X[:100], raw_score=True)
+    assert serve.cache_stats()["builds"] == 1
+    bst.update()                       # +1 round -> new key, rebuild
+    p9 = bst.predict(X[:100], raw_score=True)
+    assert serve.cache_stats()["builds"] == 2
+    assert not np.allclose(p8, p9)
+    bst.rollback_one_iter()            # back to 8 rounds -> another key
+    p8b = bst.predict(X[:100], raw_score=True)
+    assert serve.cache_stats()["builds"] == 3   # _pred_version bumped
+    np.testing.assert_array_equal(p8, p8b)
+    # rollback + RETRAIN revisits (iter_, num_trees) = (9, 9): without the
+    # rollback version bump this would cache-hit the stale pre-rollback
+    # pack; the bump forces a fresh build.
+    bst.update()
+    bst.predict(X[:100], raw_score=True)
+    assert serve.cache_stats()["builds"] == 4

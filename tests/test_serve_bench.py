@@ -1,0 +1,40 @@
+"""Smoke test for tools/serve_bench.py: the BENCH_serve blob must be
+emittable hermetically (JAX_PLATFORMS=cpu) with sane fields."""
+
+import json
+import os
+import subprocess
+import sys
+
+
+def test_serve_bench_smoke():
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(
+        os.environ,
+        LIGHTGBM_TPU_PLATFORM="cpu",
+        JAX_PLATFORMS="cpu",
+        SERVE_BENCH_ROWS="1500",
+        SERVE_BENCH_ITERS="3",
+        SERVE_BENCH_CALLS="12",
+        SERVE_BENCH_MAX_BATCH="128",
+        PYTHONPATH=os.pathsep.join(
+            [root] + os.environ.get("PYTHONPATH", "").split(os.pathsep)),
+    )
+    r = subprocess.run(
+        [sys.executable, os.path.join(root, "tools", "serve_bench.py")],
+        capture_output=True, text=True, timeout=600, env=env)
+    assert r.returncode == 0, r.stdout + r.stderr
+    blob = None
+    for line in r.stdout.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            obj = json.loads(line)
+            if obj.get("metric") == "BENCH_serve":
+                blob = obj
+    assert blob is not None, r.stdout
+    assert blob["warm_qps"] > 0
+    assert blob["p50_ms"] is not None and blob["p50_ms"] >= 0
+    assert blob["p99_ms"] >= blob["p50_ms"]
+    # ladder: 128-row cap with base 32 / ratio 2 -> at most 3 rungs
+    assert blob["compiles"] <= 3
+    assert blob["detail"]["served_rows"] > 0

@@ -1,0 +1,91 @@
+"""Serving micro-benchmark: warm QPS / latency / compile census for the
+``lightgbm_tpu.serve`` subsystem.
+
+Trains a small model, freezes it into a serve plan, warms the bucket
+ladder, then times a mixed-batch-size request stream and emits ONE
+``BENCH_serve`` JSON line (warm QPS, p50/p99 latency, compile and plan
+cache counters).  Runnable hermetically::
+
+    JAX_PLATFORMS=cpu python tools/serve_bench.py
+
+Knobs (env): SERVE_BENCH_ROWS (train rows), SERVE_BENCH_ITERS (boosting
+rounds), SERVE_BENCH_CALLS (timed requests), SERVE_BENCH_MAX_BATCH.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+ROWS = int(os.environ.get("SERVE_BENCH_ROWS", 20000))
+ITERS = int(os.environ.get("SERVE_BENCH_ITERS", 20))
+CALLS = int(os.environ.get("SERVE_BENCH_CALLS", 200))
+MAX_BATCH = int(os.environ.get("SERVE_BENCH_MAX_BATCH", 1024))
+FEATURES = 16
+
+
+def run_request_stream(pred, X, calls, max_batch, seed=7):
+    """Timed mixed-batch-size request stream against a serve Predictor —
+    the ONE measurement protocol shared by this tool and bench.py's
+    predict phase.  Returns ``(elapsed_s, served_rows)``."""
+    rng = np.random.RandomState(seed)
+    sizes = rng.randint(1, max_batch + 1, calls)
+    rows = X.shape[0]
+    served = 0
+    t0 = time.time()
+    for s in sizes:
+        lo = int(rng.randint(0, max(rows - int(s), 1)))
+        batch = X[lo:lo + int(s)]           # may clip when rows < s
+        pred.predict(batch)
+        served += batch.shape[0]
+    return time.time() - t0, served
+
+
+def main():
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu import serve
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(ROWS, FEATURES)
+    X[rng.rand(ROWS, FEATURES) < 0.02] = np.nan
+    y = (X[:, 0] + np.nan_to_num(X[:, 1]) > 0).astype(np.float64)
+    t0 = time.time()
+    bst = lgb.train({"objective": "binary", "num_leaves": 31,
+                     "verbosity": -1}, lgb.Dataset(X, label=y), ITERS)
+    train_s = time.time() - t0
+
+    pred = serve.Predictor(bst)
+    t0 = time.time()
+    warmed = pred.warmup(MAX_BATCH)
+    warm_s = time.time() - t0
+
+    # mixed request sizes, ladder-spanning (the serving traffic shape)
+    elapsed, served_rows = run_request_stream(pred, X, CALLS, MAX_BATCH)
+
+    snap = pred.metrics_snapshot()
+    blob = {
+        "metric": "BENCH_serve",
+        "warm_qps": round(CALLS / elapsed, 2),
+        "warm_rows_per_sec": round(served_rows / elapsed, 1),
+        "p50_ms": round(snap["p50_ms"], 4),
+        "p99_ms": round(snap["p99_ms"], 4),
+        "compiles": snap["compiles"],
+        "plan_cache": snap["plan_cache"],
+        "detail": {
+            "train_rows": ROWS, "features": FEATURES, "iters": ITERS,
+            "calls": CALLS, "served_rows": served_rows,
+            "max_batch": MAX_BATCH, "warmed_rungs": warmed,
+            "warmup_s": round(warm_s, 3), "train_s": round(train_s, 3),
+            "padded_rows": snap["padded_rows"],
+        },
+    }
+    print(json.dumps(blob))
+    sys.stdout.flush()
+
+
+if __name__ == "__main__":
+    main()
