@@ -187,6 +187,15 @@ _PARAMS: List[Tuple[str, Any, Any, Tuple[str, ...], Optional[Tuple[Any, Any]]]] 
     ("tpu_4bit_bins", bool, True, (), None),
     # Leaves split per growth step (wave growth); 1 = strict best-first.
     ("tpu_leaf_batch", int, 1, (), (1, 128)),
+    # Cross-shard histogram reduction on data-parallel meshes
+    # (tree_learner=data): reduce_scatter = feature-sliced psum_scatter +
+    # per-shard split scan + SplitInfo payload broadcast (~2x less comm
+    # per wave than allreduce, the reference data_parallel_tree_learner's
+    # ReduceScatter layout); allreduce = full-histogram psum + replicated
+    # scan.  auto picks reduce_scatter whenever the composition allows
+    # (voting, intermediate/advanced monotone and forced splits keep
+    # allreduce; the mask layout keeps its own reductions).
+    ("tpu_hist_comm", str, "auto", (), None),  # auto|allreduce|reduce_scatter
     # Boosting rounds fused into ONE scanned XLA dispatch (iteration
     # packing, docs/ITER_PACK.md).  0 = auto: pack whenever the config is
     # pack-capable with static row/feature masks; explicit K >= 1 forces
@@ -242,7 +251,8 @@ def _coerce(name: str, typ: Any, value: Any) -> Any:
     if typ is str:
         return str(value).strip().lower() if name in ("objective", "boosting", "tree_learner",
                                                       "device_type", "monotone_constraints_method",
-                                                      "data_sample_strategy", "tpu_histogram_impl") \
+                                                      "data_sample_strategy", "tpu_histogram_impl",
+                                                      "tpu_hist_comm") \
             else str(value)
     if typ in ("list_int", "list_float", "list_str"):
         if value is None:

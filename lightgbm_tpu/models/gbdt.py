@@ -256,6 +256,10 @@ class GBDT:
             feature_fraction_bynode=cfg.feature_fraction_bynode < 1.0),
             warn=Log.warning)
         voting, leaf_batch = comp.voting, comp.leaf_batch
+        if cfg.tpu_hist_comm not in ("auto", "allreduce", "reduce_scatter"):
+            raise ValueError(
+                f"tpu_hist_comm={cfg.tpu_hist_comm!r}: expected auto, "
+                "allreduce or reduce_scatter")
         self.grower_cfg = GrowerConfig(
             num_leaves=cfg.num_leaves,
             max_depth=cfg.max_depth,
@@ -281,8 +285,17 @@ class GBDT:
             mono_advanced=self._mono_advanced,
             mono_static=(tuple(int(m) for m in train.monotone_constraints)
                          if self._mono_advanced else None),
+            hist_comm=cfg.tpu_hist_comm,
         )
-        from .grower import fp_capable_for
+        from .grower import fp_capable_for, rs_active_for
+        if (cfg.tpu_hist_comm == "reduce_scatter"
+                and not rs_active_for(self.grower_cfg, self.mesh,
+                                      DATA_AXIS)):
+            Log.warning(
+                "tpu_hist_comm=reduce_scatter needs a data-parallel mesh "
+                "and a composition without voting, "
+                "intermediate/advanced monotone constraints or forced "
+                "splits; keeping the full-histogram allreduce")
         if (self.mesh is not None and not data_only_mesh
                 and hist_impl == "auto"
                 and not fp_capable_for(self.grower_cfg, self.mesh,
@@ -708,7 +721,8 @@ class GBDT:
         # divergence across processes must fail fast at the allgather, not
         # hang the packing processes inside it.
         from ..parallel.distributed import assert_pack_lockstep
-        return assert_pack_lockstep(k, use), use
+        return assert_pack_lockstep(
+            k, use, hist_comm=self.grower_cfg.hist_comm), use
 
     def _pack_fn(self, k: int):
         """Compiled K-round program: ONE ``lax.scan`` over the fused

@@ -19,10 +19,23 @@ state.  Two interchangeable data layouts:
 - **Sharded permutation layout** (data-axis meshes): the SAME permutation
   machinery runs per-shard inside ``shard_map`` — each shard keeps a local
   row permutation grouped by leaf and histograms only its local slice of the
-  splitting leaf; ONE ``psum`` per wave produces the replicated global
-  histograms (the reference's histogram reduce,
-  ``data_parallel_tree_learner.cpp:284``), so every split decision is
-  replicated across shards and per-tree cost stays O(N·depth / shards).
+  splitting leaf.  ONE cross-shard histogram reduction runs per wave
+  (the reference's histogram reduce, ``data_parallel_tree_learner.cpp:284``);
+  its shape is governed by ``hist_comm``:
+
+  * ``reduce_scatter`` (the ``auto`` default): a feature-sliced
+    ``psum_scatter`` leaves each shard the reduced histograms of only its
+    owned ``ceil(G/shards)`` feature block (the reference's
+    ``Network::ReduceScatter`` + per-rank feature ownership), the split
+    scan runs on just that slice, and the global winner is broadcast as
+    one tiny SplitInfo payload per child (``SyncUpGlobalBestSplit``) —
+    ~2x less comm and ``shards``-x less scan FLOPs/leaf-histogram memory
+    than the replicated alternative.
+  * ``allreduce``: a full ``psum`` replicates the global histograms on
+    every shard and the split scan runs replicated.
+
+  Either way every split decision is replicated across shards and per-tree
+  cost stays O(N·depth / shards).
 - **Mask layout** (feature-axis meshes / tiny data): rows carry a
   ``row_leaf`` assignment vector and leaf membership is a predicate folded
   into the histogram contraction.  Slower (full-N pass per split) but works
@@ -46,7 +59,7 @@ import numpy as np
 
 from ..ops.histogram import histogram_from_vals, unpack_bins4
 from ..ops.split import (BestSplit, SplitConfig, best_split, leaf_gain,
-                         leaf_output, smoothed_output)
+                         leaf_output, smoothed_output, sync_best_split)
 
 _NEG_INF = -jnp.inf
 _MIN_BUCKET = 2048
@@ -142,6 +155,17 @@ class GrowerConfig:
     # VMEM/registers.  Set by GBDT when eligible (no EFB bundling, no
     # feature-parallel layout).
     packed4: bool = False
+    # Cross-shard histogram reduction for the data-parallel sharded-perm
+    # paths (reference data_parallel_tree_learner.cpp:284).  "allreduce":
+    # full-histogram psum + replicated split scan.  "reduce_scatter": a
+    # feature-sliced psum_scatter leaves each shard only its owned
+    # ceil(G/shards) feature block, the scan runs slice-local, and the
+    # winner syncs via the one-hot SplitInfo payload broadcast
+    # (SyncUpGlobalBestSplit) — ~2x less comm per wave, shards-x less
+    # scan FLOPs.  "auto" = reduce_scatter whenever the composition
+    # allows (see rs_active_for); voting mode and the mask layout keep
+    # their own reductions in every mode.
+    hist_comm: str = "auto"
 
 
 class TreeArrays(NamedTuple):
@@ -262,6 +286,36 @@ def fp_capable_for(cfg: GrowerConfig, mesh, data_axis: str) -> bool:
                      and cfg.split.has_monotone))
 
 
+def rs_active_for(cfg: GrowerConfig, mesh, data_axis: str) -> bool:
+    """Static predicate: does this config route the data-sharded perm/wave
+    paths to the feature-sliced histogram reduce-scatter (vs the replicated
+    full-histogram allreduce)?  Shared by make_grower's dispatch, GBDT's
+    knob resolution and the HLO-cost/census tooling so they cannot
+    disagree.
+
+    Excluded compositions (these keep the allreduce):
+    - voting: it reduces only vote winners' slices, never full histograms;
+    - intermediate/advanced monotone: the per-step refresh rescans EVERY
+      leaf from its resident histogram and the advanced bound tensors live
+      in full feature space — both need the replicated leaf_hist;
+    - forced splits: _apply_forced derives child stats from the full
+      histogram row of an arbitrary (forced) feature.
+    """
+    if cfg.hist_comm not in ("auto", "reduce_scatter"):
+        return False
+    if mesh is None or int(mesh.shape[data_axis]) <= 1:
+        return False
+    if not cfg.gather_rows:
+        return False
+    if cfg.voting:
+        return False
+    if cfg.forced_splits:
+        return False
+    if (cfg.mono_intermediate or cfg.mono_advanced) and cfg.split.has_monotone:
+        return False
+    return True
+
+
 def _split_buckets(n: int) -> list:
     """Static slice sizes covering leaf row counts 1..n."""
     sizes = []
@@ -279,7 +333,8 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
 
     With ``mesh`` (and ``cfg.gather_rows``), the permutation/wave layouts run
     per-shard inside ``shard_map`` over ``data_axis`` with one histogram
-    ``psum`` per wave (see module docstring)."""
+    reduction per wave — a feature-sliced ``psum_scatter`` or a full
+    ``psum``, per ``cfg.hist_comm`` (see module docstring)."""
 
     L, B = cfg.num_leaves, cfg.num_bins
     HB = cfg.hist_bins or cfg.num_bins   # histogram-storage bin axis
@@ -334,7 +389,7 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
 
     def _best_for(hist, pg, ph, pc, meta, feature_mask, penalty=None,
                   parent_out=None, key=None, path=None, groups_mat=None,
-                  out_lo=None, out_hi=None, leaf_depth=None):
+                  out_lo=None, out_hi=None, leaf_depth=None, rs=None):
         nbpf, nan_bins, is_cat, monotone = meta[:4]
         rand_bins = None
         if need_key and key is not None:
@@ -342,6 +397,13 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
         if use_groups and path is not None and groups_mat is not None:
             feature_mask = feature_mask & _allowed_for_paths(
                 path[None, :], groups_mat)[0]
+        if rs is not None:
+            # Slice-local scan: per-node inputs were derived replicated in
+            # full feature space (identical draws on every shard); project
+            # them onto this shard's owned window.
+            feature_mask, rand_bins, penalty = rs["project"](
+                feature_mask, rand_bins, penalty)
+            nbpf, nan_bins, is_cat, monotone = rs["meta_s"]
         return best_split(
             hist, pg, ph, pc,
             num_bins_per_feature=nbpf, nan_bins=nan_bins, is_categorical=is_cat,
@@ -381,7 +443,7 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
     def _best_for_batch(histk, pgk, phk, pck, meta, feature_mask,
                         penaltyk=None, parent_outk=None, key=None,
                         pathk=None, groups_mat=None, boundsk=None,
-                        depthk=None, advk=None):
+                        depthk=None, advk=None, rs=None):
         """All k children's split searches in one vmapped program — one
         kernel set per wave instead of per child."""
         nbpf, nan_bins, is_cat, monotone = meta[:4]
@@ -390,6 +452,14 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
             parent_outk = jnp.zeros(k, jnp.float32)
         fmaskk, randk = _node_scan_inputs(key, feature_mask, nbpf, k,
                                           pathk, groups_mat)
+        if rs is not None:
+            # Slice-local scan (see _best_for): node inputs derive
+            # replicated, then project onto the owned feature window.  The
+            # advanced-monotone bound tensors never reach this path
+            # (rs_active_for excludes the refresh modes).
+            assert advk is None
+            fmaskk, randk, penaltyk = rs["project"](fmaskk, randk, penaltyk)
+            nbpf, nan_bins, is_cat, monotone = rs["meta_s"]
         if boundsk is None:
             lok = hik = jnp.zeros(k, jnp.float32)
             use_b = False
@@ -471,6 +541,12 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
     adv = cfg.mono_advanced and cfg.split.has_monotone
     inter = (cfg.mono_intermediate or adv) and cfg.split.has_monotone
     fp_capable = fp_capable_for(cfg, mesh, data_axis)
+    if cfg.hist_comm not in ("auto", "allreduce", "reduce_scatter"):
+        raise ValueError(
+            f"hist_comm={cfg.hist_comm!r}: expected auto, allreduce or "
+            "reduce_scatter")
+    rs_on = rs_active_for(cfg, mesh, data_axis)
+    rs_shards = 1 if mesh is None else int(mesh.shape[data_axis])
     if inter and cfg.voting:
         raise ValueError(
             "monotone_constraints_method=intermediate/advanced does not "
@@ -704,7 +780,7 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
     def _children_updates(st, leaf, new_leaf, hist_left, hist_right,
                           gl, hl, cl, gr, hr, cr, meta, feature_mask,
                           cegb=None, groups_mat=None, scale3=None,
-                          sync=None, fp_mono=None):
+                          sync=None, fp_mono=None, rs=None):
         """Store child stats + their best splits (both children batched into
         single 2-row scatters to minimize kernel count in the hot loop)."""
         depth = st.leaf_depth[leaf] + 1
@@ -799,7 +875,7 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
         h2 = jnp.stack([hl, hr])
         c2 = jnp.stack([cl, cr])
         hist2s = _expand_hist_batch(_scale_hist(hist2, scale3), meta,
-                                    g2, h2, c2)        # scaled (split scan)
+                                    g2, h2, c2, rs)    # scaled (split scan)
         st = st._replace(
             num_leaves=st.num_leaves + 1,
             leaf_hist=st.leaf_hist.at[pair].set(hist2),
@@ -816,10 +892,11 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
             else depth < cfg.max_depth
         bs2 = _best_for_pair(hist2s, g2, h2, c2, meta, feature_mask,
                              penalty2, jnp.stack([out_l, out_r]), node_key,
-                             path2, groups_mat, bounds2, depth2)
+                             path2, groups_mat, bounds2, depth2, rs=rs)
         if sync is not None:
-            # feature-parallel: local scans covered only owned features;
-            # globalize both children's winners before storing
+            # feature-parallel / reduce-scatter: local scans covered only
+            # owned features; globalize both children's winners before
+            # storing
             bs2 = sync(bs2)
         gain2 = jnp.where(depth_ok, bs2.gain, _NEG_INF)
         return st._replace(
@@ -1084,55 +1161,111 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
             return hist
         return hist.astype(jnp.float32) * scale3
 
-    def _fp_sync_best(bs, foffset, faxis, n_shards):
-        """Feature-parallel global best-split sync (reference
-        ``SyncUpGlobalBestSplit``, feature_parallel_tree_learner.cpp:59-77):
-        every shard scanned only its OWN features; the winner's SplitInfo
-        (scalars + cat mask) is broadcast by a one-hot psum.  Local feature
-        indices become global by adding the shard's offset.  Ties break to
-        the lowest shard, like the reference's rank order.
+    # SplitInfo payload broadcast globalizing slice-local winners — ONE
+    # implementation (ops/split.py sync_best_split) shared by the
+    # feature-parallel layout and the data-parallel reduce-scatter path so
+    # their wire formats cannot diverge.
+    _fp_sync_best = sync_best_split
 
-        Precision note: the f32 payload transports counts/sums losslessly —
-        the psum has exactly one non-zero contributor per element, so the
-        received value bit-equals the sender's.  Counts are f32 BEFORE the
-        payload in every path (f32 histogram count channel, f32 cumsum in
-        the split scan, f32 GrowthState.leaf_count; the quantized path
-        converts int32→f32 in _scale_hist before scanning), so serial and
-        feature-parallel share the same >2^24 representation limit and
-        cannot drift apart at this sync.  The feature index rides exactly
-        up to 2^24 features."""
-        def one(gain, feature, sbin, dl, ic, cmask, gl, hl, cl, gr, hr, cr):
-            win = jax.lax.pmax(gain, faxis)
-            sidx = jax.lax.axis_index(faxis)
-            is_w = (gain >= win) & (win > _NEG_INF)
-            first = jax.lax.pmin(jnp.where(is_w, sidx, n_shards), faxis)
-            mine = sidx == first
-            scal = jnp.stack([
-                (feature + foffset).astype(jnp.float32),
-                sbin.astype(jnp.float32), dl.astype(jnp.float32),
-                ic.astype(jnp.float32), gl, hl, cl, gr, hr, cr])
-            payload = jnp.concatenate([scal, cmask.astype(jnp.float32)])
-            payload = jax.lax.psum(
-                jnp.where(mine, payload, jnp.zeros_like(payload)), faxis)
-            return BestSplit(
-                gain=win,
-                feature=jnp.round(payload[0]).astype(jnp.int32),
-                bin=jnp.round(payload[1]).astype(jnp.int32),
-                default_left=payload[2] > 0.5,
-                is_cat=payload[3] > 0.5,
-                cat_mask=payload[10:] > 0.5,
-                sum_grad_left=payload[4], sum_hess_left=payload[5],
-                count_left=payload[6],
-                sum_grad_right=payload[7], sum_hess_right=payload[8],
-                count_right=payload[9])
+    def _make_rs(axis, hist_cols, meta):
+        """Per-shard context for the feature-sliced histogram reduce-scatter
+        (``hist_comm=reduce_scatter``; reference
+        ``data_parallel_tree_learner.cpp:284`` ReduceScatter + per-rank
+        feature ownership).
 
-        args = (bs.gain, bs.feature, bs.bin, bs.default_left, bs.is_cat,
-                bs.cat_mask, bs.sum_grad_left, bs.sum_hess_left,
-                bs.count_left, bs.sum_grad_right, bs.sum_hess_right,
-                bs.count_right)
-        if bs.gain.ndim == 0:
-            return one(*args)
-        return jax.vmap(one)(*args)
+        ``hist_cols`` is the HISTOGRAM feature-space width: G bundle columns
+        under EFB, F otherwise (packed4 histograms are already unpacked to
+        F).  Each shard owns the contiguous block
+        ``[shard * go, (shard+1) * go)`` of that axis, ``go =
+        ceil(hist_cols/shards)`` (histograms are zero-padded to ``gp = go *
+        shards`` before the scatter; phantom columns have nbpf=0 so they can
+        never win a scan).
+
+        Returned dict:
+        - ``scatter(h)``: (…, G, B, 3) local partials -> (…, go, B, 3) owned
+          reduced block.  Under quantized training the wire payload drops to
+          int16 (reference ``Int16HistogramSumReducer``, ``bin.h:48-81``)
+          behind an exact-overflow guard: the psum of per-shard max-abs
+          upper-bounds every partial sum of the reduction, so the int16
+          branch can never wrap; otherwise the wire stays int32.
+        - ``meta_s``: the 4 scan-meta arrays projected onto the owned slice
+          (EFB keeps the full-F meta — the scan runs in expanded feature
+          space with the ownership mask).
+        - ``project(fm, rb, pen)``: per-node F-space inputs (feature mask /
+          extra_trees thresholds / CEGB penalties, derived REPLICATED so
+          every shard draws identical randomness) projected the same way.
+        - ``sync(bs)``: the one-hot SplitInfo payload broadcast
+          (``SyncUpGlobalBestSplit``) globalizing slice-local winners.
+          Non-EFB slices are contiguous ascending feature blocks, so the
+          lowest-shard tie-break reproduces the replicated scan's
+          lowest-flat-index argmax exactly; under EFB ties break to the
+          lowest OWNING shard (the reference's rank order).
+        """
+        from ..parallel.collectives import histogram_reduce_scatter_local
+
+        go = -(-hist_cols // rs_shards)
+        gp = go * rs_shards
+        g_lo = (jax.lax.axis_index(axis) * go).astype(jnp.int32)
+
+        def scatter(h):
+            d = h.ndim - 3                     # the feature axis of (…,G,B,3)
+            if gp != hist_cols:
+                pw = [(0, 0)] * h.ndim
+                pw[d] = (0, gp - hist_cols)
+                h = jnp.pad(h, pw)
+            if cfg.quantized:
+                # int16 wire format: sum-of-per-shard-maxes >= every partial
+                # sum elementwise, so fitting int16 here is exact — no
+                # overflow at any reduction step.  f32 compare is exact for
+                # ints < 2^24; anything larger fails the guard anyway.
+                bound = jax.lax.psum(
+                    jnp.max(jnp.abs(h)).astype(jnp.float32), axis)
+                return jax.lax.cond(
+                    bound <= 32767.0,
+                    lambda x: histogram_reduce_scatter_local(
+                        x.astype(jnp.int16), axis, d).astype(jnp.int32),
+                    lambda x: histogram_reduce_scatter_local(x, axis, d),
+                    h)
+            return histogram_reduce_scatter_local(h, axis, d)
+
+        def _slice_last(a, pad_val):
+            """Project an F-space array (…, F) onto the owned (…, go)
+            window, padding phantom columns with ``pad_val``."""
+            pad = gp - a.shape[-1]
+            if pad:
+                pw = [(0, 0)] * (a.ndim - 1) + [(0, pad)]
+                a = jnp.pad(a, pw, constant_values=pad_val)
+            return jax.lax.dynamic_slice_in_dim(a, g_lo, go, axis=a.ndim - 1)
+
+        if cfg.bundled:
+            # Ownership in ORIGINAL-feature space: the features whose bundle
+            # group falls inside the owned G block.  The scan stays full-F
+            # (bundle members are not contiguous in F) with non-owned
+            # features masked out; comm still shrinks by the scatter.
+            own_f = (meta[4] >= g_lo) & (meta[4] < g_lo + go)
+            meta_s = meta[:4]
+            foff = jnp.zeros((), jnp.int32)
+
+            def project(fm, rb=None, pen=None):
+                return fm & own_f, rb, pen
+        else:
+            own_f = None
+            meta_s = (_slice_last(meta[0], 0),       # nbpf=0: never valid
+                      _slice_last(meta[1], HB),      # no NaN bin
+                      _slice_last(meta[2], False),
+                      _slice_last(meta[3], 0))
+            foff = g_lo
+
+            def project(fm, rb=None, pen=None):
+                return (_slice_last(fm, False),
+                        None if rb is None else _slice_last(rb, 0),
+                        None if pen is None else _slice_last(pen, 0.0))
+
+        return {
+            "go": go, "gp": gp, "g_lo": g_lo, "own_f": own_f,
+            "scatter": scatter, "meta_s": meta_s, "project": project,
+            "sync": lambda bs: _fp_sync_best(bs, foff, axis, rs_shards),
+        }
 
     def _fp_go_left(bins_pad, nan_bins, feat_g, sbin, dleft, scat, cmask,
                     foffset, fl, faxis):
@@ -1196,14 +1329,22 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
             return _partition_scatter(perm, start, seg, valid, go_left, S)
         return branch
 
-    def _expand_hist(bh, meta, tg, th, tc):
+    def _expand_hist(bh, meta, tg, th, tc, rs=None):
         """(G, B, 3) bundle histogram -> (F, B, 3) per-original-feature view
         (reference: per-feature offsets into group histograms,
         feature_histogram.hpp).  Bundled features' default bin 0 is
-        reconstructed as leaf_total - sum(non-default bins)."""
+        reconstructed as leaf_total - sum(non-default bins).
+
+        Under the reduce-scatter layout ``bh`` is this shard's owned
+        (go, B, 3) group block; only owned features expand (the rest are
+        zeroed and masked out of the scan by ``rs["project"]``)."""
         if not cfg.bundled:
             return bh
         nbpf, fg, fo = meta[0], meta[4], meta[5]
+        own = None
+        if rs is not None:
+            own = rs["own_f"]
+            fg = jnp.clip(fg - rs["g_lo"], 0, bh.shape[-3] - 1)
         b_iota = jnp.arange(B)
         ident = fo < 0
         src_bin = jnp.where(ident[:, None], b_iota[None, :],
@@ -1215,12 +1356,16 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
         tot = jnp.stack([tg, th, tc])
         h0 = jnp.where(ident[:, None], hf[:, 0, :],
                        tot[None, :] - jnp.sum(hf, axis=1))
-        return hf.at[:, 0, :].set(h0)
+        out = hf.at[:, 0, :].set(h0)
+        if own is not None:
+            out = out * own[:, None, None].astype(out.dtype)
+        return out
 
-    def _expand_hist_batch(bhk, meta, gk, hk, ck):
+    def _expand_hist_batch(bhk, meta, gk, hk, ck, rs=None):
         if not cfg.bundled:
             return bhk
-        return jax.vmap(lambda b, g, h, c: _expand_hist(b, meta, g, h, c))(
+        return jax.vmap(lambda b, g, h, c: _expand_hist(b, meta, g, h, c,
+                                                        rs))(
             bhk, gk, hk, ck)
 
     def _decode_col(raw, feat, meta):
@@ -1326,7 +1471,7 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
                                       .at[rc].set(new_leaf, mode="drop"))
 
     def _root_best(state, scale3, meta, feature_mask, root_pen,
-                   groups_mat=None):
+                   groups_mat=None, rs=None):
         """Root split search (shared by both layouts)."""
         key = None
         if need_key:
@@ -1335,7 +1480,7 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
         root_hist_s = _expand_hist(
             _scale_hist(state.leaf_hist[0], scale3), meta,
             state.leaf_sum_grad[0], state.leaf_sum_hess[0],
-            state.leaf_count[0])
+            state.leaf_count[0], rs)
         bs = _best_for(root_hist_s,
                        state.leaf_sum_grad[0],
                        state.leaf_sum_hess[0], state.leaf_count[0], meta,
@@ -1343,14 +1488,19 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
                        state.leaf_path[0], groups_mat,
                        state.leaf_lo[0] if cfg.split.has_monotone else None,
                        state.leaf_hi[0] if cfg.split.has_monotone else None,
-                       state.leaf_depth[0])
+                       state.leaf_depth[0], rs=rs)
+        if rs is not None:
+            # slice-local root scan -> globalize (SyncUpGlobalBestSplit)
+            bs = rs["sync"](bs)
         return state, bs
 
     def _perm_setup(bins, vals, scale3, meta, feature_mask, cegb, key,
-                    groups_mat=None, axis=None):
+                    groups_mat=None, axis=None, rs=None):
         """Shared permutation-layout prologue: padded arrays, buckets, root
         histogram/state/best-split.  ``axis`` = shard_map axis name for the
-        cross-shard histogram psum (None = single device)."""
+        cross-shard histogram reduction (None = single device); ``rs`` = the
+        reduce-scatter context (then leaf_hist holds only the owned feature
+        block)."""
         n, gcols = bins.shape
         nfeat = meta[0].shape[0]
         bins_pad = jnp.concatenate([bins, jnp.zeros((1, gcols), bins.dtype)],
@@ -1370,15 +1520,31 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
             # The reference's histogram reduce
             # (data_parallel_tree_learner.cpp:284) — integer tensors under
             # quantized training (bin.h:48-81).  Voting mode keeps leaf
-            # histograms LOCAL and reduces only vote winners.
-            root_hist = jax.lax.psum(root_hist, axis)
-        root_tot = jnp.sum(_scale_hist(root_hist[0:1], scale3)[0], axis=0)
-        if voting:
-            root_tot = jax.lax.psum(root_tot, axis)
+            # histograms LOCAL and reduces only vote winners;
+            # reduce-scatter mode keeps only the owned feature block.
+            root_hist = (rs["scatter"](root_hist) if rs is not None
+                         else jax.lax.psum(root_hist, axis))
+        if rs is not None:
+            # Every feature's bins sum to the leaf totals; the owner of
+            # histogram column 0 (shard 0) computes them from its reduced
+            # block and the one-hot psum broadcast delivers the bitwise
+            # value the allreduce path would see.
+            tot0 = jnp.sum(_scale_hist(root_hist[0:1], scale3)[0], axis=0)
+            mine0 = jax.lax.axis_index(axis) == 0
+            root_tot = jax.lax.psum(
+                jnp.where(mine0, tot0, jnp.zeros_like(tot0)), axis)
+        else:
+            root_tot = jnp.sum(_scale_hist(root_hist[0:1], scale3)[0],
+                               axis=0)
+            if voting:
+                root_tot = jax.lax.psum(root_tot, axis)
         root_g, root_h, root_c = root_tot[0], root_tot[1], root_tot[2]
         # leaf_hist columns live in HISTOGRAM feature space, which under
-        # packed4 is the unpacked F (bins columns are nibble pairs)
+        # packed4 is the unpacked F (bins columns are nibble pairs) and
+        # under reduce-scatter is the owned block width
         hist_cols = nfeat if cfg.packed4 else gcols
+        if rs is not None:
+            hist_cols = rs["go"]
         state = _init_state(n, nfeat, hist_cols, root_hist, root_g, root_h,
                             root_c, key)
         state = state._replace(perm=perm0)
@@ -1402,7 +1568,7 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
             root_bs = jax.tree.map(lambda a: a[0], bs1)
         else:
             state, root_bs = _root_best(state, scale3, meta, feature_mask,
-                                        root_pen, groups_mat)
+                                        root_pen, groups_mat, rs)
         state = _store_best(state, jnp.asarray(0), root_bs, jnp.asarray(True))
         return state, bins_pad, vals_pad, buckets, buckets_arr, max_bucket
 
@@ -1448,9 +1614,15 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
                 owns = (lf >= 0) & (lf < f)
                 m = jnp.where(owns, meta[3][jnp.clip(lf, 0, f - 1)], 0)
                 return jax.lax.psum(m, faxis)
+        rs = None
+        if axis is not None and rs_on:
+            hist_cols = f if cfg.packed4 else bins.shape[1]
+            rs = _make_rs(axis, hist_cols, meta)
+        sync = fp_sync if fp_sync is not None else (
+            rs["sync"] if rs is not None else None)
         (state, bins_pad, vals_pad, buckets, buckets_arr,
          max_bucket) = _perm_setup(bins, vals, scale3, meta, feature_mask,
-                                   cegb, key, groups_mat, axis)
+                                   cegb, key, groups_mat, axis, rs)
         if fp_sync is not None:
             # _perm_setup stored the LOCAL root best; globalize it
             # (reference SyncUpGlobalBestSplit after the root scan).
@@ -1530,7 +1702,11 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
             hist_small = jax.lax.switch(
                 _bucket_of(hs_cnt), hist_branches, perm, hs_start, hs_cnt)
             if axis is not None:
-                hist_small = jax.lax.psum(hist_small, axis)
+                # The reference's per-step histogram reduce: full psum
+                # (replicated scan) or feature-sliced reduce-scatter
+                # (slice-local scan + SplitInfo payload sync).
+                hist_small = (rs["scatter"](hist_small) if rs is not None
+                              else jax.lax.psum(hist_small, axis))
 
             hist_parent = st.leaf_hist[leaf]
             hist_big = hist_parent - hist_small
@@ -1548,7 +1724,8 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
             st = _children_updates(st, leaf, new_leaf, hist_left,
                                     hist_right, gl, hl, cl, gr, hr, cr,
                                     meta, feature_mask, cegb, groups_mat,
-                                    scale3, sync=fp_sync, fp_mono=fp_mono)
+                                    scale3, sync=sync, fp_mono=fp_mono,
+                                    rs=rs)
             if n_forced:
                 st = _record_forced_children(st, use_f, si, leaf, new_leaf)
             if inter:
@@ -1588,9 +1765,12 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
         voting = cfg.voting and axis is not None
         nan_bins = meta[1]
         groups_mat = _groups_matrix(f) if use_groups else None
+        rs = None
+        if axis is not None and rs_on:
+            rs = _make_rs(axis, f if cfg.packed4 else gcols, meta)
         (state, bins_pad, vals_pad, buckets, buckets_arr,
          max_bucket) = _perm_setup(bins, vals, scale3, meta, feature_mask,
-                                   cegb, key, groups_mat, axis)
+                                   cegb, key, groups_mat, axis, rs)
 
         part_branches = [_part_branch_for(bins_pad, nan_bins, S, meta)
                          for S in buckets]
@@ -1685,9 +1865,14 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
                           raw_dtype))                         # (W, G, B, 3)
             if axis is not None and not voting:
                 # ONE cross-shard reduce per wave — integer tensors under
-                # quantized training (bin.h:48-81).  Voting mode reduces only
-                # the vote winners' slices (see _vote_best_batch).
-                hist_small = jax.lax.psum(hist_small, axis)
+                # quantized training (bin.h:48-81; int16 on the wire when
+                # the reduce-scatter overflow guard allows).  Voting mode
+                # reduces only the vote winners' slices (_vote_best_batch);
+                # reduce-scatter mode leaves each shard its owned feature
+                # block (the reference's ReduceScatter,
+                # data_parallel_tree_learner.cpp:284).
+                hist_small = (rs["scatter"](hist_small) if rs is not None
+                              else jax.lax.psum(hist_small, axis))
 
             parent_hist = st.leaf_hist[top_l]
             hist_big = parent_hist - hist_small
@@ -1875,12 +2060,16 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
             else:
                 hist2s = _expand_hist_batch(
                     _scale_hist(cat2(hist_left, hist_right), scale3), meta,
-                    cat2(gl, gr), cat2(hl, hr), cat2(cl, cr))
+                    cat2(gl, gr), cat2(hl, hr), cat2(cl, cr), rs)
                 bs = _best_for_batch(hist2s, cat2(gl, gr), cat2(hl, hr),
                                      cat2(cl, cr), meta, feature_mask,
                                      penalty2, cat2(out_l, out_r), node_key,
                                      path2, groups_mat, bounds2,
-                                     cat2(depth, depth))
+                                     cat2(depth, depth), rs=rs)
+                if rs is not None:
+                    # All 2W slice-local winners globalize in one vmapped
+                    # payload broadcast (SyncUpGlobalBestSplit).
+                    bs = rs["sync"](bs)
             if cfg.max_depth <= 0:
                 depth_ok = jnp.ones(2 * W, bool)
             else:
@@ -2086,10 +2275,14 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
     def _grow_sharded(bins, vals, scale3, feature_mask, meta, cegb,
                       split_key):
         """Run the permutation/wave grower per-shard under ``shard_map``:
-        local partitions + local histograms, ONE psum per wave (the
-        reference's histogram reduce, ``data_parallel_tree_learner.cpp:284``).
-        All split decisions derive from the replicated psum'd histograms, so
-        the tree state is replicated and the while_loop stays in lockstep."""
+        local partitions + local histograms, ONE cross-shard histogram
+        reduction per wave (the reference's histogram reduce,
+        ``data_parallel_tree_learner.cpp:284``) — a feature-sliced
+        ``psum_scatter`` + slice-local scan + SplitInfo payload sync by
+        default, or a full ``psum`` + replicated scan under
+        ``hist_comm=allreduce``.  Either way every split decision lands
+        replicated on all shards, so the tree state is replicated and the
+        while_loop stays in lockstep."""
         from jax.sharding import PartitionSpec as P
         shard_map, smap_kw = _shard_map()
 
@@ -2239,6 +2432,7 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
     grow = jax.jit(_grow_impl, donate_argnums=())
     # static dispatch facts, inspectable by tests/tools
     grow.fp_capable = fp_capable
+    grow.rs_active = rs_on
     # Scan-able handle: the iteration-packed path traces grow INSIDE a
     # lax.scan body that is already under jit; the raw function skips the
     # redundant inner-jit trace (semantics identical — nested jit inlines).

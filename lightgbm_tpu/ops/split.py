@@ -83,6 +83,67 @@ class BestSplit(NamedTuple):
     count_right: jnp.ndarray
 
 
+def sync_best_split(bs: "BestSplit", feature_offset, axis: str,
+                    n_shards: int) -> "BestSplit":
+    """Globalize per-shard slice-local winners (reference
+    ``SyncUpGlobalBestSplit``, ``parallel_tree_learner.h`` /
+    ``feature_parallel_tree_learner.cpp:59-77``).
+
+    Each shard ran :func:`best_split` over only the feature slice it owns —
+    the feature-parallel layout's sharded columns, or the data-parallel
+    reduce-scatter path's owned block of the reduced histograms
+    (``data_parallel_tree_learner.cpp:284``).  The winner's SplitInfo
+    (scalars + categorical mask) is broadcast by a one-hot psum; LOCAL
+    feature indices become GLOBAL by adding this shard's
+    ``feature_offset``.  Ties break to the lowest shard, like the
+    reference's rank order — for contiguous ascending feature slices that
+    is exactly the replicated scan's lowest-flat-index argmax.
+
+    Precision note: the f32 payload transports counts/sums losslessly —
+    the psum has exactly one non-zero contributor per element, so the
+    received value bit-equals the sender's.  Counts are f32 BEFORE the
+    payload in every path (f32 histogram count channel, f32 cumsum in
+    the split scan, f32 GrowthState.leaf_count; the quantized path
+    converts int32→f32 before scanning), so serial and sharded share the
+    same >2^24 representation limit and cannot drift apart at this sync.
+    The feature index rides exactly up to 2^24 features.  Works on scalar
+    or batched (vmapped) BestSplits."""
+    neg_inf = -jnp.inf
+
+    def one(gain, feature, sbin, dl, ic, cmask, gl, hl, cl, gr, hr, cr):
+        win = jax.lax.pmax(gain, axis)
+        sidx = jax.lax.axis_index(axis)
+        is_w = (gain >= win) & (win > neg_inf)
+        first = jax.lax.pmin(jnp.where(is_w, sidx, n_shards), axis)
+        mine = sidx == first
+        scal = jnp.stack([
+            (feature + feature_offset).astype(jnp.float32),
+            sbin.astype(jnp.float32), dl.astype(jnp.float32),
+            ic.astype(jnp.float32), gl, hl, cl, gr, hr, cr])
+        payload = jnp.concatenate([scal, cmask.astype(jnp.float32)])
+        payload = jax.lax.psum(
+            jnp.where(mine, payload, jnp.zeros_like(payload)), axis)
+        return BestSplit(
+            gain=win,
+            feature=jnp.round(payload[0]).astype(jnp.int32),
+            bin=jnp.round(payload[1]).astype(jnp.int32),
+            default_left=payload[2] > 0.5,
+            is_cat=payload[3] > 0.5,
+            cat_mask=payload[10:] > 0.5,
+            sum_grad_left=payload[4], sum_hess_left=payload[5],
+            count_left=payload[6],
+            sum_grad_right=payload[7], sum_hess_right=payload[8],
+            count_right=payload[9])
+
+    args = (bs.gain, bs.feature, bs.bin, bs.default_left, bs.is_cat,
+            bs.cat_mask, bs.sum_grad_left, bs.sum_hess_left,
+            bs.count_left, bs.sum_grad_right, bs.sum_hess_right,
+            bs.count_right)
+    if bs.gain.ndim == 0:
+        return one(*args)
+    return jax.vmap(one)(*args)
+
+
 def threshold_l1(s: jnp.ndarray, l1: float) -> jnp.ndarray:
     """ThresholdL1 (reference ``feature_histogram.hpp`` GetLeafGain helpers)."""
     if l1 <= 0.0:

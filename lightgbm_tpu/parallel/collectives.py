@@ -44,8 +44,10 @@ _comm_backend = None
 
 def register_comm_backend(backend) -> None:
     """Install an object with optional ``global_sum/global_min/global_max/
-    global_mean/histogram_reduce_scatter/allgather_histogram`` callables;
-    ``None`` restores the built-in XLA collectives."""
+    global_mean/histogram_reduce_scatter/histogram_reduce_scatter_local/
+    allgather_histogram`` callables; ``None`` restores the built-in XLA
+    collectives.  The ``*_local`` hook is called from inside compiled
+    ``shard_map`` bodies (the grower hot loop) and must be traceable."""
     global _comm_backend
     _comm_backend = backend
 
@@ -54,6 +56,32 @@ def _injected(name):
     fn = getattr(_comm_backend, name, None) if _comm_backend is not None \
         else None
     return fn
+
+
+def histogram_reduce_scatter_local(local_hist: jnp.ndarray, axis: str,
+                                   scatter_dim: int = 0) -> jnp.ndarray:
+    """Shard-level histogram reduce-scatter (call INSIDE ``shard_map``).
+
+    This is the live implementation the distributed wave grower's hot loop
+    calls every wave (``models/grower.py``, ``tpu_hist_comm=reduce_scatter``):
+    per-shard partial histograms go in, the globally-summed block of this
+    shard's owned ``scatter_dim`` slice comes out — the reference's
+    ``Network::ReduceScatter(..., HistogramSumReducer)``
+    (``data_parallel_tree_learner.cpp:284``) as one XLA collective.
+
+    The feature axis (``scatter_dim``) must already be padded to a multiple
+    of the shard count.  A backend registered via
+    :func:`register_comm_backend` may override it with a
+    ``histogram_reduce_scatter_local`` callable — it runs inside jit, so the
+    override must be traceable (jax ops only, no host round-trips; host-level
+    backends like the C-API network-function seam should override the
+    whole-array facade below instead).
+    """
+    fn = _injected("histogram_reduce_scatter_local")
+    if fn is not None:
+        return fn(local_hist, axis, scatter_dim)
+    return jax.lax.psum_scatter(local_hist, axis,
+                                scatter_dimension=scatter_dim, tiled=True)
 
 
 def histogram_reduce_scatter(local_hist: jnp.ndarray, mesh: Mesh,
@@ -82,7 +110,7 @@ def histogram_reduce_scatter(local_hist: jnp.ndarray, mesh: Mesh,
 
     def body(h):
         # h: this shard's full-F local histogram -> (F/K, B, C) owned block.
-        return jax.lax.psum_scatter(h, axis, scatter_dimension=0, tiled=True)
+        return histogram_reduce_scatter_local(h, axis, 0)
 
     return shard_map(
         body, mesh=mesh,

@@ -111,12 +111,17 @@ def is_multi_process() -> bool:
     return jax.process_count() > 1
 
 
-def assert_pack_lockstep(pack_size: int, use_pack: bool = True) -> int:
+_HIST_COMM_CODES = {"": 0, "auto": 1, "allreduce": 2, "reduce_scatter": 3}
+
+
+def assert_pack_lockstep(pack_size: int, use_pack: bool = True,
+                         hist_comm: str = "") -> int:
     """Validate an iteration-pack resolution under a multi-process mesh.
 
     The pack path scans K boosting rounds inside ONE jitted dispatch whose
-    grower while_loops carry cross-shard collectives (psum per wave); every
-    process must therefore enter the SAME scan length or the mesh deadlocks
+    grower while_loops carry cross-shard collectives (a histogram psum or
+    psum_scatter per wave); every process must therefore enter the SAME
+    scan length AND the same collective layout or the mesh deadlocks
     mid-collective — the pack analog of the reference's lockstep
     requirement on its network reducers (``data_parallel_tree_learner.cpp``).
     Pack plans derive from replicated config + round counts, so a mismatch
@@ -126,24 +131,30 @@ def assert_pack_lockstep(pack_size: int, use_pack: bool = True) -> int:
     resolution — a pack-vs-no-pack divergence would otherwise hang right
     here, with the packing processes waiting on ones that never arrive —
     so ``iter_pack_plan`` routes BOTH outcomes through it and the gathered
-    payload carries (pack_size, use_pack).  No-op in single-process mode."""
+    payload carries (pack_size, use_pack, tpu_hist_comm).  A
+    ``tpu_hist_comm`` divergence would pit a full-histogram all-reduce on
+    one process against a reduce-scatter on another — the exact
+    cross-collective hang this check exists to pre-empt.  No-op in
+    single-process mode."""
     if not is_multi_process():
         return pack_size
     try:
         from jax.experimental import multihost_utils
         import numpy as _np
+        comm_code = _HIST_COMM_CODES.get(hist_comm, -1)
         plans = _np.asarray(multihost_utils.process_allgather(
-            _np.asarray([pack_size, int(use_pack)], _np.int32)))
-        plans = plans.reshape(-1, 2)
+            _np.asarray([pack_size, int(use_pack), comm_code], _np.int32)))
+        plans = plans.reshape(-1, 3)
     except Exception as exc:  # noqa: BLE001 — allgather transport hiccup
         log_warning(f"pack lockstep check skipped: {exc}")
         return pack_size
-    uniq = {(int(k), int(u)) for k, u in plans}
+    uniq = {(int(k), int(u), int(c)) for k, u, c in plans}
     if len(uniq) > 1:
         raise ValueError(
             f"tpu_iter_pack lockstep violation: processes resolved pack "
-            f"plans (size, packed) = {sorted(uniq)}; all processes must "
-            "train with identical pack configuration")
+            f"plans (size, packed, hist_comm) = {sorted(uniq)}; all "
+            "processes must train with identical pack and histogram-comm "
+            "configuration")
     return pack_size
 
 

@@ -5,14 +5,17 @@ Reference counterpart: the entire L1 Network layer + parallel tree learners
 rows sharded, histograms ReduceScatter'd; ``feature_parallel_tree_learner.cpp`` —
 features sharded, best splits AllGather'd; ``voting_parallel_tree_learner.cpp``).
 
-TPU re-design: there are NO hand-written collectives.  The tree grower is a
-single jit program; distribution is expressed by *sharding the inputs*:
+TPU re-design: collectives are XLA ops issued inside the compiled grower;
+distribution is expressed by *sharding the inputs*:
 
 - ``tree_learner=data``   -> ``bins``/``grad``/``hess``/``row_leaf`` sharded along
-  rows.  The histogram contraction reduces over the row axis, so XLA inserts the
-  cross-device ``psum`` of partial histograms — exactly the reference's histogram
-  ReduceScatter (``data_parallel_tree_learner.cpp:284``), but fused into the
-  compiled per-leaf step and riding ICI.
+  rows.  Each shard histograms its local rows and ONE explicit collective per
+  wave reduces the partials: a feature-sliced ``psum_scatter`` by default
+  (each shard keeps only its owned feature block and scans it locally — the
+  reference's histogram ReduceScatter + per-rank feature ownership,
+  ``data_parallel_tree_learner.cpp:284``) or a full ``psum`` under
+  ``tpu_hist_comm=allreduce``, fused into the compiled per-wave step and
+  riding ICI.
 - ``tree_learner=feature`` -> ``bins`` sharded along the feature axis; each
   device scans its own features and the split argmax becomes a tiny cross-device
   reduction (the reference's ``SyncUpGlobalBestSplit``, 2 SplitInfos per rank).

@@ -299,13 +299,14 @@ def test_sharded_training_metric_parity():
     np.testing.assert_allclose(ps, pp, rtol=1e-3, atol=1e-3)
 
 
-def _grower_all_reduce_bytes(gcfg, n=8 * 2304, f=64):
-    """Total all-reduce bytes in the compiled sharded grower HLO."""
-    import re
+def _grower_collective_wire_bytes(gcfg, n=8 * 2304, f=64):
+    """Total collective WIRE bytes (ring model: all-reduce 2(K-1)/K,
+    reduce-scatter (K-1)/K) in the compiled sharded grower HLO."""
     import lightgbm_tpu.models.grower as G
     from lightgbm_tpu.config import Config
     from lightgbm_tpu.dataset import TrainData
     from lightgbm_tpu.models.gbdt import _split_config
+    from tools.comm_census import collective_census
 
     rng = np.random.RandomState(0)
     X = rng.randn(n, f)
@@ -321,32 +322,29 @@ def _grower_all_reduce_bytes(gcfg, n=8 * 2304, f=64):
             meta["num_bins_per_feature"], meta["nan_bins"],
             meta["is_categorical"], meta["monotone"])
     txt = grow.lower(*args).compile().as_text()
-    sizes = {"f32": 4, "s32": 4, "u32": 4, "f64": 8, "s8": 1, "pred": 1}
-    total = 0
-    for m in re.finditer(r"= (f32|s32|u32|f64|s8|pred)\[([0-9,]*)\][^=]*all-reduce",
-                         txt):
-        dims = [int(d) for d in m.group(2).split(",") if d]
-        total += sizes[m.group(1)] * int(np.prod(dims)) if dims else sizes[m.group(1)]
-    return total
+    return sum(o["wire_bytes"] for o in collective_census(txt, 8))
 
 
 def test_voting_reduces_collective_bytes():
     """HLO-level evidence that voting-parallel moves LESS than data-parallel
-    (reference PV-Tree claim, voting_parallel_tree_learner.cpp): the per-wave
-    psum shrinks from (2W, F, B, 3) to (2W, 2k, B, 3)."""
+    (reference PV-Tree claim, voting_parallel_tree_learner.cpp): the
+    per-wave reduce shrinks from (2W, F, B, 3) to (2W, 2k, B, 3) — and it
+    must beat data-parallel even now that the latter reduce-scatters
+    (halved wire volume) instead of all-reducing."""
     import lightgbm_tpu.models.grower as G
     from lightgbm_tpu.models.gbdt import _split_config
     from lightgbm_tpu.config import Config
     cfg = Config({"objective": "binary", "verbosity": -1})
     base = dict(num_leaves=15, num_bins=256, split=_split_config(cfg),
                 leaf_batch=4)
-    data_bytes = _grower_all_reduce_bytes(
+    data_bytes = _grower_collective_wire_bytes(
         G.GrowerConfig(**base))
-    vote_bytes = _grower_all_reduce_bytes(
+    vote_bytes = _grower_collective_wire_bytes(
         G.GrowerConfig(voting=True, vote_top_k=4, **base))
     # Voting syncs BOTH children of each split but only 2k features;
-    # data-parallel syncs W smaller siblings across all F features.  At
-    # F=64, k=4 the static HLO reduce volume should drop well below half.
+    # data-parallel reduce-scatters W smaller siblings across all F
+    # features.  At F=64, k=4 the static wire volume should still drop
+    # well below half of the reduce-scatter path's.
     assert vote_bytes < data_bytes * 0.6, (vote_bytes, data_bytes)
 
 
@@ -385,6 +383,116 @@ def test_voting_composes_with_node_options(capsys):
         assert "forced splits" in out.out + out.err
     finally:
         _os.unlink(path)
+
+
+@pytest.mark.parametrize("quantized", [False, True])
+def test_hist_comm_reduce_scatter_matches_allreduce(quantized):
+    """ISSUE-3 acceptance: the feature-sliced reduce-scatter path
+    (feature-block psum_scatter + slice-local scan + SplitInfo payload
+    sync) must produce BITWISE-identical trees to the full-histogram
+    allreduce path — identical split order, structure, row partitions and
+    leaf values — on a virtual >= 4-shard mesh, num_leaves >= 31,
+    leaf_batch > 1, quantized on/off.  psum_scatter sums bitwise-equal to
+    psum elementwise and the payload broadcast transports exact f32, so
+    any divergence is a real layout bug, not reduce-order noise."""
+    import dataclasses
+
+    import lightgbm_tpu.models.grower as G
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.dataset import TrainData
+    from lightgbm_tpu.models.gbdt import _split_config
+
+    n, f = 4 * 2560, 12                    # > _MIN_BUCKET rows per shard
+    rng = np.random.RandomState(7)
+    X = rng.randn(n, f)
+    X[rng.rand(n) < 0.05, 3] = np.nan      # exercise NaN default-direction
+    y = (X[:, 0] + 0.7 * X[:, 1] * X[:, 2] + 0.3 * rng.randn(n) > 0)
+    cfg = Config({"objective": "binary", "num_leaves": 31,
+                  "min_data_in_leaf": 20, "verbosity": -1})
+    td = TrainData.build(X, y.astype(np.float64), cfg)
+    meta = td.feature_meta_device()
+    args = (jnp.asarray(td.binned.bins),
+            jnp.asarray((0.5 - y).astype(np.float32)),
+            jnp.full(n, 0.25, jnp.float32), jnp.ones(n, jnp.float32),
+            jnp.ones(f, bool), meta["num_bins_per_feature"],
+            meta["nan_bins"], meta["is_categorical"], meta["monotone"])
+    base = G.GrowerConfig(num_leaves=31, num_bins=td.binned.max_num_bins,
+                          split=_split_config(cfg), leaf_batch=4,
+                          quantized=quantized)
+    mesh = make_mesh(4, 1)
+    g_ar = G.make_grower(dataclasses.replace(base, hist_comm="allreduce"),
+                         mesh=mesh, data_axis=DATA_AXIS)
+    g_rs = G.make_grower(
+        dataclasses.replace(base, hist_comm="reduce_scatter"),
+        mesh=mesh, data_axis=DATA_AXIS)
+    assert g_rs.rs_active and not g_ar.rs_active
+    t_ar, rl_ar = g_ar(*args)
+    t_rs, rl_rs = g_rs(*args)
+    assert int(t_ar.num_leaves) == int(t_rs.num_leaves) == 31
+    for field in ("split_feature", "split_bin", "default_left",
+                  "left_child", "right_child", "leaf_value", "leaf_count"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(t_ar, field)),
+            np.asarray(getattr(t_rs, field)), err_msg=field)
+    np.testing.assert_array_equal(np.asarray(rl_ar), np.asarray(rl_rs))
+
+
+def test_hist_comm_reduce_scatter_matches_allreduce_efb():
+    """Same bitwise equivalence with EFB bundling engaged end-to-end
+    (histograms reduce-scatter in BUNDLE space; expansion + scan stay in
+    the owned slice with ownership-masked original features)."""
+    from tests.test_efb import _onehot_data
+
+    n = 8 * 2304
+    X, y = _onehot_data(n=n)
+    base = {"objective": "binary", "num_leaves": 31, "min_data_in_leaf": 20,
+            "verbosity": -1, "tree_learner": "data", "enable_bundle": True,
+            "tpu_leaf_batch": 4}
+    b_ar = lgb.train(dict(base, tpu_hist_comm="allreduce"),
+                     lgb.Dataset(X, label=y), 3)
+    b_rs = lgb.train(dict(base, tpu_hist_comm="reduce_scatter"),
+                     lgb.Dataset(X, label=y), 3)
+    assert b_ar._gbdt.bundles is not None
+    assert b_rs._gbdt.grow.rs_active and not b_ar._gbdt.grow.rs_active
+    # identical model files up to the serialized knob value itself
+    strip = lambda s: "\n".join(ln for ln in s.splitlines()
+                                if not ln.startswith("[tpu_hist_comm:"))
+    assert strip(b_ar.model_to_string()) == strip(b_rs.model_to_string())
+    np.testing.assert_array_equal(b_ar.predict(X, raw_score=True),
+                                  b_rs.predict(X, raw_score=True))
+
+
+def test_hist_comm_fallbacks_warn():
+    """Compositions the slice-local scan cannot honor (voting, the
+    monotone refresh modes, forced splits) keep the allreduce; an explicit
+    tpu_hist_comm=reduce_scatter request then warns instead of silently
+    flipping (round-2 verdict: no silent dead params)."""
+    import dataclasses
+
+    import lightgbm_tpu.models.grower as G
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.models.gbdt import _split_config
+
+    cfg = Config({"objective": "binary", "verbosity": -1})
+    sp = _split_config(cfg)
+    base = dict(num_leaves=15, num_bins=64, split=sp,
+                hist_comm="reduce_scatter")
+    mesh = make_mesh(8, 1)
+    assert G.make_grower(G.GrowerConfig(**base), mesh=mesh,
+                         data_axis=DATA_AXIS).rs_active
+    for bad in (dict(voting=True),
+                dict(forced_splits=((0, 1, -1, -1),)),
+                dict(mono_intermediate=True,
+                     split=dataclasses.replace(sp, has_monotone=True))):
+        g = G.make_grower(G.GrowerConfig(**dict(base, **bad)), mesh=mesh,
+                          data_axis=DATA_AXIS)
+        assert not g.rs_active, bad
+    # feature-only meshes never reduce-scatter (rows are replicated there)
+    assert not G.make_grower(G.GrowerConfig(**base), mesh=make_mesh(1, 8),
+                             data_axis=DATA_AXIS).rs_active
+    with pytest.raises(ValueError, match="hist_comm"):
+        G.make_grower(G.GrowerConfig(**dict(base, hist_comm="bogus")),
+                      mesh=mesh, data_axis=DATA_AXIS)
 
 
 def test_voting_training_quality():

@@ -1,0 +1,177 @@
+"""Per-wave collective census from compiled HLO.
+
+Parses the optimized HLO of the compiled (sharded) grower and reports every
+cross-device collective — op kind, operand dtype/shape, payload bytes and an
+estimated per-shard WIRE volume under the standard ring model:
+
+    all-reduce       2 * (K-1)/K * payload   (reduce-scatter + all-gather)
+    reduce-scatter       (K-1)/K * payload
+    all-gather           (K-1)/K * result
+    collective-permute             payload
+
+Each op inside the growth while-loop executes once per wave, so the
+program-level census (every op counted once) approximates the per-wave comm
+volume plus one-off root terms — the same convention
+``tests/test_hlo_cost.py::test_collective_bytes_per_wave`` pins.  This is
+the measurement the ISSUE-3 reduce-scatter path is judged by: the
+feature-sliced ``psum_scatter`` should cut histogram comm bytes ~2x vs the
+full-histogram all-reduce (reference ``data_parallel_tree_learner.cpp:284``;
+the multi-GPU scaling bottleneck named by arXiv:1806.11248 / 1809.04559).
+
+Run standalone (prints one JSON line comparing both ``tpu_hist_comm``
+lowerings on a virtual CPU mesh):
+
+    python tools/comm_census.py [n_shards] [rows_per_shard]
+"""
+
+import json
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_DTYPE_BYTES = {"pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "bf16": 2,
+                "f32": 4, "s32": 4, "u32": 4, "f64": 8, "s64": 8, "u64": 8}
+
+_COLLECTIVES = ("all-reduce", "reduce-scatter", "all-gather",
+                "collective-permute", "all-to-all")
+
+# One HLO statement: "%name = <result-type> <op>(...)" where result-type is
+# a single "f32[16,28,256,3]{...}" or, for async-start / variadic-combiner
+# collectives on real TPU/GPU lowerings, a tuple "(f32[...]{...}, u32[])".
+# The "-done" halves carry no new transfer and are skipped (counting both
+# start and done would double every async op).
+_OP_RE = re.compile(
+    r"= ([^=]*?) (" + "|".join(_COLLECTIVES) + r")(-start)?\(")
+
+_SHAPE_RE = re.compile(
+    r"(pred|s8|u8|s16|u16|bf16|f32|s32|u32|f64|s64|u64)\[([0-9,]*)\]")
+
+
+def _shape_elems(dims):
+    n = 1
+    for d in dims.split(","):
+        if d:
+            n *= int(d)
+    return n
+
+
+def collective_census(hlo_text, n_shards):
+    """List of collectives in ``hlo_text``: one record per op with the
+    result payload bytes and the ring-model wire bytes per shard.  Matches
+    both the synchronous CPU forms (``f32[...] all-reduce(...)``) and the
+    async/tuple forms real accelerator lowerings emit
+    (``(f32[...], u32[]) all-reduce-start(...)``)."""
+    scale = (n_shards - 1) / n_shards if n_shards > 1 else 0.0
+    out = []
+    for m in _OP_RE.finditer(hlo_text):
+        result_types, kind = m.group(1), m.group(2)
+        shapes = _SHAPE_RE.findall(result_types)
+        if not shapes:
+            continue
+        # largest result component = the transferred tensor (async tuples
+        # carry control scalars alongside it); record its dtype/shape
+        by_bytes = sorted(((_DTYPE_BYTES[d] * _shape_elems(s), d, s)
+                           for d, s in shapes), reverse=True)
+        result_bytes, dtype, dims = by_bytes[0]
+        if kind == "all-reduce":
+            payload, wire = result_bytes, 2.0 * scale * result_bytes
+        elif kind == "reduce-scatter":
+            # result is the owned 1/K block; the reduced payload is K blocks
+            payload = result_bytes * n_shards
+            wire = scale * payload
+        elif kind == "all-gather":
+            payload, wire = result_bytes, scale * result_bytes
+        else:  # collective-permute / all-to-all
+            payload, wire = result_bytes, float(result_bytes)
+        out.append({"op": kind, "dtype": dtype, "shape": dims,
+                    "payload_bytes": payload, "wire_bytes": wire})
+    return out
+
+
+def census_summary(hlo_text, n_shards):
+    """Aggregate ``collective_census`` into {op_kind: {count, wire_bytes}}
+    plus the total — ``comm_bytes_per_wave`` in the dryrun/bench blobs.
+
+    Quantized reduce-scatter programs lower BOTH branches of the int16
+    overflow-guard ``lax.cond`` (an s16 and an s32 reduce-scatter of the
+    same shape) though only one executes per wave; such pairs are merged
+    keeping the worst-case (s32) record so the wire total is never
+    double-counted."""
+    ops = collective_census(hlo_text, n_shards)
+    s32_rs_shapes = {r["shape"] for r in ops
+                     if r["op"] == "reduce-scatter" and r["dtype"] == "s32"}
+    ops = [r for r in ops
+           if not (r["op"] == "reduce-scatter" and r["dtype"] == "s16"
+                   and r["shape"] in s32_rs_shapes)]
+    by_kind = {}
+    for rec in ops:
+        slot = by_kind.setdefault(rec["op"], {"count": 0, "payload_bytes": 0,
+                                              "wire_bytes": 0.0})
+        slot["count"] += 1
+        slot["payload_bytes"] += rec["payload_bytes"]
+        slot["wire_bytes"] += rec["wire_bytes"]
+    return {
+        "n_shards": n_shards,
+        "ops": by_kind,
+        "comm_bytes_per_wave": round(sum(r["wire_bytes"] for r in ops), 1),
+    }
+
+
+def compile_sharded_grower_hlo(hist_comm, n_shards=8, rows_per_shard=4096,
+                               features=28, num_leaves=255, leaf_batch=16,
+                               quantized=False, num_bins=None):
+    """Optimized HLO text of the bench-shaped sharded wave grower under the
+    given ``tpu_hist_comm`` lowering (virtual CPU mesh; shared with
+    tests/test_hlo_cost.py so tool and CI measure the same program)."""
+    import dataclasses
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    import lightgbm_tpu.models.grower as G
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.dataset import TrainData
+    from lightgbm_tpu.models.gbdt import _split_config
+    from lightgbm_tpu.parallel.mesh import DATA_AXIS, make_mesh
+
+    n = n_shards * rows_per_shard
+    rng = np.random.RandomState(0)
+    X = rng.randn(n, features)
+    y = (X[:, 0] > 0).astype(np.float64)
+    cfg = Config({"objective": "binary", "verbosity": -1})
+    td = TrainData.build(X, y, cfg)
+    meta = td.feature_meta_device()
+    gcfg = G.GrowerConfig(num_leaves=num_leaves,
+                          num_bins=num_bins or td.binned.max_num_bins,
+                          split=_split_config(cfg), leaf_batch=leaf_batch,
+                          quantized=quantized, hist_comm=hist_comm)
+    mesh = make_mesh(n_shards, 1)
+    grow = G.make_grower(gcfg, mesh=mesh, data_axis=DATA_AXIS)
+    args = [jnp.asarray(td.binned.bins), jnp.zeros(n, jnp.float32),
+            jnp.ones(n, jnp.float32), jnp.ones(n, jnp.float32),
+            jnp.ones(features, bool), meta["num_bins_per_feature"],
+            meta["nan_bins"], meta["is_categorical"], meta["monotone"]]
+    return grow.lower(*args).compile().as_text()
+
+
+def main():
+    n_shards = int(sys.argv[1]) if len(sys.argv) > 1 else 8
+    rows = int(sys.argv[2]) if len(sys.argv) > 2 else 4096
+
+    import _hermetic
+    _hermetic.force_cpu(n_shards)
+
+    blob = {"metric": "comm_census"}
+    for comm in ("allreduce", "reduce_scatter"):
+        txt = compile_sharded_grower_hlo(comm, n_shards, rows)
+        blob[comm] = census_summary(txt, n_shards)
+    ar = blob["allreduce"]["comm_bytes_per_wave"]
+    rs = blob["reduce_scatter"]["comm_bytes_per_wave"]
+    blob["reduction_ratio"] = round(ar / max(rs, 1.0), 3)
+    print(json.dumps(blob))
+
+
+if __name__ == "__main__":
+    main()
