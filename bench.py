@@ -52,6 +52,24 @@ ITER_PACK = int(os.environ.get("BENCH_ITER_PACK", 12))
 PREDICT_CHECK = os.environ.get("BENCH_PREDICT", "1") == "1"
 PREDICT_CALLS = int(os.environ.get("BENCH_PREDICT_CALLS", 40))
 PREDICT_MAX_BATCH = int(os.environ.get("BENCH_PREDICT_MAX_BATCH", 8192))
+# Shape-matrix rungs (ISSUE-4 / BASELINE.md table beyond Higgs): a
+# lambdarank rung at the MS-LTR geometry (137 features, query groups,
+# NDCG@5 reported) and a wide rung at the Epsilon geometry (dense F=2000,
+# where the bounded histogram pool + tiled split scan are what make the
+# shape fit).  Each emits its own blob inside detail.* and never disturbs
+# the primary Higgs metric (emitted first; rung failures record an error
+# string).  On the hermetic CPU fallback both rungs shrink with the
+# primary row budget so the JSON always materializes.
+LTR_CHECK = os.environ.get("BENCH_LTR", "1") == "1"
+LTR_ROWS = int(os.environ.get("BENCH_LTR_ROWS", 2_270_000))   # MS-LTR scale
+LTR_FEATURES = int(os.environ.get("BENCH_LTR_FEATURES", 137))
+LTR_ITERS = int(os.environ.get("BENCH_LTR_ITERS", 15))
+LTR_GROUP = int(os.environ.get("BENCH_LTR_GROUP", 120))       # docs/query
+WIDE_CHECK = os.environ.get("BENCH_WIDE", "1") == "1"
+WIDE_ROWS = int(os.environ.get("BENCH_WIDE_ROWS", 400_000))   # Epsilon scale
+WIDE_FEATURES = int(os.environ.get("BENCH_WIDE_FEATURES", 2000))
+WIDE_ITERS = int(os.environ.get("BENCH_WIDE_ITERS", 10))
+WIDE_POOL_MB = float(os.environ.get("BENCH_WIDE_POOL_MB", 256.0))
 
 
 def _pack_eff(iters, pack):
@@ -82,26 +100,161 @@ def bench_params():
     return params
 
 
-def make_higgs_like(n, f, seed=0):
-    cache = _cache_path(f"higgs_{n}x{f}_s{seed}.npz")
+def _cached_dataset(name, build):
+    """Disk-cached synthetic data: wedge-ladder retries re-run the bench in
+    a fresh child process (see _cache_path), so every rung's matrix — not
+    just Higgs — must survive the retry instead of minutes of numpy
+    regeneration.  ``build()`` returns a dict of arrays; returns the same
+    dict loaded or built."""
+    cache = _cache_path(name)
     if cache and os.path.exists(cache):
         try:
             with np.load(cache) as d:
-                return d["X"], d["y"]
+                return dict(d)
         except Exception:  # noqa: BLE001 — torn/stale cache: regenerate
             _cache_drop(cache)
-    rng = np.random.RandomState(seed)
-    X = rng.randn(n, f).astype(np.float32)
-    w = rng.randn(f) / np.sqrt(f)
-    logits = X @ w + 0.5 * np.sin(X[:, 0] * 2) * X[:, 1]
-    p = 1 / (1 + np.exp(-logits))
-    y = (rng.rand(n) < p).astype(np.float64)
+    arrays = build()
     if cache:
         def _write(path):
             with open(path, "wb") as fh:   # handle keeps the exact name
-                np.savez(fh, X=X, y=y)
+                np.savez(fh, **arrays)
         _cache_write(cache, _write)
-    return X, y
+    return arrays
+
+
+def make_higgs_like(n, f, seed=0):
+    def build():
+        rng = np.random.RandomState(seed)
+        X = rng.randn(n, f).astype(np.float32)
+        w = rng.randn(f) / np.sqrt(f)
+        logits = X @ w + 0.5 * np.sin(X[:, 0] * 2) * X[:, 1]
+        p = 1 / (1 + np.exp(-logits))
+        y = (rng.rand(n) < p).astype(np.float64)
+        return {"X": X, "y": y}
+    d = _cached_dataset(f"higgs_{n}x{f}_s{seed}.npz", build)
+    return d["X"], d["y"]
+
+
+def make_msltr_like(n, f, group, seed=0):
+    """MS-LTR-like synthetic ranking data: fixed-size query groups, graded
+    relevance 0-4 skewed to low grades (the reference's LTR benchmark
+    shape, docs/Experiments.rst:115)."""
+    def build():
+        rng = np.random.RandomState(seed)
+        X = rng.randn(n, f).astype(np.float32)
+        w = rng.randn(f) / np.sqrt(f)
+        util = X @ w + 0.3 * rng.randn(n)
+        # per-row grade from global utility quantiles (60/20/10/7/3%)
+        cuts = np.quantile(util, [0.60, 0.80, 0.90, 0.97])
+        y = np.searchsorted(cuts, util).astype(np.float64)
+        groups = np.full(n // group, group, np.int64)
+        rem = n - groups.sum()
+        if rem:
+            groups = np.concatenate([groups, [rem]])
+        return {"X": X, "y": y, "groups": groups}
+    d = _cached_dataset(f"msltr_{n}x{f}_g{group}_s{seed}.npz", build)
+    return d["X"], d["y"], d["groups"]
+
+
+def make_epsilon_like(n, f, seed=0):
+    """Epsilon-like dense wide binary data (f ~ 2000 gaussian features)."""
+    def build():
+        rng = np.random.RandomState(seed)
+        X = rng.randn(n, f).astype(np.float32)
+        w = rng.randn(f) / np.sqrt(f)
+        y = (X @ w + 0.5 * rng.randn(n) > 0).astype(np.float64)
+        return {"X": X, "y": y}
+    d = _cached_dataset(f"epsilon_{n}x{f}_s{seed}.npz", build)
+    return d["X"], d["y"]
+
+
+def _rung_train(params, ds_kw, iters, jax):
+    """Train one side-rung booster and return (booster, elapsed_s)."""
+    import lightgbm_tpu as lgb
+
+    ds = lgb.Dataset(ds_kw.pop("X"), **ds_kw)
+    ds.construct(params)
+    bst = lgb.Booster(params=params, train_set=ds)
+    bst.update()                                    # warmup compile
+    np.array(jax.device_get(bst._gbdt.scores[:8]))
+    t0 = time.time()
+    for _ in range(iters):
+        bst.update()
+    np.array(jax.device_get(bst._gbdt.scores[:8]))
+    return bst, time.time() - t0
+
+
+def run_ltr_rung(rows, iters, platform, jax, features=None, group=None,
+                 num_leaves=None):
+    """lambdarank throughput + NDCG@5 sample at the MS-LTR geometry;
+    returns the detail blob."""
+    features = features or LTR_FEATURES
+    group = group or LTR_GROUP
+    num_leaves = num_leaves or NUM_LEAVES
+    X, y, groups = make_msltr_like(rows, features, group)
+    params = {"objective": "lambdarank", "num_leaves": num_leaves,
+              "learning_rate": 0.1, "max_bin": 255, "min_data_in_leaf": 0,
+              "min_sum_hessian_in_leaf": 100.0, "metric": "none",
+              "verbosity": -1, "tpu_leaf_batch": LEAF_BATCH}
+    bst, elapsed = _rung_train(
+        params, dict(X=X, label=y, group=groups), iters, jax)
+    ndcg = None
+    try:
+        from lightgbm_tpu.metrics import _ndcg_multi
+        nq = min(len(groups), 500)
+        ns = int(groups[:nq].sum())
+        pred = bst.predict(X[:ns], raw_score=True)
+        gains = np.array([2.0 ** i - 1.0 for i in range(32)])
+        ndcg = _ndcg_multi(y[:ns], pred, groups[:nq], [5], gains)[0]
+    except Exception:  # noqa: BLE001 — metric is garnish, rate is the rung
+        pass
+    return {
+        "rows": rows, "features": features, "iters": iters,
+        "num_leaves": num_leaves, "queries": int(len(groups)),
+        "docs_per_query": group, "platform": platform,
+        "train_time_s": round(elapsed, 3),
+        "row_iters_per_sec": round(rows * iters / elapsed, 1),
+        "ndcg5_train_sample": None if ndcg is None else round(ndcg, 6),
+    }
+
+
+def run_wide_rung(rows, iters, platform, jax, features=None,
+                  num_leaves=None, max_bin=None, pool_mb=None):
+    """Dense-wide (Epsilon-like) rung: the (L, F, B, 3) leaf-histogram
+    carry that motivates the bounded pool (~1.5 GB f32 unpooled at
+    F=2000/B=256/L=255).  Trains with histogram_pool_size set so the blob
+    also witnesses the pooled carry; returns the detail blob."""
+    features = features or WIDE_FEATURES
+    # CPU fallback: XLA-on-host cannot afford B=256 x F=2000 histograms —
+    # shrink depth/bins, keep the WIDTH (the shape under test).
+    cpu = platform == "cpu"
+    num_leaves = num_leaves or (63 if cpu else NUM_LEAVES)
+    max_bin = max_bin or (63 if cpu else 255)
+    pool_mb = WIDE_POOL_MB if pool_mb is None else pool_mb
+    X, y = make_epsilon_like(rows, features)
+    params = {"objective": "binary", "num_leaves": num_leaves,
+              "learning_rate": 0.1, "max_bin": max_bin,
+              "min_data_in_leaf": 0, "min_sum_hessian_in_leaf": 100.0,
+              "metric": "none", "verbosity": -1,
+              "tpu_leaf_batch": min(LEAF_BATCH, 8),
+              "histogram_pool_size": pool_mb}
+    bst, elapsed = _rung_train(params, dict(X=X, label=y), iters, jax)
+    g = bst._gbdt
+    bins = g.train_data.binned.max_num_bins
+    slots = g.grow.pool_slots(features)
+    return {
+        "rows": rows, "features": features, "iters": iters,
+        "num_leaves": num_leaves, "max_bin": max_bin, "platform": platform,
+        "train_time_s": round(elapsed, 3),
+        "row_iters_per_sec": round(rows * iters / elapsed, 1),
+        "histogram_pool_mb": pool_mb,
+        "pool_slots": int(slots),
+        "pool_engaged": bool(g.grow.pool_capable and slots < num_leaves),
+        "leaf_hist_mb_unpooled": round(
+            num_leaves * features * bins * 3 * 4 / 2**20, 1),
+        "leaf_hist_mb_pooled": round(
+            slots * features * bins * 3 * 4 / 2**20, 1),
+    }
 
 
 def _cache_path(name):
@@ -267,7 +420,8 @@ def run_bench(rows, iters):
             "plan_cache_hits": snap["plan_cache"]["hits"],
         }
 
-    def emit(quant_rate, predict_stats=None):
+    def emit(quant_rate, predict_stats=None, ltr_stats=None,
+             wide_stats=None):
         print(json.dumps({
             "metric": "binary_255leaves_row_iters_per_sec",
             "value": round(row_iters_per_sec, 1),
@@ -296,6 +450,10 @@ def run_bench(rows, iters):
                     round(quant_rate, 1) if isinstance(quant_rate, float)
                     else quant_rate),
                 "predict": predict_stats,
+                # Shape-matrix rungs (VERDICT weak #2): ranking and
+                # wide-feature geometries measured alongside Higgs.
+                "lambdarank": ltr_stats,
+                "wide": wide_stats,
                 "reference": "LightGBM CPU 16t Higgs 10.5Mx28 500it in "
                              "130.094s (docs/Experiments.rst:113)",
             },
@@ -315,6 +473,28 @@ def run_bench(rows, iters):
             predict_stats = {"error": f"{e!r}"[:200]}
         emit(None, predict_stats)
 
+    # Side rungs re-emit cumulatively after each completes, so a wedged
+    # later rung can never forfeit an earlier one (the outer runner
+    # salvages the LAST metric line).  Row/iter budgets derive from the
+    # primary budget, so the CPU fallback shrinks them automatically.
+    ltr_stats = wide_stats = None
+    if LTR_CHECK:
+        try:
+            ltr_stats = run_ltr_rung(
+                max(min(LTR_ROWS, rows // 4), 4096),
+                max(min(LTR_ITERS, iters), 2), platform, jax)
+        except Exception as e:  # noqa: BLE001
+            ltr_stats = {"error": f"{e!r}"[:200]}
+        emit(None, predict_stats, ltr_stats)
+    if WIDE_CHECK:
+        try:
+            wide_stats = run_wide_rung(
+                max(min(WIDE_ROWS, rows // 8), 4096),
+                max(min(WIDE_ITERS, iters // 2), 2), platform, jax)
+        except Exception as e:  # noqa: BLE001
+            wide_stats = {"error": f"{e!r}"[:200]}
+        emit(None, predict_stats, ltr_stats, wide_stats)
+
     quant_rate = None
     if QUANT_CHECK and not QUANTIZED:
         try:
@@ -326,7 +506,7 @@ def run_bench(rows, iters):
         except Exception as e:  # noqa: BLE001
             quant_rate = f"failed: {e!r}"[:200]
     if quant_rate is not None:
-        emit(quant_rate, predict_stats)
+        emit(quant_rate, predict_stats, ltr_stats, wide_stats)
 
 
 def _scan_json(stdout):

@@ -1,0 +1,52 @@
+"""Regenerate the tiny example datasets (committed alongside the configs,
+mirroring the reference's ``examples/*`` layout where each task ships
+``<name>.train`` / ``<name>.test`` TSV files with the label in column 0
+and ``.query`` side files for ranking).
+
+    python examples/generate_data.py
+"""
+
+import os
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def _write(path, y, X):
+    np.savetxt(path, np.column_stack([y, X]), delimiter="\t", fmt="%.5g")
+
+
+def binary(n_train=500, n_test=100, f=10, seed=0):
+    rng = np.random.RandomState(seed)
+    n = n_train + n_test
+    X = rng.randn(n, f)
+    y = (X[:, 0] + 0.8 * X[:, 1] * X[:, 2] + 0.3 * rng.randn(n) > 0)
+    d = os.path.join(HERE, "binary_classification")
+    os.makedirs(d, exist_ok=True)
+    _write(os.path.join(d, "binary.train"), y[:n_train], X[:n_train])
+    _write(os.path.join(d, "binary.test"), y[n_train:], X[n_train:])
+
+
+def lambdarank(n_queries=40, docs=20, f=6, seed=1):
+    rng = np.random.RandomState(seed)
+    n = n_queries * docs
+    X = rng.randn(n, f)
+    util = X[:, 0] + 0.5 * X[:, 1] + 0.3 * rng.randn(n)
+    cuts = np.quantile(util, [0.6, 0.9])
+    y = np.searchsorted(cuts, util)          # graded relevance 0-2
+    d = os.path.join(HERE, "lambdarank")
+    os.makedirs(d, exist_ok=True)
+    n_train = (n_queries - 8) * docs
+    _write(os.path.join(d, "rank.train"), y[:n_train], X[:n_train])
+    _write(os.path.join(d, "rank.test"), y[n_train:], X[n_train:])
+    np.savetxt(os.path.join(d, "rank.train.query"),
+               np.full(n_queries - 8, docs, np.int64), fmt="%d")
+    np.savetxt(os.path.join(d, "rank.test.query"),
+               np.full(8, docs, np.int64), fmt="%d")
+
+
+if __name__ == "__main__":
+    binary()
+    lambdarank()
+    print("wrote examples/binary_classification + examples/lambdarank data")

@@ -196,6 +196,14 @@ _PARAMS: List[Tuple[str, Any, Any, Tuple[str, ...], Optional[Tuple[Any, Any]]]] 
     # (voting, intermediate/advanced monotone and forced splits keep
     # allreduce; the mask layout keeps its own reductions).
     ("tpu_hist_comm", str, "auto", (), None),  # auto|allreduce|reduce_scatter
+    # Feature-block width for the split scan's (F, B) cumsum/gain buffers:
+    # wide feature spaces evaluate candidates per G-block through a
+    # sequential map so peak scan scratch stops scaling with full F.
+    # 0 = auto (128-wide blocks once the scan width exceeds 256 columns),
+    # 1 = untiled, >= 2 = explicit block width.  The winner is selected
+    # with the untiled argmax's exact tie-break order, so tiling never
+    # changes the chosen split (ops/split.py best_split).
+    ("tpu_split_tile", int, 0, (), (0, None)),
     # Boosting rounds fused into ONE scanned XLA dispatch (iteration
     # packing, docs/ITER_PACK.md).  0 = auto: pack whenever the config is
     # pack-capable with static row/feature masks; explicit K >= 1 forces

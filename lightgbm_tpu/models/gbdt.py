@@ -70,6 +70,7 @@ def _split_config(cfg: Config, train: Optional[TrainData] = None) -> SplitConfig
                       or cfg.cegb_tradeoff < 1.0),
         cegb_tradeoff=cfg.cegb_tradeoff,
         cegb_penalty_split=cfg.cegb_penalty_split,
+        scan_tile=cfg.tpu_split_tile,
         **facts,
     )
 
@@ -183,12 +184,14 @@ class GBDT:
                 "data=<file> or dataset.load_train_data_two_round); this "
                 "dataset came from in-memory arrays, which are already "
                 "materialized")
-        # Host-threading / histogram-memory / GPU-device knobs have no TPU
-        # analog (XLA owns threading and fusion; leaf histograms live in
-        # HBM; the device is the jax backend) — warn instead of silently
-        # accepting (round-2 verdict: no silent dead params).
+        # Host-threading / GPU-device knobs have no TPU analog (XLA owns
+        # threading and fusion; the device is the jax backend) — warn
+        # instead of silently accepting (round-2 verdict: no silent dead
+        # params).  histogram_pool_size is NOT on this list: it bounds the
+        # growth loop's device-resident leaf-histogram carry (grower
+        # P-slot pool, reference HistogramPool).
         for pname in ("num_threads", "force_col_wise", "force_row_wise",
-                      "histogram_pool_size", "gpu_platform_id",
+                      "gpu_platform_id",
                       "gpu_device_id", "gpu_use_dp", "num_gpu"):
             if pname in cfg.raw_params:
                 Log.warning(
@@ -286,16 +289,26 @@ class GBDT:
             mono_static=(tuple(int(m) for m in train.monotone_constraints)
                          if self._mono_advanced else None),
             hist_comm=cfg.tpu_hist_comm,
+            histogram_pool_size=cfg.histogram_pool_size,
         )
-        from .grower import fp_capable_for, rs_active_for
+        from .grower import fp_capable_for, pool_active_for, rs_active_for
         if (cfg.tpu_hist_comm == "reduce_scatter"
                 and not rs_active_for(self.grower_cfg, self.mesh,
                                       DATA_AXIS)):
             Log.warning(
                 "tpu_hist_comm=reduce_scatter needs a data-parallel mesh "
                 "and a composition without voting, "
-                "intermediate/advanced monotone constraints or forced "
-                "splits; keeping the full-histogram allreduce")
+                "intermediate/advanced monotone constraints, forced "
+                "splits or (non-EFB) feature_contri; keeping the "
+                "full-histogram allreduce")
+        if (cfg.histogram_pool_size >= 0
+                and not pool_active_for(self.grower_cfg, self.mesh,
+                                        DATA_AXIS)):
+            Log.warning(
+                "histogram_pool_size is ignored for this composition: the "
+                "GSPMD mask layout, voting-parallel and the intermediate/"
+                "advanced monotone refresh need every leaf histogram "
+                "resident; keeping the full (num_leaves, ...) carry")
         if (self.mesh is not None and not data_only_mesh
                 and hist_impl == "auto"
                 and not fp_capable_for(self.grower_cfg, self.mesh,
@@ -546,6 +559,16 @@ class GBDT:
             else:
                 self.valid_scores[i] = self.valid_scores[i] + pred
 
+    @property
+    def fused_path_active(self) -> bool:
+        """Does ``train_one_iter`` (without explicit gradients) take the
+        fused one-dispatch path?  The ONE predicate shared with
+        ``tools/profile_iter.py``'s dispatch census so the census label can
+        never disagree with the branch actually taken."""
+        return (self._fused_iter is not None
+                and not self.sample_strategy.is_goss
+                and not self._use_cegb and not self.cfg.linear_tree)
+
     def train_one_iter(self, grad: Optional[np.ndarray] = None,
                        hess: Optional[np.ndarray] = None) -> bool:
         """One boosting iteration (reference ``GBDT::TrainOneIter``).  Returns
@@ -564,9 +587,7 @@ class GBDT:
                 if self._split_key is not None else None)
 
         results = []
-        used_fused = (grad is None and self._fused_iter is not None
-                      and not self.sample_strategy.is_goss
-                      and not self._use_cegb and not cfg.linear_tree)
+        used_fused = grad is None and self.fused_path_active
         if used_fused:
             # Hot path: ONE device dispatch for gradients + all class trees +
             # score updates.

@@ -46,6 +46,20 @@ Histograms are carried RAW in ``leaf_hist`` (int32 under quantized training)
 and scaled to f32 only at split-scan consumption, so sibling subtraction is
 EXACT integer arithmetic and cross-shard reduction moves integer tensors —
 the reference's integer histogram reducers (``bin.h:48-81``).
+
+``histogram_pool_size`` bounds the ``leaf_hist`` carry (reference
+``HistogramPool``, ``serial_tree_learner.h``): instead of one resident
+histogram per leaf (~523 MB f32 at the Yahoo-LTR shape (255, 700, 256, 3),
+~1.5 GB at Epsilon F=2000) the perm/wave/sharded layouts carry a P-slot
+pool with an int32 ``leaf->slot`` indirection — a slot is claimed when a
+leaf's smaller-sibling histogram is built, the larger sibling's
+subtraction lands in the parent's slot, eviction is LRU over unpinned
+slots, and a miss (an evicted histogram needed again: splitting an old
+leaf, forced splits) recomputes from the leaf's contiguous perm segment in
+creation-time row order and re-reduces across shards like the resident
+path.  Under ``hist_comm=reduce_scatter`` a slot holds only the owned
+``ceil(G/K)`` feature slice, so the savings multiply.  See
+``pool_active_for`` for the compositions that keep full residency.
 """
 
 from __future__ import annotations
@@ -166,6 +180,19 @@ class GrowerConfig:
     # allows (see rs_active_for); voting mode and the mask layout keep
     # their own reductions in every mode.
     hist_comm: str = "auto"
+    # Bounded histogram pool (reference HistogramPool,
+    # serial_tree_learner.h: LRU slots + recompute-on-miss), reference MB
+    # semantics: the growth loop carries only P = floor(MB / slot_bytes)
+    # leaf histograms (slot = one (G, B, 3) f32/int32 leaf histogram — the
+    # owned ceil(G/K) slice under hist_comm=reduce_scatter, so the savings
+    # multiply) behind an int32 leaf->slot indirection.  -1 = unbounded =
+    # the full (L, G, B, 3) carry.  Auto-clamped to [2*leaf_batch + 1, L]
+    # so the wave frontier (W parents pinned for sibling subtraction + W
+    # freshly built smaller siblings) always fits.  Engages on the
+    # perm/wave/sharded-perm layouts (see pool_active_for); the mask
+    # layout, voting and the intermediate/advanced monotone refresh keep
+    # full residency.
+    histogram_pool_size: float = -1.0
 
 
 class TreeArrays(NamedTuple):
@@ -207,7 +234,15 @@ class _GrowState(NamedTuple):
     perm: jnp.ndarray            # (N + max_bucket,) i32 rows grouped by leaf
     leaf_start: jnp.ndarray      # (L,) i32 slice start per leaf
     leaf_rows: jnp.ndarray       # (L,) i32 physical row count per leaf
-    leaf_hist: jnp.ndarray       # (L, F, B, 3) f32
+    leaf_hist: jnp.ndarray       # (P, G, B, 3) histogram POOL (P == L and
+                                 #   slot == leaf id when unpooled; bounded
+                                 #   P with leaf_slot indirection otherwise)
+    leaf_slot: jnp.ndarray       # (L,) i32 pool slot per leaf, -1 evicted
+                                 #   ((1,) dummy when unpooled)
+    slot_leaf: jnp.ndarray       # (P,) i32 owner leaf per slot, -1 free
+                                 #   ((1,) dummy when unpooled)
+    slot_tick: jnp.ndarray       # (P,) i32 LRU stamp ((1,) dummy)
+    tick: jnp.ndarray            # () i32 pool claim counter
     leaf_sum_grad: jnp.ndarray   # (L,)
     leaf_sum_hess: jnp.ndarray   # (L,)
     leaf_count: jnp.ndarray      # (L,) in-bag counts (histogram count channel)
@@ -277,11 +312,15 @@ def fp_capable_for(cfg: GrowerConfig, mesh, data_axis: str) -> bool:
     if len(others) != 1 or int(mesh.shape[others[0]]) <= 1:
         return False
     n_forced = len(cfg.forced_splits or ())
+    # feature_contri is a static full-F tuple truncated to the scan width —
+    # a per-shard feature slice would apply shard 0's multipliers
+    # everywhere, so those configs keep the (full-F) mask fallback.
     return (int(mesh.shape[data_axis]) == 1 and cfg.leaf_batch == 1
             and not cfg.voting and not cfg.split.extra_trees
             and cfg.feature_fraction_bynode >= 1.0
             and not cfg.interaction_groups and not cfg.split.use_cegb
             and not n_forced and not cfg.bundled
+            and not cfg.split.feature_contri
             and not ((cfg.mono_intermediate or cfg.mono_advanced)
                      and cfg.split.has_monotone))
 
@@ -299,7 +338,12 @@ def rs_active_for(cfg: GrowerConfig, mesh, data_axis: str) -> bool:
       leaf from its resident histogram and the advanced bound tensors live
       in full feature space — both need the replicated leaf_hist;
     - forced splits: _apply_forced derives child stats from the full
-      histogram row of an arbitrary (forced) feature.
+      histogram row of an arbitrary (forced) feature;
+    - feature_contri without EFB: the multipliers are a STATIC full-F
+      tuple baked into the scan, which truncates to the local width — a
+      slice-local scan would apply shard 0's block to every shard's owned
+      features.  (The EFB slice keeps the full-F scan under an ownership
+      mask, so it composes.)
     """
     if cfg.hist_comm not in ("auto", "reduce_scatter"):
         return False
@@ -310,6 +354,39 @@ def rs_active_for(cfg: GrowerConfig, mesh, data_axis: str) -> bool:
     if cfg.voting:
         return False
     if cfg.forced_splits:
+        return False
+    if cfg.split.feature_contri and not cfg.bundled:
+        return False
+    if (cfg.mono_intermediate or cfg.mono_advanced) and cfg.split.has_monotone:
+        return False
+    return True
+
+
+def pool_active_for(cfg: GrowerConfig, mesh=None,
+                    data_axis: str = "data") -> bool:
+    """Static predicate: may this config bound the leaf-histogram carry
+    with the P-slot pool (``histogram_pool_size`` >= 0, reference
+    ``HistogramPool`` semantics) instead of full (L, G, B, 3) residency?
+    Shared by make_grower's layouts, GBDT's knob resolution and tests so
+    they cannot disagree.
+
+    Excluded compositions (these keep full residency):
+    - the GSPMD mask layout (``gather_rows=False``): leaves have no
+      contiguous row segment to recompute an evicted histogram from;
+    - voting: the wave body and root scan read resident LOCAL parent
+      histograms that are never globally reduced;
+    - intermediate/advanced monotone: the per-step refresh rescans EVERY
+      leaf from its resident histogram — a bounded pool would recompute
+      L-P histograms per step.
+
+    Note the actual slot count is shape-dependent (``hist_cols``): a pool
+    large enough to hold all L leaves degenerates to the unpooled carry
+    even when this predicate is True."""
+    if cfg.histogram_pool_size < 0:
+        return False
+    if not cfg.gather_rows:
+        return False
+    if cfg.voting:
         return False
     if (cfg.mono_intermediate or cfg.mono_advanced) and cfg.split.has_monotone:
         return False
@@ -547,6 +624,102 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
             "reduce_scatter")
     rs_on = rs_active_for(cfg, mesh, data_axis)
     rs_shards = 1 if mesh is None else int(mesh.shape[data_axis])
+    # ---- bounded histogram pool (reference HistogramPool,
+    # serial_tree_learner.h: cache_size slots, LRU eviction, recompute on a
+    # cache miss).  P slots replace the full (L, ...) leaf_hist carry; the
+    # leaf->slot indirection lives in the growth state.
+    pool_capable = pool_active_for(cfg, mesh, data_axis)
+    _W_FRONTIER = min(cfg.leaf_batch, max(L - 1, 1))
+
+    def _pool_slots(hist_cols: int) -> int:
+        """Static slot count for a pool over (hist_cols, HB, 3) 4-byte
+        slots under the reference's MB semantics, clamped so one wave
+        always fits (W parent slots stay pinned for sibling subtraction
+        while up to 2W child slots materialize) and to L (>= L slots ==
+        today's unpooled carry, returned as exactly L)."""
+        if not pool_capable:
+            return L
+        slot_bytes = hist_cols * HB * 3 * 4
+        p = int(float(cfg.histogram_pool_size) * (1 << 20)
+                // max(slot_bytes, 1))
+        floor = min(2 * _W_FRONTIER + 1, L)
+        return min(max(p, floor), L)
+
+    def _pool_ops(P):
+        """Slot machinery for a P-slot pool: LRU claim/evict and ownership
+        bookkeeping, shared by the perm (W=1) and wave (W>1) bodies."""
+        IMAX = jnp.iinfo(jnp.int32).max
+
+        def claim(st, sp, active, miss):
+            """Claim pool slots for W splitting leaves: each active leaf j
+            needs one fresh slot for its smaller child's histogram; the
+            larger child reuses the parent's slot ``sp[j]`` (the sibling
+            subtraction lands in place, the reference's
+            ``FeatureHistogram::Subtract`` into the parent's pool entry) —
+            or a second fresh slot when the parent's histogram was evicted
+            (``miss``).  Free slots are claimed first, then the least-
+            recently-stamped unpinned slot; parents of this wave and
+            already-claimed slots are pinned.  Returns
+            ``(st, slot_small (W,), slot_big (W,))`` with evicted leaves'
+            ``leaf_slot`` cleared; sentinel P marks inactive lanes."""
+            Wc = sp.shape[0]
+            pin0 = jnp.zeros(P + 1, bool).at[
+                jnp.where(active & (sp >= 0), sp, P)].set(True)[:P]
+            base = jnp.where(st.slot_leaf < 0, jnp.int32(-1), st.slot_tick)
+
+            def claim_one(j, carry):
+                pin, ss, sb, ev = carry
+                key = jnp.where(pin, IMAX, base)
+                v1 = jnp.argmin(key).astype(jnp.int32)
+                key2 = jnp.where(jnp.arange(P) == v1, IMAX, key)
+                v2 = jnp.argmin(key2).astype(jnp.int32)
+                act, use2 = active[j], miss[j]
+                pin_n = pin.at[v1].set(True)
+                pin_n = jnp.where(use2, pin_n.at[v2].set(True), pin_n)
+                pin = jnp.where(act, pin_n, pin)
+                ev = ev.at[2 * j].set(jnp.where(act, st.slot_leaf[v1], -1))
+                ev = ev.at[2 * j + 1].set(
+                    jnp.where(act & use2, st.slot_leaf[v2], -1))
+                ss = ss.at[j].set(jnp.where(act, v1, P))
+                sb = sb.at[j].set(
+                    jnp.where(act, jnp.where(use2, v2, sp[j]), P))
+                return pin, ss, sb, ev
+
+            _, ss, sb, ev = jax.lax.fori_loop(
+                0, Wc, claim_one,
+                (pin0, jnp.zeros(Wc, jnp.int32), jnp.zeros(Wc, jnp.int32),
+                 jnp.full(2 * Wc, -1, jnp.int32)))
+            leaf_slot = st.leaf_slot.at[
+                jnp.where(ev >= 0, ev, L)].set(-1, mode="drop")
+            return st._replace(leaf_slot=leaf_slot), ss, sb
+
+        def assign(st, children, slots):
+            """Record ownership + LRU stamps for 2W (child leaf, slot)
+            pairs; sentinel indices (leaf >= L / slot >= P) drop."""
+            return st._replace(
+                leaf_slot=st.leaf_slot.at[children].set(slots, mode="drop"),
+                slot_leaf=st.slot_leaf.at[slots].set(children, mode="drop"),
+                slot_tick=st.slot_tick.at[slots].set(st.tick, mode="drop"),
+                tick=st.tick + 1)
+
+        return claim, assign
+
+    def _pool_setup(pool_cols, axis, rs):
+        """Per-layout pool context shared by _grow_perm and _grow_wave:
+        slot count, activity flag, claim/assign ops, and the reduce every
+        recomputed (miss) histogram must ride so its value matches the
+        resident path's."""
+        P = _pool_slots(pool_cols)
+        pool_on = P < L
+        pool_claim, pool_assign = _pool_ops(P) if pool_on else (None, None)
+
+        def reduce_hist(h):
+            if axis is None:
+                return h
+            return rs["scatter"](h) if rs is not None \
+                else jax.lax.psum(h, axis)
+
+        return P, pool_on, pool_claim, pool_assign, reduce_hist
     if inter and cfg.voting:
         raise ValueError(
             "monotone_constraints_method=intermediate/advanced does not "
@@ -682,7 +855,7 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
         return pen
 
     def _init_state(n, f, gcols, root_hist, root_g, root_h, root_c,
-                    key=None):
+                    key=None, pool_slots=None):
         tree = TreeArrays(
             split_feature=jnp.zeros(M, jnp.int32),
             split_bin=jnp.zeros(M, jnp.int32),
@@ -699,13 +872,21 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
             leaf_weight=jnp.zeros(L, jnp.float32),
             num_leaves=jnp.asarray(1, jnp.int32),
         )
+        P = L if pool_slots is None else pool_slots
+        pooled = P < L
         return _GrowState(
             num_leaves=jnp.asarray(1, jnp.int32),
             perm=jnp.zeros(0, jnp.int32),  # set by caller when used
             leaf_start=jnp.zeros(L, jnp.int32),
             leaf_rows=jnp.zeros(L, jnp.int32).at[0].set(n),
-            leaf_hist=jnp.zeros((L, gcols, HB, 3),
+            leaf_hist=jnp.zeros((P, gcols, HB, 3),
                                 root_hist.dtype).at[0].set(root_hist),
+            leaf_slot=(jnp.full(L, -1, jnp.int32).at[0].set(0) if pooled
+                       else jnp.zeros(1, jnp.int32)),
+            slot_leaf=(jnp.full(P, -1, jnp.int32).at[0].set(0) if pooled
+                       else jnp.zeros(1, jnp.int32)),
+            slot_tick=jnp.zeros(P if pooled else 1, jnp.int32),
+            tick=jnp.asarray(1, jnp.int32),
             leaf_sum_grad=jnp.zeros(L, jnp.float32).at[0].set(root_g),
             leaf_sum_hess=jnp.zeros(L, jnp.float32).at[0].set(root_h),
             leaf_count=jnp.zeros(L, jnp.float32).at[0].set(root_c),
@@ -780,9 +961,11 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
     def _children_updates(st, leaf, new_leaf, hist_left, hist_right,
                           gl, hl, cl, gr, hr, cr, meta, feature_mask,
                           cegb=None, groups_mat=None, scale3=None,
-                          sync=None, fp_mono=None, rs=None):
+                          sync=None, fp_mono=None, rs=None, slots2=None):
         """Store child stats + their best splits (both children batched into
-        single 2-row scatters to minimize kernel count in the hot loop)."""
+        single 2-row scatters to minimize kernel count in the hot loop).
+        ``slots2`` redirects the two histogram writes into pool slots
+        (bounded pool active); default is the unpooled slot == leaf id."""
         depth = st.leaf_depth[leaf] + 1
         node = st.num_leaves - 1
         pair = jnp.stack([leaf, new_leaf])
@@ -878,7 +1061,8 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
                                     g2, h2, c2, rs)    # scaled (split scan)
         st = st._replace(
             num_leaves=st.num_leaves + 1,
-            leaf_hist=st.leaf_hist.at[pair].set(hist2),
+            leaf_hist=st.leaf_hist.at[
+                pair if slots2 is None else slots2].set(hist2),
             leaf_sum_grad=st.leaf_sum_grad.at[pair].set(g2),
             leaf_sum_hess=st.leaf_sum_hess.at[pair].set(h2),
             leaf_count=st.leaf_count.at[pair].set(c2),
@@ -1395,12 +1579,19 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
                 packed4=cfg.packed4, features=nf)
         return branch
 
-    def _apply_forced(st, scale3, meta):
+    def _apply_forced(st, scale3, meta, hist_of=None):
         """When the current step has a pending forced split (reference
         ForceSplits, serial_tree_learner.cpp:620), overwrite that leaf's
         stored best split with the forced (feature, bin) and its histogram-
         derived child stats; growth then proceeds through the normal split
-        machinery.  Returns (state, forced_active, forced_index)."""
+        machinery.  Returns (state, forced_active, forced_index).
+        ``hist_of(st, leaf)`` abstracts the histogram lookup — under the
+        bounded pool it resolves the leaf's slot with recompute-on-miss
+        (reference HistogramPool::Get miss semantics).  A missed forced
+        leaf is recomputed here AND again as the split-time parent in the
+        same step (the result is not threaded through the forced-stats
+        cond); bounded at n_forced recomputes per tree, accepted for the
+        simpler lockstep structure."""
         step = st.num_leaves - 1
         use = step < n_forced
         si = jnp.clip(step, 0, n_forced - 1)
@@ -1409,8 +1600,10 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
         sbin = F_BIN[si]
 
         def _forced_stats(_):
+            raw = (hist_of(st, fleaf) if hist_of is not None
+                   else st.leaf_hist[fleaf])
             hist = _expand_hist(
-                _scale_hist(st.leaf_hist[fleaf], scale3), meta,
+                _scale_hist(raw, scale3), meta,
                 st.leaf_sum_grad[fleaf], st.leaf_sum_hess[fleaf],
                 st.leaf_count[fleaf])
             hb = hist[feat]                           # (B, 3)
@@ -1495,7 +1688,7 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
         return state, bs
 
     def _perm_setup(bins, vals, scale3, meta, feature_mask, cegb, key,
-                    groups_mat=None, axis=None, rs=None):
+                    groups_mat=None, axis=None, rs=None, pool_slots=None):
         """Shared permutation-layout prologue: padded arrays, buckets, root
         histogram/state/best-split.  ``axis`` = shard_map axis name for the
         cross-shard histogram reduction (None = single device); ``rs`` = the
@@ -1546,7 +1739,7 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
         if rs is not None:
             hist_cols = rs["go"]
         state = _init_state(n, nfeat, hist_cols, root_hist, root_g, root_h,
-                            root_c, key)
+                            root_c, key, pool_slots)
         state = state._replace(perm=perm0)
         root_pen = None
         if cfg.split.use_cegb and cegb is not None:
@@ -1615,14 +1808,16 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
                 m = jnp.where(owns, meta[3][jnp.clip(lf, 0, f - 1)], 0)
                 return jax.lax.psum(m, faxis)
         rs = None
+        hist_cols = f if cfg.packed4 else bins.shape[1]
         if axis is not None and rs_on:
-            hist_cols = f if cfg.packed4 else bins.shape[1]
             rs = _make_rs(axis, hist_cols, meta)
         sync = fp_sync if fp_sync is not None else (
             rs["sync"] if rs is not None else None)
+        P, pool_on, pool_claim, pool_assign, _reduce_hist = _pool_setup(
+            rs["go"] if rs is not None else hist_cols, axis, rs)
         (state, bins_pad, vals_pad, buckets, buckets_arr,
          max_bucket) = _perm_setup(bins, vals, scale3, meta, feature_mask,
-                                   cegb, key, groups_mat, axis, rs)
+                                   cegb, key, groups_mat, axis, rs, P)
         if fp_sync is not None:
             # _perm_setup stored the LOCAL root best; globalize it
             # (reference SyncUpGlobalBestSplit after the root scan).
@@ -1652,11 +1847,33 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
             return jnp.clip(jnp.searchsorted(buckets_arr, cnt, side="left"),
                             0, len(buckets) - 1).astype(jnp.int32)
 
+        def _pool_hist_of(st, l):
+            """Pool lookup with recompute-on-miss (reference
+            HistogramPool::Get returning false -> the learner reconstructs
+            the leaf's histogram from its rows): an evicted leaf's
+            histogram is rebuilt from its contiguous perm segment — whose
+            row order is untouched since the leaf was created, so a leaf
+            originally histogrammed directly recomputes bit-identically —
+            and re-reduced across shards exactly like the resident path."""
+            sl = st.leaf_slot[l]
+
+            def rec(_):
+                h = jax.lax.switch(
+                    _bucket_of(st.leaf_rows[l]), hist_branches, st.perm,
+                    st.leaf_start[l], st.leaf_rows[l])
+                return _reduce_hist(h)
+
+            return jax.lax.cond(
+                sl < 0, rec,
+                lambda _: st.leaf_hist[jnp.clip(sl, 0, P - 1)], None)
+
         def body(st: _GrowState) -> _GrowState:
             use_f = jnp.asarray(False)
             si = jnp.asarray(0)
             if n_forced:
-                st, use_f, si = _apply_forced(st, scale3, meta)
+                st, use_f, si = _apply_forced(
+                    st, scale3, meta,
+                    hist_of=_pool_hist_of if pool_on else None)
                 leaf = jnp.where(use_f, st.forced_leaf[si],
                                  jnp.argmax(st.best_gain)).astype(jnp.int32)
             else:
@@ -1669,6 +1886,12 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
                           st.leaf_count[leaf])
             gl, hl, cl = st.best_gl[leaf], st.best_hl[leaf], st.best_cl[leaf]
             gr, hr, cr = pg - gl, ph - hl, pc - cl
+            if pool_on:
+                # Parent histogram BEFORE the partition reorders the
+                # segment: resident slot, or recompute-on-miss from the
+                # leaf's rows in their creation-time order.
+                sp = st.leaf_slot[leaf]
+                hist_parent = _pool_hist_of(st, leaf)
 
             if faxis is not None:
                 glv = _fp_go_left(
@@ -1708,10 +1931,22 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
                 hist_small = (rs["scatter"](hist_small) if rs is not None
                               else jax.lax.psum(hist_small, axis))
 
-            hist_parent = st.leaf_hist[leaf]
+            if not pool_on:
+                hist_parent = st.leaf_hist[leaf]
             hist_big = hist_parent - hist_small
             hist_left = jnp.where(small_left, hist_small, hist_big)
             hist_right = jnp.where(small_left, hist_big, hist_small)
+
+            slots2 = None
+            if pool_on:
+                # Claim a slot for the smaller child; the larger child
+                # lands in the parent's slot (or a second claim on a miss).
+                st, ss1, sb1 = pool_claim(st, sp[None],
+                                          jnp.ones(1, bool), (sp < 0)[None])
+                s_small, s_big = ss1[0], sb1[0]
+                slots2 = jnp.stack([jnp.where(small_left, s_small, s_big),
+                                    jnp.where(small_left, s_big, s_small)])
+                st = pool_assign(st, jnp.stack([leaf, new_leaf]), slots2)
 
             tree = _update_tree(st, leaf, new_leaf, node, pg, ph, pc)
             st = st._replace(
@@ -1725,7 +1960,7 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
                                     hist_right, gl, hl, cl, gr, hr, cr,
                                     meta, feature_mask, cegb, groups_mat,
                                     scale3, sync=sync, fp_mono=fp_mono,
-                                    rs=rs)
+                                    rs=rs, slots2=slots2)
             if n_forced:
                 st = _record_forced_children(st, use_f, si, leaf, new_leaf)
             if inter:
@@ -1766,11 +2001,14 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
         nan_bins = meta[1]
         groups_mat = _groups_matrix(f) if use_groups else None
         rs = None
+        hist_cols = f if cfg.packed4 else gcols
         if axis is not None and rs_on:
-            rs = _make_rs(axis, f if cfg.packed4 else gcols, meta)
+            rs = _make_rs(axis, hist_cols, meta)
+        P, pool_on, pool_claim, pool_assign, _reduce_hist = _pool_setup(
+            rs["go"] if rs is not None else hist_cols, axis, rs)
         (state, bins_pad, vals_pad, buckets, buckets_arr,
          max_bucket) = _perm_setup(bins, vals, scale3, meta, feature_mask,
-                                   cegb, key, groups_mat, axis, rs)
+                                   cegb, key, groups_mat, axis, rs, P)
 
         part_branches = [_part_branch_for(bins_pad, nan_bins, S, meta)
                          for S in buckets]
@@ -1821,6 +2059,33 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
             dlefts = st.best_default_left[top_l]
             scats = st.best_is_cat[top_l]
             cmasks = st.best_cat_mask[top_l]
+            raw_dtype = jnp.int32 if cfg.quantized else jnp.float32
+
+            if pool_on:
+                # W parent histograms BEFORE the partition reorders their
+                # segments: resident slots, or recompute-on-miss from the
+                # leaf's rows in creation-time order (reference
+                # HistogramPool::Get miss -> reconstruct), re-reduced
+                # across shards exactly like the smaller-sibling path.
+                spW = st.leaf_slot[top_l]                       # (W,)
+                missW = active & (spW < 0)
+
+                def parent_one(j, ph):
+                    def rec(_):
+                        h = jax.lax.switch(
+                            _bucket_of(cnts[j]), hist_branches, st.perm,
+                            starts[j], cnts[j])
+                        return _reduce_hist(h)
+
+                    h = jax.lax.cond(
+                        missW[j], rec,
+                        lambda _: st.leaf_hist[jnp.clip(spW[j], 0, P - 1)],
+                        None)
+                    return ph.at[j].set(h)
+
+                parent_hist = jax.lax.fori_loop(
+                    0, W, parent_one,
+                    jnp.zeros((W,) + st.leaf_hist.shape[1:], raw_dtype))
 
             def part_one(j, carry):
                 perm, nls = carry
@@ -1851,8 +2116,6 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
             small_start = jnp.where(small_left, starts, starts + nl_phys)
             small_cnt = jnp.where(small_left, nl_phys, cnts - nl_phys)
 
-            raw_dtype = jnp.int32 if cfg.quantized else jnp.float32
-
             def hist_one(j, hs):
                 h = jax.lax.switch(
                     _bucket_of(small_cnt[j]), hist_branches, perm,
@@ -1874,7 +2137,8 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
                 hist_small = (rs["scatter"](hist_small) if rs is not None
                               else jax.lax.psum(hist_small, axis))
 
-            parent_hist = st.leaf_hist[top_l]
+            if not pool_on:
+                parent_hist = st.leaf_hist[top_l]
             hist_big = parent_hist - hist_small
             sl = small_left[:, None, None, None]
             hist_left = jnp.where(sl, hist_small, hist_big)
@@ -1983,6 +2247,15 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
             idx2 = jnp.concatenate([leaf_j, newleaf_j])
             cat2 = lambda a, b: jnp.concatenate([a, b])
             depth = st.leaf_depth[top_l] + 1
+            hist_idx2 = idx2
+            if pool_on:
+                # Claim W smaller-sibling slots (+ replacements for missed
+                # parents); larger siblings take over their parents' slots.
+                st, ssW, sbW = pool_claim(st, spW, active, missW)
+                slot_l = jnp.where(small_left, ssW, sbW)
+                slot_r = jnp.where(small_left, sbW, ssW)
+                hist_idx2 = cat2(slot_l, slot_r)
+                st = pool_assign(st, idx2, hist_idx2)
             st = st._replace(
                 perm=perm,
                 tree=tree,
@@ -1992,7 +2265,7 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
                 leaf_rows=st.leaf_rows.at[leaf_j].set(nl_phys, mode="drop")
                                      .at[newleaf_j].set(cnts - nl_phys,
                                                         mode="drop"),
-                leaf_hist=st.leaf_hist.at[idx2].set(
+                leaf_hist=st.leaf_hist.at[hist_idx2].set(
                     cat2(hist_left, hist_right), mode="drop"),
                 leaf_sum_grad=st.leaf_sum_grad.at[idx2].set(
                     cat2(gl, gr), mode="drop"),
@@ -2433,6 +2706,8 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
     # static dispatch facts, inspectable by tests/tools
     grow.fp_capable = fp_capable
     grow.rs_active = rs_on
+    grow.pool_capable = pool_capable
+    grow.pool_slots = _pool_slots
     # Scan-able handle: the iteration-packed path traces grow INSIDE a
     # lax.scan body that is already under jit; the raw function skips the
     # redundant inner-jit trace (semantics identical — nested jit inlines).

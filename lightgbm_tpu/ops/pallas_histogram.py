@@ -44,6 +44,19 @@ C_PAD = 4  # channels (grad, hess, count) padded; BlockSpec dim == array dim
            # valsT bytes vs a full 8-sublane tile.
 _VMEM_LIMIT = 64 * 1024 * 1024  # Mosaic scoped-vmem ceiling (v5e has 128MB)
 
+
+def _compiler_params_cls():
+    """pltpu compiler-params class across the jax rename
+    (TPUCompilerParams -> CompilerParams); fails with the attribute names
+    rather than an opaque NoneType call on a third rename."""
+    cls = getattr(pltpu, "CompilerParams",
+                  getattr(pltpu, "TPUCompilerParams", None))
+    if cls is None:
+        raise AttributeError(
+            "jax.experimental.pallas.tpu exposes neither CompilerParams "
+            "nor TPUCompilerParams; unsupported jax version")
+    return cls
+
 _DTYPES = {
     "f32": (jnp.float32, jnp.float32, 4),
     "bf16": (jnp.bfloat16, jnp.float32, 2),
@@ -206,7 +219,8 @@ def histogram_flat(
         out_specs=pl.BlockSpec((C_PAD, ftile * b_pad),
                                lambda i: (0, 0), memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((C_PAD, ftile * b_pad), acc_dtype),
-        compiler_params=pltpu.CompilerParams(
+        # jax renamed TPUCompilerParams -> CompilerParams across 0.4.x/0.5
+        compiler_params=_compiler_params_cls()(
             dimension_semantics=("arbitrary",),
             vmem_limit_bytes=_VMEM_LIMIT),
         interpret=interpret,

@@ -64,6 +64,15 @@ class SplitConfig:
     use_cegb: bool = False
     cegb_tradeoff: float = 1.0
     cegb_penalty_split: float = 0.0
+    # Feature-block width for the scan's (F, B) cumsum/gain buffers: the
+    # candidate evaluation runs per G-block through a sequential lax.map so
+    # peak scan scratch stops scaling with full F (wide-feature shapes,
+    # F=700/F=2000).  0 = auto (128-wide blocks once the scan width exceeds
+    # 256 columns), 1 = untiled, >= 2 = explicit block width.  The winner is
+    # selected with the exact tie-break order of the untiled argmax (lowest
+    # flat index; sorted-categorical wins only strictly), so tiling never
+    # changes the chosen split.
+    scan_tile: int = 0
 
 
 class BestSplit(NamedTuple):
@@ -283,7 +292,18 @@ def _sorted_categorical(G, H, C, parent_grad, parent_hess, parent_count,
     return gain, cat_mask, gl, hl, cl
 
 
-def best_split(
+def _resolve_tile(scan_tile: int, f: int) -> int:
+    """Effective G-block width for a scan over ``f`` columns (0 = untiled).
+    Auto (0) engages 128-wide blocks only once the width exceeds 256 —
+    narrow shapes keep the single fused scan they always had."""
+    if scan_tile >= 2:
+        return 0 if scan_tile >= f else scan_tile
+    if scan_tile == 1:
+        return 0
+    return 128 if f > 256 else 0
+
+
+def _best_split_impl(
     hist: jnp.ndarray,            # (F, B, 3) leaf histogram
     parent_grad: jnp.ndarray,     # scalar ΣG over the leaf (includes NaN bin)
     parent_hess: jnp.ndarray,     # scalar ΣH
@@ -310,14 +330,17 @@ def best_split(
                                                # AdvancedLeafConstraints
                                                # cumulative slices)
     leaf_depth: jnp.ndarray | None = None,     # scalar (monotone_penalty)
+    feature_contri: jnp.ndarray | None = None,  # (F,) f32 gain multipliers,
+                                                # pre-resolved by best_split
     with_feature_gains: bool = False,          # also return (F,) best gain per
                                                # feature (voting-parallel)
-) -> BestSplit:
-    """Evaluate every (feature, threshold, missing-direction) candidate and argmax.
-
-    With ``with_feature_gains`` returns ``(best, per_feature_gain)`` — the
-    local vote input of the voting-parallel learner (reference
-    ``VotingParallelTreeLearner``, ``voting_parallel_tree_learner.cpp``)."""
+):
+    """One scan over an (F, B, 3) histogram block (the whole feature space
+    untiled, or one G-block of it).  Returns ``(best, from_sorted, fg)``
+    where ``from_sorted`` flags a sorted-categorical winner — the cross-tile
+    reducer needs it to reproduce the untiled "sorted wins only strictly"
+    rule — and ``fg`` is the per-feature gain vector (None unless
+    ``with_feature_gains``)."""
     f, b, _ = hist.shape
     G, H, C = hist[..., 0], hist[..., 1], hist[..., 2]
     biota = jnp.arange(b, dtype=jnp.int32)[None, :]
@@ -470,11 +493,8 @@ def best_split(
         # (reference stops on "gain <= 0").
         gain_fb = jnp.where(gain_fb > _EPS, gain_fb, -jnp.inf)
 
-    if cfg.feature_contri is not None:
-        fc = jnp.asarray(cfg.feature_contri, jnp.float32)[:f]
-        fc = jnp.concatenate([fc, jnp.ones(f - fc.shape[0], jnp.float32)]) \
-            if fc.shape[0] < f else fc
-        scaled = gain_fb * fc[:, None]
+    if feature_contri is not None:
+        scaled = gain_fb * feature_contri[:, None]
         # reference stops on best gain <= 0: a zeroed-out feature must not
         # win over "no split"
         gain_fb = jnp.where(jnp.isfinite(gain_fb) & (scaled > _EPS),
@@ -505,25 +525,151 @@ def best_split(
         sum_grad_right=GR, sum_hess_right=HR, count_right=CR,
     )
 
+    from_sorted = jnp.asarray(False)
     if cfg.has_categorical and cfg.use_sorted_categorical:
-        best = _merge_sorted_categorical(
+        best, from_sorted = _merge_sorted_categorical(
             best, G, H, C, parent_grad, parent_hess, parent_count,
             parent_output, parent_gain, in_feature, sorted_eligible,
             feature_mask, penalty_col, cfg, min_count,
-            rand_bins if cfg.extra_trees else None)
+            rand_bins if cfg.extra_trees else None, feature_contri)
+    fg = None
     if with_feature_gains:
         fg = jnp.max(gain_fb, axis=1)
         # NOTE: sorted-categorical gains are not folded into the vote — the
         # vote only ranks features, and one-hot gains rank the same columns.
-        return best, fg
+    return best, from_sorted, fg
+
+
+def best_split(
+    hist: jnp.ndarray,            # (F, B, 3) leaf histogram
+    parent_grad: jnp.ndarray,
+    parent_hess: jnp.ndarray,
+    parent_count: jnp.ndarray,
+    *,
+    num_bins_per_feature: jnp.ndarray,
+    nan_bins: jnp.ndarray,
+    is_categorical: jnp.ndarray,
+    monotone: jnp.ndarray | None,
+    feature_mask: jnp.ndarray,
+    cfg: SplitConfig,
+    gain_penalty: jnp.ndarray | None = None,
+    parent_output: jnp.ndarray | None = None,
+    rand_bins: jnp.ndarray | None = None,
+    out_lo: jnp.ndarray | None = None,
+    out_hi: jnp.ndarray | None = None,
+    adv_bounds: tuple | None = None,
+    leaf_depth: jnp.ndarray | None = None,
+    with_feature_gains: bool = False,
+) -> BestSplit:
+    """Evaluate every (feature, threshold, missing-direction) candidate and
+    argmax (argument semantics documented on :func:`_best_split_impl`).
+
+    With ``with_feature_gains`` returns ``(best, per_feature_gain)`` — the
+    local vote input of the voting-parallel learner (reference
+    ``VotingParallelTreeLearner``, ``voting_parallel_tree_learner.cpp``).
+
+    Wide feature spaces (``cfg.scan_tile``) evaluate in G-blocks through a
+    sequential ``lax.map`` so the (F, B) cumsum/gain scratch peaks at one
+    block instead of full F; the cross-block reduction replays the untiled
+    tie-break order exactly (lowest flat index within a block, lowest block
+    across blocks, sorted-categorical winners only on strictly greater
+    gain), so the chosen split is identical to the untiled scan."""
+    f, b, _ = hist.shape
+    fc = None
+    if cfg.feature_contri is not None:
+        fc = jnp.asarray(cfg.feature_contri, jnp.float32)[:f]
+        if fc.shape[0] < f:
+            fc = jnp.concatenate(
+                [fc, jnp.ones(f - fc.shape[0], jnp.float32)])
+    t = _resolve_tile(cfg.scan_tile, f)
+    if t == 0:
+        best, _src, fg = _best_split_impl(
+            hist, parent_grad, parent_hess, parent_count,
+            num_bins_per_feature=num_bins_per_feature, nan_bins=nan_bins,
+            is_categorical=is_categorical, monotone=monotone,
+            feature_mask=feature_mask, cfg=cfg, gain_penalty=gain_penalty,
+            parent_output=parent_output, rand_bins=rand_bins,
+            out_lo=out_lo, out_hi=out_hi, adv_bounds=adv_bounds,
+            leaf_depth=leaf_depth, feature_contri=fc,
+            with_feature_gains=with_feature_gains)
+        return (best, fg) if with_feature_gains else best
+
+    nt = -(-f // t)
+    pad = nt * t - f
+
+    def blk(a, fill):
+        """(F, ...) per-feature array -> (nt, t, ...) padded G-blocks.
+        Pad columns are inert: nbpf=0 masks them out of every candidate."""
+        if a is None:
+            return None
+        if pad:
+            a = jnp.concatenate(
+                [a, jnp.full((pad,) + a.shape[1:], fill, a.dtype)])
+        return a.reshape((nt, t) + a.shape[1:])
+
+    ops = {"hist": blk(hist, 0), "nbpf": blk(num_bins_per_feature, 0),
+           "nanb": blk(nan_bins, b), "iscat": blk(is_categorical, False),
+           "fmask": blk(feature_mask, False)}
+    if monotone is not None:
+        ops["mono"] = blk(monotone, 0)
+    if gain_penalty is not None:
+        ops["pen"] = blk(gain_penalty, 0.0)
+    if rand_bins is not None:
+        ops["rand"] = blk(rand_bins, 0)
+    if fc is not None:
+        ops["fc"] = blk(fc, 1.0)
+    if adv_bounds is not None:
+        for i, a in enumerate(adv_bounds):
+            ops[f"adv{i}"] = blk(a, 0.0)
+
+    def tile_fn(x):
+        adv = (tuple(x[f"adv{i}"] for i in range(4))
+               if adv_bounds is not None else None)
+        best, src, fg = _best_split_impl(
+            x["hist"], parent_grad, parent_hess, parent_count,
+            num_bins_per_feature=x["nbpf"], nan_bins=x["nanb"],
+            is_categorical=x["iscat"],
+            monotone=x.get("mono"),
+            feature_mask=x["fmask"], cfg=cfg,
+            gain_penalty=x.get("pen"),
+            parent_output=parent_output,
+            rand_bins=x.get("rand"),
+            out_lo=out_lo, out_hi=out_hi, adv_bounds=adv,
+            leaf_depth=leaf_depth,
+            feature_contri=x.get("fc"),
+            with_feature_gains=with_feature_gains)
+        if with_feature_gains:
+            return best, src, fg
+        return best, src
+
+    mapped = jax.lax.map(tile_fn, ops)
+    bests, srcs = mapped[0], mapped[1]
+    # Cross-block winner with the untiled argmax's exact tie-break: max
+    # gain; on ties a numeric/one-hot winner beats a sorted-categorical one
+    # (the untiled merge takes sorted only on STRICTLY greater gain); then
+    # the lowest block (= lowest feature id, blocks are contiguous).
+    gains = bests.gain
+    iota = jnp.arange(nt)
+    is_max = gains == jnp.max(gains)
+    numeric_max = is_max & ~srcs
+    first_numeric = jnp.argmin(jnp.where(numeric_max, iota, nt))
+    first_any = jnp.argmin(jnp.where(is_max, iota, nt))
+    ti = jnp.where(jnp.any(numeric_max), first_numeric,
+                   first_any).astype(jnp.int32)
+    best = jax.tree.map(lambda a: a[ti], bests)
+    best = best._replace(feature=best.feature + ti * t)
+    if with_feature_gains:
+        return best, mapped[2].reshape(nt * t)[:f]
     return best
 
 
 def _merge_sorted_categorical(best, G, H, C, parent_grad, parent_hess,
                               parent_count, parent_output, parent_gain,
                               in_feature, sorted_eligible, feature_mask,
-                              penalty_col, cfg, min_count, rand_bins):
-    """Run the sorted many-vs-many scan and take it when it beats ``best``."""
+                              penalty_col, cfg, min_count, rand_bins,
+                              feature_contri=None):
+    """Run the sorted many-vs-many scan and take it when it beats ``best``.
+    Returns ``(best, from_sorted)``."""
     s_gain, s_mask, s_gl, s_hl, s_cl = _sorted_categorical(
         G, H, C, parent_grad, parent_hess, parent_count, parent_output,
         in_feature, cfg, min_count, rand_bins)
@@ -536,12 +682,8 @@ def _merge_sorted_categorical(best, G, H, C, parent_grad, parent_hess,
     if penalty_col is not None:
         s_gain = s_gain - penalty_col[:, 0]
         s_gain = jnp.where(s_gain > _EPS, s_gain, -jnp.inf)
-    if cfg.feature_contri is not None:
-        f = s_gain.shape[0]
-        fc = jnp.asarray(cfg.feature_contri, jnp.float32)[:f]
-        fc = jnp.concatenate([fc, jnp.ones(f - fc.shape[0], jnp.float32)]) \
-            if fc.shape[0] < f else fc
-        s_scaled = s_gain * fc
+    if feature_contri is not None:
+        s_scaled = s_gain * feature_contri
         s_gain = jnp.where(jnp.isfinite(s_gain) & (s_scaled > _EPS),
                            s_scaled, -jnp.inf)
     s_gain = jnp.where(sorted_eligible & feature_mask, s_gain, -jnp.inf)
@@ -562,4 +704,4 @@ def _merge_sorted_categorical(best, G, H, C, parent_grad, parent_hess,
         sum_grad_right=pickf(parent_grad - s_gl[sf], best.sum_grad_right),
         sum_hess_right=pickf(parent_hess - s_hl[sf], best.sum_hess_right),
         count_right=pickf(parent_count - s_cl[sf], best.count_right),
-    )
+    ), better

@@ -18,3 +18,25 @@ import pytest  # noqa: E402
 @pytest.fixture
 def rng():
     return np.random.RandomState(42)
+
+
+def pytest_terminal_summary(terminalreporter, exitstatus, config):
+    """One end-of-run line making the differential-coverage gap visible
+    (VERDICT weak #3): without ``LGBM_REFERENCE_BIN`` every
+    test_differential.py case skips silently, so a run can look green
+    while the genuine-binary parity suite never executed."""
+    if os.environ.get("LGBM_REFERENCE_BIN"):
+        return
+    stats = terminalreporter.stats
+
+    def _count(key):
+        return sum(1 for rep in stats.get(key, ())
+                   if "test_differential.py" in getattr(rep, "nodeid", ""))
+
+    skipped = _count("skipped")
+    ran = _count("passed") + _count("failed") + _count("error")
+    if skipped or ran:
+        terminalreporter.write_line(
+            f"differential vs genuine LightGBM: {ran} ran, {skipped} "
+            "skipped — set LGBM_REFERENCE_BIN (build via "
+            "tools/refbuild/build_reference.sh) to run them")

@@ -1,0 +1,32 @@
+"""bench.py shape-matrix rungs (ISSUE-4 satellite / VERDICT weak #2): the
+lambdarank (MS-LTR-like) and wide (Epsilon-like) rungs must emit their
+detail blobs on ANY platform — the hermetic CPU fallback included — and the
+wide rung must actually engage the bounded histogram pool it exists to
+exercise.  Scaled-down geometries here; bench.py's env knobs carry the
+full MS-LTR/Epsilon sizes."""
+
+import jax
+
+from bench import run_ltr_rung, run_wide_rung
+
+
+def test_ltr_rung_blob():
+    blob = run_ltr_rung(4200, 2, "cpu", jax, features=24, group=60,
+                        num_leaves=15)
+    assert blob["rows"] == 4200 and blob["features"] == 24
+    assert blob["queries"] == 70
+    assert blob["row_iters_per_sec"] > 0
+    assert 0.0 <= blob["ndcg5_train_sample"] <= 1.0
+
+
+def test_wide_rung_blob_pool_engaged():
+    # features > 256 also auto-engages the tiled split scan; rows must
+    # exceed _MIN_BUCKET so the pooled perm layout (not the mask
+    # fallback) runs.
+    blob = run_wide_rung(2600, 2, "cpu", jax, features=320, num_leaves=31,
+                         max_bin=31, pool_mb=1.0)
+    assert blob["rows"] == 2600 and blob["features"] == 320
+    assert blob["row_iters_per_sec"] > 0
+    assert blob["pool_engaged"] is True
+    assert blob["pool_slots"] < 31
+    assert blob["leaf_hist_mb_pooled"] < blob["leaf_hist_mb_unpooled"]

@@ -1,0 +1,51 @@
+"""examples/ quickstart corpus smoke test (ISSUE-4 satellite / VERDICT
+missing #5): every example's train.conf + predict.conf must run end to end
+through the CLI — the reference exercises its examples the same way
+(test_consistency.py) so the corpus doubles as living documentation."""
+
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.cli import run
+
+EXAMPLES = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "examples")
+
+
+@pytest.mark.parametrize("name,model,result,n_pred", [
+    ("binary_classification", "LightGBM_model.txt",
+     "LightGBM_predict_result.txt", 100),
+    ("lambdarank", "LightGBM_rank_model.txt",
+     "LightGBM_rank_predict_result.txt", 160),
+])
+def test_example_trains_and_predicts_via_cli(tmp_path, monkeypatch, name,
+                                             model, result, n_pred):
+    src = os.path.join(EXAMPLES, name)
+    work = tmp_path / name
+    shutil.copytree(src, work)
+    monkeypatch.chdir(work)
+    assert run(["config=train.conf"]) == 0
+    assert (work / model).exists()
+    assert run(["config=predict.conf"]) == 0
+    pred = np.loadtxt(work / result)
+    assert pred.shape == (n_pred,)
+    assert np.all(np.isfinite(pred))
+    if name == "binary_classification":
+        # predictions are probabilities and carry real signal on the
+        # committed holdout (labels in column 0 of binary.test)
+        data = np.loadtxt(work / "binary.test")
+        y = data[:, 0]
+        assert np.all((pred >= 0) & (pred <= 1))
+        acc = np.mean((pred > 0.5) == (y > 0.5))
+        assert acc > 0.75, acc
+
+
+def test_examples_readme_lists_every_example():
+    with open(os.path.join(EXAMPLES, "README.md")) as fh:
+        txt = fh.read()
+    for d in sorted(os.listdir(EXAMPLES)):
+        if os.path.isdir(os.path.join(EXAMPLES, d)):
+            assert d in txt, f"examples/README.md misses {d}/"

@@ -140,6 +140,51 @@ def test_growth_carry_bytes_bounded(hlo):
     assert total <= hist_bytes * 1.10 + (4 << 20), (total, hist_bytes)
 
 
+def test_growth_carry_bytes_bounded_wide_pool():
+    """ISSUE-4 hermetic pin at the wide-feature shape (255 leaves, F=700,
+    B=256 — the Yahoo-LTR histogram geometry that motivates the bounded
+    pool): with ``histogram_pool_size`` set, the growth loop's carried
+    histogram bytes must be <= 1/4 of the unpooled (L, F, B, 3) carry
+    (~523 MB f32), and no full-L histogram buffer may be smuggled back
+    into the program anywhere (a defensive copy or a staging buffer would
+    resurrect exactly the memory wall the pool removes).  The compile also
+    exercises the feature-tiled split scan (auto-engaged at F=700)."""
+    NW, FW, LW, WW = 4096, 700, 255, 4
+    POOL_MB = 128.0
+    gcfg = G.GrowerConfig(
+        num_leaves=LW, num_bins=B,
+        split=G.SplitConfig(has_nan=False, has_categorical=False,
+                            use_sorted_categorical=False,
+                            has_monotone=False),
+        leaf_batch=WW, histogram_pool_size=POOL_MB)
+    grow = G.make_grower(gcfg)
+    P = grow.pool_slots(FW)
+    unpooled_bytes = LW * FW * B * 3 * 4
+    assert grow.pool_capable
+    assert P * FW * B * 3 * 4 <= unpooled_bytes // 4, (P, LW)
+    rng = np.random.RandomState(0)
+    bins = jnp.asarray(rng.randint(0, B, (NW, FW)).astype(np.uint8))
+    args = [bins, jnp.zeros(NW, jnp.float32), jnp.ones(NW, jnp.float32),
+            jnp.ones(NW, jnp.float32), jnp.ones(FW, bool),
+            jnp.full(FW, B, jnp.int32), jnp.full(FW, B, jnp.int32),
+            jnp.zeros(FW, bool), jnp.zeros(FW, jnp.int32)]
+    txt = grow.lower(*args).compile().as_text()
+    pool_hist = f"f32[{P},{FW},{B},3]"
+    carries = [w for w in _whiles(txt) if pool_hist in w]
+    assert carries, "pool histogram buffer missing from the growth carry"
+    # The growth loop is the largest carry holding the pool buffer (inner
+    # fori-loops may carry it as a loop-invariant operand).
+    grow_carry_hist = max(
+        sum(_shape_bytes(d, s) for d, s in _parse_shapes(w)
+            if int(np.prod([int(x) for x in s.split(",") if x])
+                   if s else 1) >= P * FW * B)
+        for w in carries)
+    assert grow_carry_hist <= unpooled_bytes // 4, (
+        grow_carry_hist, unpooled_bytes)
+    # no second histogram-scale buffer: nothing full-L-sized anywhere
+    assert f"[{LW},{FW},{B},3]" not in txt
+
+
 def test_while_op_count_bounded(hlo):
     """The program stays a handful of loops (grow loop + inner fori-loops
     + histogram block scans), not an unrolled per-leaf ladder."""

@@ -483,10 +483,21 @@ def test_hist_comm_fallbacks_warn():
     for bad in (dict(voting=True),
                 dict(forced_splits=((0, 1, -1, -1),)),
                 dict(mono_intermediate=True,
-                     split=dataclasses.replace(sp, has_monotone=True))):
+                     split=dataclasses.replace(sp, has_monotone=True)),
+                # static full-F multipliers cannot follow a feature slice
+                dict(split=dataclasses.replace(
+                    sp, feature_contri=(0.5,) * 8))):
         g = G.make_grower(G.GrowerConfig(**dict(base, **bad)), mesh=mesh,
                           data_axis=DATA_AXIS)
         assert not g.rs_active, bad
+    # ... but the EFB slice scans full-F under an ownership mask, so
+    # feature_contri composes there (predicate only: building a bundled
+    # grower needs bundle metadata)
+    assert G.rs_active_for(
+        G.GrowerConfig(**dict(base, bundled=True,
+                              split=dataclasses.replace(
+                                  sp, feature_contri=(0.5,) * 8))),
+        mesh, DATA_AXIS)
     # feature-only meshes never reduce-scatter (rows are replicated there)
     assert not G.make_grower(G.GrowerConfig(**base), mesh=make_mesh(1, 8),
                              data_axis=DATA_AXIS).rs_active

@@ -1,0 +1,22 @@
+"""tools/profile_iter.py non-fused dispatch census (ISSUE-4 satellite):
+the GOSS / CEGB / linear_tree fallbacks (``gbdt.train_one_iter``
+``used_fused=False``) must report MORE compiled-program dispatches per
+boosting iteration than the fused hot path (1.0) — the measured fused-path
+coverage gap, visible in profiles instead of silent."""
+
+from tools.profile_iter import nonfused_dispatch_census
+
+
+def test_nonfused_census_shapes_and_gap():
+    blobs = {b["path"]: b for b in
+             nonfused_dispatch_census(rows=4096, iters=3, num_leaves=15)}
+    assert set(blobs) == {"fused", "goss", "cegb", "linear_tree"}
+    assert blobs["fused"]["used_fused"] is True
+    assert blobs["fused"]["dispatches_per_iter"] == 1.0
+    for path in ("goss", "cegb", "linear_tree"):
+        assert blobs[path]["used_fused"] is False
+        assert blobs[path]["dispatches_per_iter"] > 1.0, blobs[path]
+    # linear_tree does host leaf solves: its per-iteration host syncs are
+    # the worst of the family — the census must expose that, not hide it
+    assert (blobs["linear_tree"]["host_syncs_per_iter"]
+            > blobs["fused"]["host_syncs_per_iter"])

@@ -9,17 +9,74 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from lightgbm_tpu.config import _PARAMS  # noqa: E402
 
+# Descriptions for parameters whose behavior is TPU-build-specific or
+# otherwise non-obvious from the name; everything else inherits the
+# reference's semantics (docs/Parameters.rst).
+_DESCRIPTIONS = {
+    "histogram_pool_size": (
+        "max MB of device memory for the per-tree leaf-histogram pool "
+        "(reference HistogramPool semantics): the growth loop carries only "
+        "`floor(MB / slot_bytes)` histograms (LRU slots + "
+        "recompute-on-miss) instead of one per leaf — the knob that makes "
+        "wide-feature shapes (F=700/F=2000) fit HBM; -1 = unbounded (full "
+        "residency); auto-clamped so one growth wave always fits; under "
+        "`tpu_hist_comm=reduce_scatter` a slot holds only the shard's "
+        "owned feature slice, so the savings multiply; voting, "
+        "intermediate/advanced monotone and the GSPMD mask layout keep "
+        "full residency (a warning names the fallback)"),
+    "tree_learner": (
+        "serial, or data/feature/voting — which device-mesh sharding the "
+        "tree learner uses (parallel/mesh.py)"),
+    "device_type": "tpu (any jax backend; cpu runs the identical programs)",
+    "tpu_histogram_impl": (
+        "histogram kernel: auto|pallas|flat_bf16|onehot|segment (auto = "
+        "pallas on TPU with runtime degrade to onehot on a Mosaic compile "
+        "failure)"),
+    "tpu_rows_block": "rows per histogram-kernel block",
+    "tpu_4bit_bins": (
+        "auto 4-bit bin packing when every feature fits 16 bins "
+        "(reference DenseBin IS_4BIT): resident bin matrix and per-leaf "
+        "gathers halve"),
+    "tpu_leaf_batch": (
+        "leaves split per growth step (wave growth); 1 = strict "
+        "best-first, >1 divides sequential steps per tree"),
+    "tpu_hist_comm": (
+        "cross-shard histogram reduction on data meshes: auto|allreduce|"
+        "reduce_scatter (auto = feature-sliced psum_scatter + slice-local "
+        "scan + SplitInfo payload broadcast, ~2x less comm per wave)"),
+    "tpu_split_tile": (
+        "feature-block width for the split scan's (F, B) cumsum/gain "
+        "buffers: 0 = auto (128-wide blocks once the scan width exceeds "
+        "256 columns), 1 = untiled, >= 2 explicit; winner selection "
+        "replays the untiled tie-break order exactly, so tiling never "
+        "changes the chosen split"),
+    "tpu_iter_pack": (
+        "boosting rounds fused into one scanned XLA dispatch "
+        "(docs/ITER_PACK.md); 0 = auto-pack when results cannot change"),
+    "tpu_native_predict_max_rows": (
+        "predict batches up to this many rows take the native C++ host "
+        "traversal; larger batches go through the compiled serve plan "
+        "(docs/SERVING.md); 0 routes everything to the device"),
+}
+
 
 def main():
+    stale = set(_DESCRIPTIONS) - {name for name, *_ in _PARAMS}
+    if stale:
+        raise SystemExit(
+            f"gen_params_doc: _DESCRIPTIONS keys not in config._PARAMS "
+            f"(renamed or removed parameter?): {sorted(stale)}")
     out = ["# Parameters",
            "",
            "Generated from `lightgbm_tpu/config.py` by "
            "`tools/gen_params_doc.py` — the single source of truth for the "
            "parameter surface (reference: `docs/Parameters.rst` generated "
-           "from `config.h`).",
+           "from `config.h`).  Parameters without a description follow the "
+           "reference's semantics unchanged.",
            "",
-           "| parameter | type | default | aliases | constraints |",
-           "|---|---|---|---|---|"]
+           "| parameter | type | default | aliases | constraints |"
+           " description |",
+           "|---|---|---|---|---|---|"]
     for name, typ, default, aliases, bounds in _PARAMS:
         tname = typ if isinstance(typ, str) else typ.__name__
         alias_s = ", ".join(aliases) if aliases else ""
@@ -29,7 +86,9 @@ def main():
             lo, hi = bounds
             bound_s = f"{'' if lo is None else lo} .. {'' if hi is None else hi}"
         d = "" if default is None else repr(default)
-        out.append(f"| `{name}` | {tname} | {d} | {alias_s} | {bound_s} |")
+        desc = _DESCRIPTIONS.get(name, "")
+        out.append(f"| `{name}` | {tname} | {d} | {alias_s} | {bound_s} |"
+                   f" {desc} |")
     out.append("")
     path = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "docs", "PARAMETERS.md")

@@ -3,7 +3,11 @@ print the top time sinks (VERDICT r4 ask #1: if vs_baseline < 1.0, name
 the top-3 sinks in PERF.md), plus a host-sync census: device_get calls per
 boosting iteration on the per-round path vs the iteration-packed path
 (docs/ITER_PACK.md), so the pack path's dispatch-elimination claim is
-measurable outside bench.py.
+measurable outside bench.py — and a NON-FUSED-path census
+(:func:`nonfused_dispatch_census`): the GOSS / CEGB / linear_tree configs
+route through ``gbdt.train_one_iter``'s ``used_fused=False`` branch, whose
+per-iteration dispatch and host-sync counts were previously invisible in
+profiles (the fused-path coverage gap, ISSUE-4 satellite).
 
     python tools/profile_iter.py [rows] [iters]
 
@@ -16,6 +20,99 @@ import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Compiled training-program entry points on the GBDT instance (all
+# dynamically attribute-resolved at call time, so wrapping the attribute
+# intercepts every launch): the fused iteration, the grow+apply program,
+# the bare grower, and the objective-gradient program.
+_DISPATCH_ATTRS = ("_fused_iter", "_grow_apply", "grow", "_grad_fn")
+
+
+def _count_dispatches_and_syncs(bst, iters):
+    """Run ``iters`` post-warmup boosting rounds counting (a) launches of
+    the GBDT's compiled training programs and (b) jax.device_get host
+    syncs.  The dispatch census counts the big jitted programs (grower /
+    gradients / score update / GOSS mask), not ad-hoc eager ops — the
+    quantity comparable to bench.py's ``dispatches_per_iter`` (1.0 on the
+    fused path)."""
+    import jax
+
+    import lightgbm_tpu.models.gbdt as gbdt_mod
+    import lightgbm_tpu.sampling as sampling_mod
+
+    bst.update()                                    # compile outside census
+    gbdt = bst._gbdt
+    counts = {"dispatch": 0, "sync": 0}
+    wrapped = []
+
+    def wrap(obj, name):
+        fn = getattr(obj, name, None)
+        if fn is None or not callable(fn):
+            return
+
+        def counting(*a, __fn=fn, **k):
+            counts["dispatch"] += 1
+            return __fn(*a, **k)
+
+        setattr(obj, name, counting)
+        wrapped.append((obj, name, fn))
+
+    for name in _DISPATCH_ATTRS:
+        wrap(gbdt, name)
+    for name in ("_add_leaf_outputs", "_scale_tree_arrays"):
+        wrap(gbdt_mod, name)
+    wrap(sampling_mod, "goss_mask_device")
+    orig_get = jax.device_get
+
+    def counting_get(x):
+        counts["sync"] += 1
+        return orig_get(x)
+
+    jax.device_get = counting_get
+    try:
+        for _ in range(iters):
+            bst.update()
+    finally:
+        jax.device_get = orig_get
+        for obj, name, fn in wrapped:
+            setattr(obj, name, fn)
+    return counts["dispatch"], counts["sync"]
+
+
+def nonfused_dispatch_census(rows=8192, iters=4, num_leaves=31):
+    """Per-iteration dispatch/host-sync counts for the bench config's fused
+    hot path AND the three non-fused fallbacks (GOSS, CEGB, linear_tree —
+    ``gbdt.train_one_iter`` ``used_fused=False``).  Returns one blob per
+    path so the fused-path coverage gap is a measured number in profiles
+    instead of a silent branch."""
+    import numpy as np
+
+    import lightgbm_tpu as lgb
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(rows, 8)
+    y = (X[:, 0] + X[:, 1] * X[:, 2] > 0).astype(np.float64)
+    base = {"objective": "binary", "num_leaves": num_leaves,
+            "metric": "none", "verbosity": -1}
+    paths = [
+        ("fused", {}),
+        ("goss", {"data_sample_strategy": "goss"}),
+        ("cegb", {"cegb_penalty_split": 0.1}),
+        ("linear_tree", {"linear_tree": True}),
+    ]
+    out = []
+    for name, extra in paths:
+        ds = lgb.Dataset(X, label=y)
+        bst = lgb.Booster(params=dict(base, **extra), train_set=ds)
+        g = bst._gbdt
+        dispatches, syncs = _count_dispatches_and_syncs(bst, iters)
+        out.append({
+            "path": name,
+            "used_fused": g.fused_path_active,
+            "dispatches_per_iter": round(dispatches / iters, 2),
+            "host_syncs_per_iter": round(syncs / iters, 2),
+        })
+    return out
 
 
 def _count_host_syncs(run, warmup):
@@ -98,6 +195,13 @@ def main():
           f"({syncs_legacy} device_get in {n} iters), "
           f"packed={syncs_packed / n:.2f} "
           f"({syncs_packed} device_get in one {n}-round pack)")
+
+    # ---- non-fused fallback paths (GOSS / CEGB / linear_tree) -----------
+    print("non-fused dispatch census (used_fused=False paths):")
+    for blob in nonfused_dispatch_census(rows=min(rows, 65536)):
+        print(f"  {blob['path']:<12} used_fused={blob['used_fused']!s:<5} "
+              f"dispatches/iter={blob['dispatches_per_iter']:<6} "
+              f"host_syncs/iter={blob['host_syncs_per_iter']}")
 
 
 if __name__ == "__main__":
