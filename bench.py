@@ -70,6 +70,11 @@ WIDE_ROWS = int(os.environ.get("BENCH_WIDE_ROWS", 400_000))   # Epsilon scale
 WIDE_FEATURES = int(os.environ.get("BENCH_WIDE_FEATURES", 2000))
 WIDE_ITERS = int(os.environ.get("BENCH_WIDE_ITERS", 10))
 WIDE_POOL_MB = float(os.environ.get("BENCH_WIDE_POOL_MB", 256.0))
+# GOSS rung (ISSUE-5): Higgs shape under data_sample_strategy=goss — the
+# device-resident sampler keeps the boosting round ONE compiled dispatch
+# (tpu_device_goss auto), witnessed as dispatches_per_iter in the blob.
+GOSS_CHECK = os.environ.get("BENCH_GOSS", "1") == "1"
+GOSS_ITERS = int(os.environ.get("BENCH_GOSS_ITERS", 15))
 
 
 def _pack_eff(iters, pack):
@@ -257,6 +262,43 @@ def run_wide_rung(rows, iters, platform, jax, features=None,
     }
 
 
+def run_goss_rung(rows, iters, platform, jax, features=None,
+                  num_leaves=None):
+    """GOSS rung at the Higgs shape (``data_sample_strategy=goss``): the
+    device-resident sampler (ISSUE-5, ``tpu_device_goss`` auto) derives
+    the top-set + amplified rest-sample mask IN-TRACE from the fused
+    iteration's own gradients, so a GOSS boosting round stays ONE compiled
+    dispatch — ``dispatches_per_iter`` in the blob is measured the census
+    way (tools/profile_iter.py) on top of the timed window."""
+    features = features or FEATURES
+    num_leaves = num_leaves or NUM_LEAVES
+    X, y = make_higgs_like(rows, features)
+    params = {"objective": "binary", "num_leaves": num_leaves,
+              "learning_rate": 0.1, "max_bin": 255, "min_data_in_leaf": 0,
+              "min_sum_hessian_in_leaf": 100.0, "metric": "none",
+              "verbosity": -1, "tpu_leaf_batch": LEAF_BATCH,
+              "data_sample_strategy": "goss"}
+    bst, elapsed = _rung_train(params, dict(X=X, label=y), iters, jax)
+    blob = {
+        "rows": rows, "features": features, "iters": iters,
+        "num_leaves": num_leaves, "platform": platform,
+        "data_sample_strategy": "goss",
+        "top_rate": bst._gbdt.cfg.top_rate,
+        "other_rate": bst._gbdt.cfg.other_rate,
+        "used_fused": bool(bst._gbdt.fused_path_active),
+        "train_time_s": round(elapsed, 3),
+        "row_iters_per_sec": round(rows * iters / elapsed, 1),
+    }
+    try:
+        from tools.profile_iter import _count_dispatches_and_syncs
+        d, s = _count_dispatches_and_syncs(bst, 2)
+        blob["dispatches_per_iter"] = round(d / 2, 2)
+        blob["host_syncs_per_iter"] = round(s / 2, 2)
+    except Exception as e:  # noqa: BLE001 — census is garnish on the rate
+        blob["dispatches_per_iter"] = f"failed: {e!r}"[:120]
+    return blob
+
+
 def _cache_path(name):
     """Retry attempts (the wedge ladder) re-run the whole measurement in
     fresh child processes; caching the synthetic data and the binned
@@ -421,7 +463,7 @@ def run_bench(rows, iters):
         }
 
     def emit(quant_rate, predict_stats=None, ltr_stats=None,
-             wide_stats=None):
+             wide_stats=None, goss_stats=None):
         print(json.dumps({
             "metric": "binary_255leaves_row_iters_per_sec",
             "value": round(row_iters_per_sec, 1),
@@ -454,6 +496,9 @@ def run_bench(rows, iters):
                 # wide-feature geometries measured alongside Higgs.
                 "lambdarank": ltr_stats,
                 "wide": wide_stats,
+                # GOSS rung (ISSUE-5): device-resident sampling at the
+                # Higgs shape — one compiled dispatch per boosting round.
+                "goss": goss_stats,
                 "reference": "LightGBM CPU 16t Higgs 10.5Mx28 500it in "
                              "130.094s (docs/Experiments.rst:113)",
             },
@@ -477,7 +522,7 @@ def run_bench(rows, iters):
     # later rung can never forfeit an earlier one (the outer runner
     # salvages the LAST metric line).  Row/iter budgets derive from the
     # primary budget, so the CPU fallback shrinks them automatically.
-    ltr_stats = wide_stats = None
+    ltr_stats = wide_stats = goss_stats = None
     if LTR_CHECK:
         try:
             ltr_stats = run_ltr_rung(
@@ -494,6 +539,14 @@ def run_bench(rows, iters):
         except Exception as e:  # noqa: BLE001
             wide_stats = {"error": f"{e!r}"[:200]}
         emit(None, predict_stats, ltr_stats, wide_stats)
+    if GOSS_CHECK:
+        try:
+            goss_stats = run_goss_rung(
+                max(rows // 4, 4096),
+                max(min(GOSS_ITERS, iters), 2), platform, jax)
+        except Exception as e:  # noqa: BLE001
+            goss_stats = {"error": f"{e!r}"[:200]}
+        emit(None, predict_stats, ltr_stats, wide_stats, goss_stats)
 
     quant_rate = None
     if QUANT_CHECK and not QUANTIZED:
@@ -506,7 +559,7 @@ def run_bench(rows, iters):
         except Exception as e:  # noqa: BLE001
             quant_rate = f"failed: {e!r}"[:200]
     if quant_rate is not None:
-        emit(quant_rate, predict_stats, ltr_stats, wide_stats)
+        emit(quant_rate, predict_stats, ltr_stats, wide_stats, goss_stats)
 
 
 def _scan_json(stdout):

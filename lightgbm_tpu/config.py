@@ -210,6 +210,18 @@ _PARAMS: List[Tuple[str, Any, Any, Tuple[str, ...], Optional[Tuple[Any, Any]]]] 
     # the pack path (bagging/feature-fraction masks move to key-folded
     # device sampling there).
     ("tpu_iter_pack", int, 0, (), (0, 4096)),
+    # Device-resident GOSS (data_sample_strategy=goss): compute the
+    # sampling mask in-trace from the just-computed device gradients —
+    # exact lax.top_k top set (same stable descending tie-break as the
+    # host argsort), key-folded jax.random rest-sample with the exact
+    # (1-top_rate)/other_rate amplification.  The top set matches the
+    # host sampler bit-for-bit under distinct scores; the random rest
+    # sample is a DIFFERENT (seed-keyed device) stream than the host
+    # np.random one — statistically equivalent, AUC-parity tested.
+    # auto = in-trace when the fused one-dispatch iteration applies,
+    # host sampler otherwise; on = device sampling even on non-fused
+    # paths (standalone mask dispatch); off = always the host sampler.
+    ("tpu_device_goss", str, "auto", (), None),  # auto|on|off
     # Predict batches up to this many rows take the native C++ host
     # traversal (no device round-trip); larger batches go through the
     # compiled serve plan (docs/SERVING.md).  0 routes everything to the

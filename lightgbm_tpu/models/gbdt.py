@@ -102,6 +102,21 @@ def _scale_tree_arrays(arrays: TreeArrays, factor) -> TreeArrays:
                            internal_value=arrays.internal_value * factor)
 
 
+def _mark_features_used_trace(used, split_feature, num_leaves):
+    """``used |= features split by this tree`` — the in-trace CEGB
+    first-use update (reference ``CostEfficientGradientBoosting::
+    UpdateUsedFeatures``): only the tree's ``num_leaves - 1`` live split
+    slots mark, stale tail entries scatter out of range and drop."""
+    m = split_feature.shape[0]
+    f = used.shape[0]
+    live = jnp.arange(m, dtype=jnp.int32) < (num_leaves - 1)
+    idx = jnp.where(live, split_feature, f)
+    return used.at[idx].set(True, mode="drop")
+
+
+_mark_features_used = jax.jit(_mark_features_used_trace)
+
+
 class GBDT:
     """Boosting driver (reference ``GBDT``, ``gbdt.h:630``)."""
 
@@ -263,6 +278,10 @@ class GBDT:
             raise ValueError(
                 f"tpu_hist_comm={cfg.tpu_hist_comm!r}: expected auto, "
                 "allreduce or reduce_scatter")
+        if cfg.tpu_device_goss not in ("auto", "on", "off"):
+            raise ValueError(
+                f"tpu_device_goss={cfg.tpu_device_goss!r}: expected auto, "
+                "on or off")
         self.grower_cfg = GrowerConfig(
             num_leaves=cfg.num_leaves,
             max_depth=cfg.max_depth,
@@ -383,10 +402,20 @@ class GBDT:
             self.bins_dev = shard_arrays(self.mesh, self.bins_dev)
         self.sample_strategy = SampleStrategy(
             cfg, train.num_data, train.label, train.query_boundaries())
+        # Device-resident GOSS (tpu_device_goss): "on"/"auto" compute the
+        # sampling mask from the just-computed DEVICE gradients — in-trace
+        # inside the fused iteration when it applies, via a standalone
+        # device dispatch under "on" otherwise; "off" (and "auto" on
+        # non-fused-capable configs) replays the reference's host sampler
+        # (np argsort + np.random), pulling gradients to the host.
+        self._device_goss = cfg.tpu_device_goss
 
         # CEGB (reference cost_effective_gradient_boosting.hpp): coupled
-        # penalties apply on a feature's FIRST use in the model, so the host
-        # tracks used features across iterations and re-masks the vector.
+        # penalties apply on a feature's FIRST use in the model.  The
+        # cross-iteration ``used`` feature vector is a device-resident (F,)
+        # bool carried in the training state and updated IN-TRACE from each
+        # tree's split_feature/num_leaves, so the fused iteration (and the
+        # iter-pack scan) never round-trips it through the host.
         self._use_cegb = self.grower_cfg.split.use_cegb
         if self._use_cegb:
             nf = train.num_features
@@ -396,9 +425,13 @@ class GBDT:
                     v[: len(lst)] = np.asarray(lst, np.float32)[:nf]
                 return v
             self._cegb_coupled_raw = _vec(cfg.cegb_penalty_feature_coupled)
+            self._cegb_coupled_dev = jnp.asarray(self._cegb_coupled_raw)
             self._cegb_lazy_dev = jnp.asarray(
                 _vec(cfg.cegb_penalty_feature_lazy))
-            self._cegb_used = np.zeros(nf, bool)
+            self._cegb_used_dev = jnp.zeros(nf, bool)
+        # Uncommitted per-round CEGB used-vector snapshots from the last
+        # train_pack (commit_round advances _cegb_used_dev through them).
+        self._pack_used_pending: List[jnp.ndarray] = []
 
         self._linear_nls: List[int] = []
         # Degenerate-tree stop check runs one iteration BEHIND: the pending
@@ -468,11 +501,40 @@ class GBDT:
         # Pack programs close over the (possibly rebuilt) grower; drop them
         # whenever the iteration programs are rebuilt (histogram degrade).
         self._pack_fns: Dict[int, object] = {}
+        # In-trace sampling/penalty state (docs/PERF.md round 8): GOSS
+        # derives its mask from the in-trace gradients (tpu_device_goss)
+        # and CEGB carries its first-use feature vector on device, so both
+        # paths keep the ONE-dispatch iteration and stay pack-capable.
+        strategy = self.sample_strategy
+        goss_in_trace = (strategy.is_goss
+                         and self._device_goss in ("auto", "on"))
+        use_cegb = self._use_cegb
+        track_used = use_cegb and bool(self._cegb_coupled_raw.any())
+        n_rows = self.train_data.num_data
+        if goss_in_trace:
+            goss_top_k, goss_other_k, goss_amp = strategy.goss_constants()
+        cegb_lazy = self._cegb_lazy_dev if use_cegb else None
+        cegb_coupled_raw = self._cegb_coupled_dev if use_cegb else None
         if (obj is not None and not obj.need_renew_tree_output
                 and not obj.stochastic_gradients):
             def fused(bins, scores, mask, fmask, shrink, quant_key=None,
-                      split_key=None):
+                      split_key=None, it=None, goss_key=None,
+                      cegb_used=None):
+                from ..sampling import goss_mask_device
                 grad, hess = obj.get_gradients(scores)
+                if goss_in_trace:
+                    # Same score/key stream as the standalone device mask
+                    # (_iter_masks): |g*h| summed across classes, key
+                    # folded by the absolute iteration number.
+                    gs = grad.reshape(n_rows, -1).sum(axis=1)
+                    hs = hess.reshape(n_rows, -1).sum(axis=1)
+                    mask = goss_mask_device(
+                        gs, hs, jax.random.fold_in(goss_key, it),
+                        goss_top_k, goss_other_k, goss_amp)
+                coupled = lazy = None
+                if use_cegb:
+                    coupled = cegb_coupled_raw * (~cegb_used)
+                    lazy = cegb_lazy
                 outs = []
                 if shape_k:
                     new_scores = scores
@@ -483,15 +545,25 @@ class GBDT:
                               else jax.random.fold_in(split_key, k))
                         ns_k, arrays, row_leaf = grow_apply(
                             bins, new_scores[:, k], grad[:, k], hess[:, k],
-                            mask, fmask, shrink, quant_key=qk, split_key=sk)
+                            mask, fmask, shrink, coupled, lazy,
+                            quant_key=qk, split_key=sk)
                         new_scores = new_scores.at[:, k].set(ns_k)
                         outs.append((arrays, row_leaf))
-                    return new_scores, outs
-                ns, arrays, row_leaf = grow_apply(bins, scores, grad, hess,
-                                                  mask, fmask, shrink,
-                                                  quant_key=quant_key,
-                                                  split_key=split_key)
-                return ns, [(arrays, row_leaf)]
+                else:
+                    new_scores, arrays, row_leaf = grow_apply(
+                        bins, scores, grad, hess, mask, fmask, shrink,
+                        coupled, lazy, quant_key=quant_key,
+                        split_key=split_key)
+                    outs = [(arrays, row_leaf)]
+                if use_cegb:
+                    new_used = cegb_used
+                    if track_used:
+                        for arrays, _rl in outs:
+                            new_used = _mark_features_used_trace(
+                                new_used, arrays.split_feature,
+                                arrays.num_leaves)
+                    return new_scores, outs, new_used
+                return new_scores, outs
             self._fused_core = fused      # scanned by the pack path
             self._fused_iter = jax.jit(fused)
 
@@ -521,10 +593,10 @@ class GBDT:
         grads = None
         if strategy.is_goss:
             top_k, other_k, amp = strategy.goss_constants()
-            if grad is None:
-                # Device-resident GOSS (reference goss.hpp:30-60): gradients
-                # never leave HBM (round-1/2 review: the host argsort pull
-                # was a flagged per-iteration round trip).
+            if grad is None and self._device_goss == "on":
+                # Standalone device GOSS mask (reference goss.hpp:30-60):
+                # gradients never leave HBM even though this config could
+                # not fuse the mask into the iteration dispatch.
                 from ..sampling import goss_mask_device
                 g_dev, h_dev = self._grad_fn(self.scores)
                 grads = (g_dev, h_dev)
@@ -532,6 +604,17 @@ class GBDT:
                 hs = h_dev.reshape(n, -1).sum(axis=1)
                 key = jax.random.fold_in(self._goss_key, self.iter_)
                 mask_dev = goss_mask_device(gs, hs, key, top_k, other_k, amp)
+            elif grad is None:
+                # Host sampler (tpu_device_goss=off, or auto on a config
+                # whose objective already needs per-round host access):
+                # pull the gradients and replay the reference's np argsort
+                # + np.random rest-sample exactly.
+                g_dev, h_dev = self._grad_fn(self.scores)
+                grads = (g_dev, h_dev)
+                gm = np.asarray(jax.device_get(g_dev)).reshape(n, -1)
+                hm = np.asarray(jax.device_get(h_dev)).reshape(n, -1)
+                mask_dev = jnp.asarray(strategy.mask(
+                    self.iter_, gm.sum(axis=1), hm.sum(axis=1)))
             else:
                 gm = np.asarray(grad).reshape(n, -1)
                 hm = np.asarray(hess).reshape(n, -1)
@@ -543,9 +626,15 @@ class GBDT:
             mask_dev = self._bag_mask_dev
         else:
             mask_dev = self._full_mask
-        fmask = (self._fmask_static if self._fmask_static is not None
-                 else jnp.asarray(self.feature_sampler.tree_mask(self.iter_)))
-        return mask_dev, fmask, grads
+        return mask_dev, self._tree_fmask(), grads
+
+    def _tree_fmask(self) -> jnp.ndarray:
+        """This iteration's feature mask — the ONE derivation shared by
+        ``_iter_masks`` and the fused-GOSS branch of ``train_one_iter``
+        (static mask when feature_fraction == 1, per-tree host sample
+        otherwise)."""
+        return (self._fmask_static if self._fmask_static is not None
+                else jnp.asarray(self.feature_sampler.tree_mask(self.iter_)))
 
     def _store_tree(self, k: int, arrays: TreeArrays,
                     row_leaf: jnp.ndarray) -> None:
@@ -564,10 +653,14 @@ class GBDT:
         """Does ``train_one_iter`` (without explicit gradients) take the
         fused one-dispatch path?  The ONE predicate shared with
         ``tools/profile_iter.py``'s dispatch census so the census label can
-        never disagree with the branch actually taken."""
+        never disagree with the branch actually taken.  GOSS rides the
+        fused dispatch whenever device GOSS is allowed (tpu_device_goss
+        auto/on) and CEGB always does (its used-feature vector is device
+        state); linear trees still solve leaf models outside it."""
         return (self._fused_iter is not None
-                and not self.sample_strategy.is_goss
-                and not self._use_cegb and not self.cfg.linear_tree)
+                and not (self.sample_strategy.is_goss
+                         and self._device_goss == "off")
+                and not self.cfg.linear_tree)
 
     def train_one_iter(self, grad: Optional[np.ndarray] = None,
                        hess: Optional[np.ndarray] = None) -> bool:
@@ -579,7 +672,15 @@ class GBDT:
                 "objective='custom' requires gradients: pass a callable "
                 "objective in params or call update(fobj=...) "
                 "(reference LGBM_BoosterUpdateOneIterCustom)")
-        mask_dev, fmask, goss_grads = self._iter_masks(grad, hess)
+        used_fused = grad is None and self.fused_path_active
+        goss_in_fused = used_fused and self.sample_strategy.is_goss
+        if goss_in_fused:
+            # The GOSS mask is derived IN-TRACE from the fused iteration's
+            # own gradients — no standalone mask dispatch, no host pull.
+            mask_dev, goss_grads = self._full_mask, None
+            fmask = self._tree_fmask()
+        else:
+            mask_dev, fmask, goss_grads = self._iter_masks(grad, hess)
         shrink = cfg.learning_rate if cfg.boosting != "rf" else 1.0
         qkey = (jax.random.fold_in(self._quant_key, self.iter_)
                 if self._quant_key is not None else None)
@@ -587,13 +688,19 @@ class GBDT:
                 if self._split_key is not None else None)
 
         results = []
-        used_fused = grad is None and self.fused_path_active
         if used_fused:
             # Hot path: ONE device dispatch for gradients + all class trees +
-            # score updates.
-            self.scores, outs = self._hist_fallback_call(
+            # score updates (+ the in-trace GOSS mask / CEGB used-vector).
+            it_arg = np.int32(self.iter_) if goss_in_fused else None
+            gkey = self._goss_key if goss_in_fused else None
+            used0 = self._cegb_used_dev if self._use_cegb else None
+            out = self._hist_fallback_call(
                 "_fused_iter", self.bins_dev, self.scores, mask_dev,
-                fmask, shrink, qkey, skey)
+                fmask, shrink, qkey, skey, it_arg, gkey, used0)
+            if self._use_cegb:
+                self.scores, outs, self._cegb_used_dev = out
+            else:
+                self.scores, outs = out
             results = [(k, a, rl) for k, (a, rl) in enumerate(outs)]
         else:
             if goss_grads is not None:
@@ -607,8 +714,14 @@ class GBDT:
                 gk = g_dev[:, k] if self._shape_k else g_dev
                 hk = h_dev[:, k] if self._shape_k else h_dev
                 sk = self.scores[:, k] if self._shape_k else self.scores
-                qk = None if qkey is None else jax.random.fold_in(qkey, k)
-                nk = None if skey is None else jax.random.fold_in(skey, k)
+                # Key derivation mirrors the fused trace exactly (fold by
+                # class only in the multiclass shape), so fused-vs-unfused
+                # trees stay bitwise identical under quantized rounding
+                # and split smearing.
+                qk = (qkey if qkey is None or not self._shape_k
+                      else jax.random.fold_in(qkey, k))
+                nk = (skey if skey is None or not self._shape_k
+                      else jax.random.fold_in(skey, k))
                 if cfg.linear_tree:
                     arrays, row_leaf = self._hist_fallback_call(
                         "_raw_grow", gk, hk, mask_dev, fmask, qk, nk)
@@ -628,8 +741,7 @@ class GBDT:
                     new_sk = _add_leaf_outputs(sk, row_leaf,
                                                arrays.leaf_value)
                 elif self._use_cegb:
-                    coupled = jnp.asarray(
-                        self._cegb_coupled_raw * (~self._cegb_used))
+                    coupled = self._cegb_coupled_dev * (~self._cegb_used_dev)
                     new_sk, arrays, row_leaf = self._hist_fallback_call(
                         "_grow_apply", self.bins_dev, sk, gk, hk, mask_dev,
                         fmask, shrink, coupled, self._cegb_lazy_dev, qk, nk)
@@ -645,12 +757,16 @@ class GBDT:
         for k, arrays, row_leaf in results:
             self._store_tree(k, arrays, row_leaf)
         self.iter_ += 1
-        if self._use_cegb and self._cegb_coupled_raw.any():
-            # Coupled penalties: mark this iteration's split features used.
+        if (self._use_cegb and not used_fused
+                and self._cegb_coupled_raw.any()):
+            # Coupled penalties, non-fused fallback (custom gradients /
+            # renew objectives): mark this iteration's split features used
+            # with the SAME in-trace update the fused path runs, so the
+            # device vector stays the one source of truth.
             for _, arrays, _rl in results:
-                sf, nl = jax.device_get((arrays.split_feature,
-                                         arrays.num_leaves))
-                self._cegb_used[np.asarray(sf[: max(int(nl) - 1, 0)])] = True
+                self._cegb_used_dev = _mark_features_used(
+                    self._cegb_used_dev, arrays.split_feature,
+                    arrays.num_leaves)
         nls = [a.num_leaves for _, a, _rl in results] + self._linear_nls
         self._linear_nls = []
         # Deferring the degenerate-stop fetch by one iteration keeps the
@@ -661,7 +777,13 @@ class GBDT:
         # per-iteration RNG (bagging/GOSS resample, quantize or smearing
         # keys, DART score mutation all break that, as does any path that
         # already syncs the host each iteration).
+        # goss_in_fused passes the full mask only as a placeholder — the
+        # real mask is recomputed in-trace each iteration, so a stump round
+        # would NOT replay identically and the check cannot defer.  Fused
+        # CEGB CAN defer: a stump leaves scores AND the used vector
+        # unchanged, so iteration t+1 replays t exactly.
         defer = (used_fused and self._deterministic_iters
+                 and not goss_in_fused
                  and mask_dev is self._full_mask
                  and self._fmask_static is not None
                  and qkey is None and skey is None)
@@ -694,11 +816,12 @@ class GBDT:
             return ("objective needs per-round host access (tree-output "
                     "renewal or host-stochastic gradients)")
         if cfg.linear_tree:
-            return "linear trees solve leaf models on the host each round"
-        if self._use_cegb:
-            return "CEGB tracks first-use feature penalties on the host"
-        if self.sample_strategy.is_goss:
-            return "GOSS resampling is derived outside the fused iteration"
+            return ("linear trees read tree structure back each round "
+                    "(batched device solve, but per-round host attach)")
+        if (self.sample_strategy.is_goss
+                and self._device_goss == "off"):
+            return ("GOSS uses the host sampler (tpu_device_goss=off); "
+                    "device GOSS (auto/on) is pack-capable")
         if self.sample_strategy.is_balanced or cfg.bagging_by_query:
             return "balanced / by-query bagging samples on the host"
         return None
@@ -740,10 +863,17 @@ class GBDT:
                 k = 1
         # EVERY resolution passes the lockstep gate: a pack-vs-no-pack
         # divergence across processes must fail fast at the allgather, not
-        # hang the packing processes inside it.
+        # hang the packing processes inside it.  The payload also carries
+        # the in-trace sampling/penalty capabilities — a device-GOSS or
+        # fused-CEGB divergence would change the scanned program's
+        # collective layout just like a hist_comm divergence would.
         from ..parallel.distributed import assert_pack_lockstep
         return assert_pack_lockstep(
-            k, use, hist_comm=self.grower_cfg.hist_comm), use
+            k, use, hist_comm=self.grower_cfg.hist_comm,
+            device_goss=bool(self.sample_strategy.is_goss
+                             and self._device_goss != "off"),
+            cegb_fused=bool(self._use_cegb
+                            and self._fused_iter is not None)), use
 
     def _pack_fn(self, k: int):
         """Compiled K-round program: ONE ``lax.scan`` over the fused
@@ -769,11 +899,14 @@ class GBDT:
             ff_k = max(int(np.ceil(nvalid * cfg.feature_fraction)), 1)
         use_quant = self._quant_key is not None
         use_split = self._split_key is not None
+        use_goss = strategy.is_goss          # pack-capable => device GOSS
+        use_cegb = self._use_cegb
         from ..sampling import bagging_mask_device, feature_mask_device
 
         def packed(bins, scores, iter0, shrink, row_mask, base_fmask,
-                   bag_key, ff_key, quant_key, split_key):
-            def body(sc, it):
+                   bag_key, ff_key, quant_key, split_key, cegb_used=None):
+            def body(carry, it):
+                sc, used = carry if use_cegb else (carry, None)
                 mask = (bagging_mask_device(bag_key, it // bag_freq, n,
                                             bag_k)
                         if use_bag else row_mask)
@@ -783,13 +916,29 @@ class GBDT:
                       else None)
                 sk = (jax.random.fold_in(split_key, it) if use_split
                       else None)
-                new_sc, outs = core(bins, sc, mask, fmask, shrink, qk, sk)
+                # bag_key IS the GOSS key (PRNGKey(bagging_seed), folded
+                # by the absolute iteration in-trace — the same stream the
+                # per-round fused iteration uses, so K is scheduling-only).
+                out = core(bins, sc, mask, fmask, shrink, qk, sk,
+                           it=it if use_goss else None,
+                           goss_key=bag_key if use_goss else None,
+                           cegb_used=used)
+                if use_cegb:
+                    new_sc, outs, new_used = out
+                    return ((new_sc, new_used),
+                            (tuple(a for a, _rl in outs), new_used))
+                new_sc, outs = out
                 return new_sc, tuple(a for a, _rl in outs)
 
             iters = iter0 + jnp.arange(k, dtype=jnp.int32)
-            scores2, stacked = jax.lax.scan(body, scores, iters)
+            if use_cegb:
+                (scores2, _used2), (stacked, used_stack) = jax.lax.scan(
+                    body, (scores, cegb_used), iters)
+            else:
+                scores2, stacked = jax.lax.scan(body, scores, iters)
+                used_stack = None
             nls = jnp.stack([t.num_leaves for t in stacked], axis=1)
-            return scores2, stacked, nls
+            return scores2, stacked, nls, used_stack
 
         fn = jax.jit(packed)
         self._pack_fns[k] = fn
@@ -818,13 +967,14 @@ class GBDT:
                       else jnp.asarray(self.feature_sampler.used))
         args = (self.bins_dev, self.scores, np.int32(self.iter_), shrink,
                 self._full_mask, base_fmask, self._goss_key, self._ff_key,
-                self._quant_key, self._split_key)
+                self._quant_key, self._split_key,
+                self._cegb_used_dev if self._use_cegb else None)
         try:
-            scores2, stacked, nls = self._pack_fn(k)(*args)
+            scores2, stacked, nls, used_stack = self._pack_fn(k)(*args)
         except Exception as e:  # noqa: BLE001 — degrade-and-retry (Mosaic)
             if not self._degrade_histogram_impl(e):
                 raise
-            scores2, stacked, nls = self._pack_fn(k)(*args)
+            scores2, stacked, nls, used_stack = self._pack_fn(k)(*args)
         self.scores = scores2
         nls_host = np.asarray(jax.device_get(nls))    # the ONE sync per pack
         dead = np.all(nls_host <= 1, axis=1)
@@ -832,6 +982,11 @@ class GBDT:
         finished = bool(dead.any())
         rounds = [[slice_tree_arrays(stacked[c], j)
                    for c in range(self.num_class)] for j in range(j0)]
+        # CEGB: per-round used-vector snapshots; commit_round advances the
+        # resident vector through them so an uncommitted tail (mid-pack
+        # early stop) never leaks its first-use marks.
+        self._pack_used_pending = (
+            [used_stack[j] for j in range(j0)] if self._use_cegb else [])
         # Rounds at/after the stop are dropped; any that still grew (a
         # later bagging epoch can revive growth after a degenerate round —
         # the reference stops at the FIRST degenerate round regardless)
@@ -848,6 +1003,8 @@ class GBDT:
         updates, no host sync) and advance the iteration counter."""
         for c, arrays in enumerate(round_arrays):
             self._store_tree(c, arrays, None)
+        if self._pack_used_pending:
+            self._cegb_used_dev = self._pack_used_pending.pop(0)
         self.iter_ += 1
 
     def discard_rounds(self, rounds) -> None:
@@ -855,6 +1012,7 @@ class GBDT:
         were trained inside the same dispatch but must vanish as if
         training had halted per-round.  Stumps carry zero leaf values, so
         subtracting every tree's prediction is exact."""
+        self._pack_used_pending = []
         for rnd in rounds:
             for c, arrays in enumerate(rnd):
                 self._subtract_tree_scores(c, arrays)
@@ -972,9 +1130,18 @@ class GBDT:
 
     def _fit_and_store_linear(self, k: int, arrays: TreeArrays, row_leaf,
                               gk, hk, mask_dev, sk, shrink: float):
-        """Linear-tree path (reference ``LinearTreeLearner``): host
-        normal-equation solves per leaf, host score updates on raw values."""
-        from .linear import fit_leaf_linear_models, predict_linear
+        """Linear-tree path (reference ``LinearTreeLearner``): the per-leaf
+        weighted normal equations are built by segment-sums over the
+        row->leaf assignment and solved in ONE batched device dispatch
+        (ops/linear.py) — the per-leaf host Python loop and its six
+        gradient/hessian/mask/row pulls are gone; the host touches only
+        the tree structure (one batched transfer, as every path does) and
+        one (L,)-shaped coefficient readback.  The reference's f64 host
+        solve stays behind the models/linear.py facade
+        (LIGHTGBM_TPU_HOST_LINEAR=1) for parity debugging and platforms
+        where the batched f32 solve is unavailable."""
+        from .linear import fit_leaf_linear_models, leaf_path_features, \
+            predict_linear
 
         ub = self.train_data.binned.upper_bounds_padded
         tree = Tree.from_arrays(arrays, ub)  # unshrunk
@@ -993,18 +1160,40 @@ class GBDT:
             self._host_cache[k].append(tree)
             self._linear_nls.append(tree.num_leaves)
             return sk
-        rl = np.asarray(jax.device_get(row_leaf))
-        m = np.asarray(jax.device_get(mask_dev), np.float64)
-        g = np.asarray(jax.device_get(gk), np.float64) * m
-        h = np.asarray(jax.device_get(hk), np.float64) * m
-        # Solve with unshrunk stats, then one Tree::Shrinkage covers leaf
-        # values, constants and coefficients (reference tree.h:201-213).
-        fit_leaf_linear_models(
-            tree, raw, rl, g, h, self.cfg.linear_lambda,
-            np.asarray(self.train_data.binned.is_categorical))
-        tree.shrink(shrink)
-        pred = predict_linear(tree, rl, raw)
-        new_sk = sk + jnp.asarray(pred, jnp.float32)
+        if os.environ.get("LIGHTGBM_TPU_HOST_LINEAR", "0") == "1":
+            rl = np.asarray(jax.device_get(row_leaf))
+            m = np.asarray(jax.device_get(mask_dev), np.float64)
+            g = np.asarray(jax.device_get(gk), np.float64) * m
+            h = np.asarray(jax.device_get(hk), np.float64) * m
+            # Solve with unshrunk stats, then one Tree::Shrinkage covers
+            # leaf values, constants and coefficients (tree.h:201-213).
+            fit_leaf_linear_models(
+                tree, raw, rl, g, h, self.cfg.linear_lambda,
+                np.asarray(self.train_data.binned.is_categorical))
+            tree.shrink(shrink)
+            pred = predict_linear(tree, rl, raw)
+            new_sk = sk + jnp.asarray(pred, jnp.float32)
+        else:
+            from ..ops.linear import attach_leaf_models, \
+                fit_linear_leaves_device, pad_leaf_features
+            if getattr(self, "_raw_dev", None) is None:
+                self._raw_dev = jnp.asarray(raw, jnp.float32)
+            feats = leaf_path_features(
+                tree, raw.shape[1],
+                np.asarray(self.train_data.binned.is_categorical))
+            lf_np, fok_np = pad_leaf_features(feats, arrays.max_leaves)
+            lv_np = np.zeros(arrays.max_leaves, np.float32)
+            lv_np[: tree.num_leaves] = np.asarray(
+                tree.leaf_value[: tree.num_leaves], np.float32)
+            coeffs, const, good, pred = fit_linear_leaves_device(
+                self._raw_dev, row_leaf, gk, hk, mask_dev,
+                jnp.asarray(lf_np), jnp.asarray(fok_np),
+                jnp.asarray(lv_np), self.cfg.linear_lambda, shrink)
+            new_sk = sk + pred
+            co, cs, gd = jax.device_get((coeffs, const, good))
+            attach_leaf_models(tree, feats, np.asarray(co),
+                               np.asarray(cs), np.asarray(gd))
+            tree.shrink(shrink)
         self.dev_models[k].append(arrays)
         self._host_cache[k].append(tree)
         self._linear_nls.append(tree.num_leaves)

@@ -1,0 +1,182 @@
+"""Device-resident linear-leaf solves (``linear_tree``).
+
+Reference: ``LinearTreeLearner::CalculateLinear`` (``src/treelearner/
+linear_tree_learner.cpp``) solves one small weighted normal-equation
+system per leaf on the host (Eigen), looping leaves in Python here —
+six host syncs per iteration pulling gradients, hessians, the mask and
+the row->leaf vector off the device (the ISSUE-5 census numbers).
+
+TPU re-design: ONE dispatch builds every leaf's normal equations by
+segment-summing weighted feature outer products over the row->leaf
+assignment — each leaf's path-feature set is padded to a common width
+``Dp`` (next power of two, so the trace re-specializes O(log depth)
+times at most) — and a single batched ``jnp.linalg.solve`` solves all
+leaves at once.  NaN-row masking and the too-few-rows fallback replicate
+the host semantics exactly; padded dimensions carry an identity diagonal
+and a zero RHS, so their coefficients come out exactly zero.  The solve
+runs in the device's native f32 (the reference's f64 Eigen solve stays
+available behind the host facade, ``models/linear.py``, for callers that
+need it — LIGHTGBM_TPU_HOST_LINEAR=1).
+
+The op also emits the per-row training predictions (linear output with
+per-row NaN fallback to the constant leaf value), so no per-leaf value
+ever round-trips the host inside the training loop.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Above this many scratch elements (rows x padded-dim^2) the outer-product
+# accumulation runs as a lax.scan over row blocks instead of one
+# materialized (N, D1, D1) tensor.
+_BLOCK_ELEMS = 1 << 24
+_BLOCK_ROWS = 1 << 16
+
+
+def pad_leaf_features(feats: Sequence[np.ndarray], num_leaves_max: int
+                      ) -> Tuple[np.ndarray, np.ndarray]:
+    """Pack per-leaf path-feature lists into ``(leaf_feat, feat_ok)`` —
+    ``(L, Dp)`` int32 indices (real features first, zero-padded) and the
+    matching validity mask.  ``Dp`` is the max feature count rounded up to
+    a power of two (min 2) so the jitted solve re-specializes at most
+    O(log depth) times across a training run."""
+    dmax = max([len(f) for f in feats] + [1])
+    dp = 2
+    while dp < dmax:
+        dp *= 2
+    leaf_feat = np.zeros((num_leaves_max, dp), np.int32)
+    feat_ok = np.zeros((num_leaves_max, dp), bool)
+    for l, fl in enumerate(feats):
+        d = len(fl)
+        if d:
+            leaf_feat[l, :d] = np.asarray(fl, np.int32)
+            feat_ok[l, :d] = True
+    return leaf_feat, feat_ok
+
+
+def _accumulate(Xa, gz, hz, okf, rl, L):
+    """(A, b, cnt) segment sums over rows; blocked when the outer-product
+    scratch would not fit comfortably."""
+    n, d1 = Xa.shape
+
+    def seg(block):
+        xa, g, h, ok, r = block
+        a = jax.ops.segment_sum(
+            h[:, None, None] * xa[:, :, None] * xa[:, None, :], r,
+            num_segments=L + 1)
+        b = jax.ops.segment_sum(g[:, None] * xa, r, num_segments=L + 1)
+        c = jax.ops.segment_sum(ok.astype(jnp.float32), r,
+                                num_segments=L + 1)
+        return a, b, c
+
+    if n * d1 * d1 <= _BLOCK_ELEMS:
+        a, b, c = seg((Xa, gz, hz, okf, rl))
+        return a[:L], b[:L], c[:L]
+    blk = _BLOCK_ROWS
+    pad = (-n) % blk
+    Xa = jnp.pad(Xa, ((0, pad), (0, 0)))
+    gz = jnp.pad(gz, (0, pad))
+    hz = jnp.pad(hz, (0, pad))
+    okf = jnp.pad(okf, (0, pad))
+    rl = jnp.pad(rl, (0, pad), constant_values=L)   # pad rows -> dropped
+    nb = (n + pad) // blk
+
+    def body(carry, block):
+        a0, b0, c0 = carry
+        a, b, c = seg(block)
+        return (a0 + a, b0 + b, c0 + c), None
+
+    shape = lambda *s: jnp.zeros(s, jnp.float32)
+    (a, b, c), _ = jax.lax.scan(
+        body,
+        (shape(L + 1, d1, d1), shape(L + 1, d1), shape(L + 1)),
+        (Xa.reshape(nb, blk, d1), gz.reshape(nb, blk),
+         hz.reshape(nb, blk), okf.reshape(nb, blk), rl.reshape(nb, blk)))
+    return a[:L], b[:L], c[:L]
+
+
+def fit_linear_leaves(X, row_leaf, grad, hess, mask, leaf_feat, feat_ok,
+                      leaf_value, linear_lambda, shrink):
+    """Solve every leaf's weighted normal equations in one batched device
+    program (trace body — see :func:`fit_linear_leaves_device`).
+
+    Replicates ``fit_leaf_linear_models``: rows whose leaf features
+    contain NaN are excluded from the solve and fall back to the plain
+    leaf value at prediction; a leaf with fewer usable rows than
+    coefficients (or an empty feature set, or a singular system) keeps
+    its constant output.  (Refit's decay blend stays on the host —
+    ``models/linear.refit_leaf_linear_models`` — its keep-old /
+    intercept-only-leaf semantics operate on the post-trim feature sets,
+    not the fit-time padded ones.)
+
+    Returns ``(coeffs (L, Dp), const (L,), good (L,) bool,
+    pred (N,))`` — ``pred`` is the SHRUNK per-row training contribution.
+    """
+    n = X.shape[0]
+    L, dp = leaf_feat.shape
+    lf = leaf_feat[row_leaf]                      # (N, Dp)
+    fok = feat_ok[row_leaf]                       # (N, Dp)
+    xr = jnp.take_along_axis(X, lf, axis=1)
+    nan_row = jnp.any(jnp.isnan(xr) & fok, axis=1)
+    ok = ~nan_row
+    xr0 = jnp.where(fok & ~jnp.isnan(xr), xr, 0.0)
+    Xa = jnp.concatenate([xr0, jnp.ones((n, 1), xr0.dtype)], axis=1)
+    Xa = jnp.where(ok[:, None], Xa, 0.0)
+    gz = jnp.where(ok, grad * mask, 0.0)
+    hz = jnp.where(ok, hess * mask, 0.0)
+    A, b, cnt = _accumulate(Xa, gz, hz, ok, row_leaf, L)
+    # Diagonal: ridge lambda on real feature dims, identity on padded
+    # dims (zero rows/cols otherwise — keeps the batched solve
+    # nonsingular with an exactly-zero padded coefficient), nothing on
+    # the intercept (reference adds lambda to the d feature dims only).
+    dleaf = feat_ok.sum(axis=1)                   # (L,)
+    j = jnp.arange(dp + 1)
+    diag_add = jnp.where(j[None, :] < dleaf[:, None],
+                         jnp.float32(linear_lambda),
+                         jnp.where(j[None, :] == dp, 0.0, 1.0))
+    A = A + diag_add[:, :, None] * jnp.eye(dp + 1, dtype=A.dtype)[None]
+    coeffs_all = -jnp.linalg.solve(A, b[:, :, None])[:, :, 0]   # (L, Dp+1)
+    good = ((dleaf > 0) & (cnt >= dleaf + 1)
+            & jnp.all(jnp.isfinite(coeffs_all), axis=1))
+    coeffs = jnp.where(good[:, None], coeffs_all[:, :dp], 0.0)
+    const = jnp.where(good, coeffs_all[:, dp], leaf_value)
+    lin = jnp.sum(xr0 * coeffs[row_leaf], axis=1) + const[row_leaf]
+    pred = jnp.where(good[row_leaf] & ok, lin, leaf_value[row_leaf])
+    return coeffs, const, good, pred * shrink
+
+
+fit_linear_leaves_device = jax.jit(fit_linear_leaves)
+
+
+def attach_leaf_models(tree, feats: List[np.ndarray], coeffs: np.ndarray,
+                       const: np.ndarray, good: np.ndarray,
+                       zero_threshold: float = 1e-35) -> None:
+    """Attach the batched device solve's results to a host Tree (mutates
+    ``tree``) with the reference's |coef| > kZeroThreshold feature trim —
+    the ONE host pass replacing the per-leaf solve loop."""
+    nl = tree.num_leaves
+    leaf_const = np.asarray(tree.leaf_value[:nl], np.float64).copy()
+    leaf_features: List[np.ndarray] = []
+    leaf_coeffs: List[np.ndarray] = []
+    for l in range(nl):
+        fl = np.asarray(feats[l], np.int64) if l < len(feats) \
+            else np.zeros(0, np.int64)
+        d = len(fl)
+        if d == 0 or not bool(good[l]):
+            leaf_features.append(np.zeros(0, np.int64))
+            leaf_coeffs.append(np.zeros(0, np.float64))
+            continue
+        c = np.asarray(coeffs[l][:d], np.float64)
+        keep = np.abs(c) > zero_threshold
+        leaf_features.append(fl[keep])
+        leaf_coeffs.append(c[keep])
+        leaf_const[l] = float(const[l])
+    tree.is_linear = True
+    tree.leaf_const = leaf_const
+    tree.leaf_features = leaf_features
+    tree.leaf_coeff = leaf_coeffs

@@ -29,12 +29,22 @@ def gradient_scales(
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Per-iteration scale factors mapping grad/hess onto integer levels.
 
-    Mirrors the reference's scale computation (``gradient_discretizer.cpp``):
-    gradients use the signed half-range ``num_bins/2 - 1`` levels per sign,
-    hessians (non-negative) the full ``num_bins - 1`` range.
+    Mirrors the reference's scale computation (``gradient_discretizer.cpp``
+    / arXiv:2207.09682 §3.1): gradients use the signed half-range
+    ``num_bins / 2`` levels per sign (delta_g = max|g| / (B/2)), hessians
+    (non-negative) the full ``num_bins`` range (delta_h = max h / B).
+
+    The previous ``num_bins/2 - 1`` / ``num_bins - 1`` divisors halved the
+    gradient resolution at the default B=4 (levels {-1, 0, 1} instead of
+    {-2..2}) and cost a measured ~2.6e-3 holdout AUC at the bench config
+    (docs/PERF.md round 8) — the whole quantized-parity drift.
     """
-    g_levels = max(num_bins // 2 - 1, 1)
-    h_levels = max(num_bins - 1, 1)
+    # int8 storage bounds the level range at +/-127: at the maximum
+    # num_grad_quant_bins=128 the full-range hessian level would be 128
+    # and silently clip low for every max-hessian row, so the scale must
+    # target the largest level that actually fits.
+    g_levels = min(max(num_bins // 2, 1), 127)
+    h_levels = min(max(num_bins, 1), 127)
     g_scale = jnp.maximum(jnp.max(jnp.abs(grad)) / g_levels, _EPS)
     h_scale = jnp.maximum(jnp.max(jnp.abs(hess)) / h_levels, _EPS)
     return g_scale.astype(jnp.float32), h_scale.astype(jnp.float32)

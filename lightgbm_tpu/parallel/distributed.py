@@ -115,7 +115,8 @@ _HIST_COMM_CODES = {"": 0, "auto": 1, "allreduce": 2, "reduce_scatter": 3}
 
 
 def assert_pack_lockstep(pack_size: int, use_pack: bool = True,
-                         hist_comm: str = "") -> int:
+                         hist_comm: str = "", device_goss: bool = False,
+                         cegb_fused: bool = False) -> int:
     """Validate an iteration-pack resolution under a multi-process mesh.
 
     The pack path scans K boosting rounds inside ONE jitted dispatch whose
@@ -131,11 +132,13 @@ def assert_pack_lockstep(pack_size: int, use_pack: bool = True,
     resolution — a pack-vs-no-pack divergence would otherwise hang right
     here, with the packing processes waiting on ones that never arrive —
     so ``iter_pack_plan`` routes BOTH outcomes through it and the gathered
-    payload carries (pack_size, use_pack, tpu_hist_comm).  A
-    ``tpu_hist_comm`` divergence would pit a full-histogram all-reduce on
-    one process against a reduce-scatter on another — the exact
-    cross-collective hang this check exists to pre-empt.  No-op in
-    single-process mode."""
+    payload carries (pack_size, use_pack, tpu_hist_comm, device_goss,
+    cegb_fused).  A ``tpu_hist_comm`` divergence would pit a full-histogram
+    all-reduce on one process against a reduce-scatter on another — the
+    exact cross-collective hang this check exists to pre-empt; a
+    device-GOSS or fused-CEGB divergence (one process sampling in-trace
+    while another loops the host) would likewise split the scanned
+    program's collective schedule.  No-op in single-process mode."""
     if not is_multi_process():
         return pack_size
     try:
@@ -143,18 +146,19 @@ def assert_pack_lockstep(pack_size: int, use_pack: bool = True,
         import numpy as _np
         comm_code = _HIST_COMM_CODES.get(hist_comm, -1)
         plans = _np.asarray(multihost_utils.process_allgather(
-            _np.asarray([pack_size, int(use_pack), comm_code], _np.int32)))
-        plans = plans.reshape(-1, 3)
+            _np.asarray([pack_size, int(use_pack), comm_code,
+                         int(device_goss), int(cegb_fused)], _np.int32)))
+        plans = plans.reshape(-1, 5)
     except Exception as exc:  # noqa: BLE001 — allgather transport hiccup
         log_warning(f"pack lockstep check skipped: {exc}")
         return pack_size
-    uniq = {(int(k), int(u), int(c)) for k, u, c in plans}
+    uniq = {tuple(int(v) for v in row) for row in plans}
     if len(uniq) > 1:
         raise ValueError(
             f"tpu_iter_pack lockstep violation: processes resolved pack "
-            f"plans (size, packed, hist_comm) = {sorted(uniq)}; all "
-            "processes must train with identical pack and histogram-comm "
-            "configuration")
+            f"plans (size, packed, hist_comm, device_goss, cegb_fused) = "
+            f"{sorted(uniq)}; all processes must train with identical "
+            "pack, histogram-comm and in-trace sampling configuration")
     return pack_size
 
 

@@ -141,8 +141,14 @@ class LambdaRankNDCG(ObjectiveFunction):
             lam_j = -jnp.sum(sign * lam, axis=1)                        # (Q,S)
             hes_j = jnp.sum(hes, axis=1)
             if norm:
-                # Reference normalizes per query by sum of |lambda| (norm_factor).
-                sum_abs = jnp.sum(jnp.abs(lam), axis=(1, 2)) + 1e-20
+                # Reference normalizes per query by the accumulated
+                # |lambda| over BOTH pair endpoints (``sum_lambdas -=
+                # 2 * p_lambda``, rank_objective.hpp:178) — the factor is
+                # log2(1 + 2S)/(2S), not log2(1 + S)/S; the halved
+                # denominator over-scaled every query's lambdas and let
+                # position-bias over-correction swamp the debias gain
+                # (test_unbiased_lambdarank_positions).
+                sum_abs = 2.0 * jnp.sum(jnp.abs(lam), axis=(1, 2)) + 1e-20
                 scale = jnp.where(
                     sum_abs > 0,
                     jnp.log2(1.0 + sum_abs) / sum_abs, 1.0)[:, None]

@@ -1,13 +1,15 @@
-"""bench.py shape-matrix rungs (ISSUE-4 satellite / VERDICT weak #2): the
-lambdarank (MS-LTR-like) and wide (Epsilon-like) rungs must emit their
-detail blobs on ANY platform — the hermetic CPU fallback included — and the
-wide rung must actually engage the bounded histogram pool it exists to
-exercise.  Scaled-down geometries here; bench.py's env knobs carry the
-full MS-LTR/Epsilon sizes."""
+"""bench.py shape-matrix rungs (ISSUE-4 satellite / VERDICT weak #2,
+GOSS rung ISSUE-5): the lambdarank (MS-LTR-like), wide (Epsilon-like) and
+GOSS (Higgs-shape sampled) rungs must emit their detail blobs on ANY
+platform — the hermetic CPU fallback included — the wide rung must
+actually engage the bounded histogram pool it exists to exercise, and the
+GOSS rung must witness the device-resident sampler's ONE compiled dispatch
+per boosting round.  Scaled-down geometries here; bench.py's env knobs
+carry the full sizes."""
 
 import jax
 
-from bench import run_ltr_rung, run_wide_rung
+from bench import run_goss_rung, run_ltr_rung, run_wide_rung
 
 
 def test_ltr_rung_blob():
@@ -30,3 +32,15 @@ def test_wide_rung_blob_pool_engaged():
     assert blob["pool_engaged"] is True
     assert blob["pool_slots"] < 31
     assert blob["leaf_hist_mb_pooled"] < blob["leaf_hist_mb_unpooled"]
+
+
+def test_goss_rung_blob_one_dispatch():
+    blob = run_goss_rung(4096, 2, "cpu", jax, features=12, num_leaves=15)
+    assert blob["rows"] == 4096 and blob["features"] == 12
+    assert blob["data_sample_strategy"] == "goss"
+    assert blob["row_iters_per_sec"] > 0
+    # device GOSS (tpu_device_goss auto) keeps the round fused: the mask
+    # is derived in-trace, so the census sees exactly one program launch
+    assert blob["used_fused"] is True
+    assert blob["dispatches_per_iter"] == 1.0
+    assert blob["host_syncs_per_iter"] <= 2.0

@@ -87,7 +87,11 @@ def test_sparse_bins_match_dense_bins():
 def test_sparse_ingestion_memory_bounded():
     """Constructing a Dataset from a 100k x 2000 / ~1% CSR must stay O(nnz)
     + the uint8 bin matrix — never the ~1.6 GB dense f64 copy (VERDICT r3
-    missing #4).  Measured as child-process peak RSS."""
+    missing #4).  Measured as the child process's peak-RSS DELTA across the
+    construct call against a same-process baseline taken right before it —
+    an absolute bound flaked under concurrent test processes (allocator /
+    import-baseline noise moved the ambient floor); the delta is invariant
+    to whatever the baseline happens to be (ISSUE-5 satellite)."""
     pytest.importorskip("scipy.sparse")
     import os
     import subprocess
@@ -99,11 +103,11 @@ import numpy as np
 import scipy.sparse as sp
 import lightgbm_tpu as lgb
 
-# On Linux ru_maxrss survives exec and records the FORK-MOMENT copy-on-
-# write footprint of the launching process — under a jax-heavy pytest
-# parent that alone exceeds any sane bound (ADVICE r4 medium #2).  Reset
-# the kernel's peak-RSS watermark now that imports are done, then read
-# VmHWM (this process's true peak from here on) at the end.
+# Reset the kernel's peak-RSS watermark (clear_refs "5") so VmHWM tracks
+# only what happens AFTER the baseline point; where clear_refs is
+# unavailable fall back to ru_maxrss, whose pre/post difference still
+# catches any allocation pushing past the prior lifetime peak (the 1.6 GB
+# dense copy always does).
 def _reset_peak():
     try:
         with open("/proc/self/clear_refs", "w") as fh:
@@ -120,8 +124,6 @@ def _peak_mb(use_hwm):
                     return int(line.split()[1]) / 1024
     return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024
 
-_hwm_ok = _reset_peak()
-
 n, f, nnz_per_col = 100_000, 2000, 1000
 rng = np.random.RandomState(0)
 # .copy() matters: choice(replace=False) returns a slice view that pins
@@ -133,14 +135,20 @@ vals = rng.randn(f * nnz_per_col)
 X = sp.csr_matrix((vals, (rows, cols)), shape=(n, f))
 y = (np.asarray(X[:, 0].todense()).ravel() > 0).astype(float)
 ds = lgb.Dataset(X, label=y)
+
+# Same-process baseline: imports done, data built, nothing constructed.
+_hwm_ok = _reset_peak()
+base_mb = _peak_mb(_hwm_ok)
+
 ds.construct({"objective": "binary", "verbosity": -1,
               "enable_bundle": False})
-peak_mb = _peak_mb(_hwm_ok)
-print("PEAK_MB", peak_mb, "(VmHWM)" if _hwm_ok else "(ru_maxrss)")
-# bins (100k x 2000 uint8) = 200 MB; jax/numpy baseline ~350 MB; head-
-# room for allocator noise under concurrent test load.  The dense-f64
-# path would add 1600 MB on top of the baseline, far beyond the bound.
-sys.exit(0 if peak_mb < 1200 else 1)
+delta_mb = _peak_mb(_hwm_ok) - base_mb
+print("BASE_MB", base_mb, "DELTA_MB", delta_mb,
+      "(VmHWM)" if _hwm_ok else "(ru_maxrss)")
+# Legit construct cost: bins (100k x 2000 uint8) = 200 MB plus per-column
+# working buffers; 900 MB of headroom still sits far below the ~1.6 GB
+# the dense-f64 copy would add on top.
+sys.exit(0 if delta_mb < 900 else 1)
 """
     r = subprocess.run([sys.executable, "-u", "-c", code],
                        capture_output=True, text=True, timeout=600,

@@ -60,7 +60,11 @@ def _assert_identical(b1, b4, scores_exact=True):
     {"feature_fraction": 0.6},                             # device col mask
     {"bagging_fraction": 0.8, "bagging_freq": 1,
      "feature_fraction": 0.7},                             # both dynamic
-], ids=["binary", "bagging", "feature_fraction", "bagging+ff"])
+    {"data_sample_strategy": "goss"},                      # in-trace GOSS
+    {"cegb_tradeoff": 0.5, "cegb_penalty_split": 0.02,
+     "cegb_penalty_feature_coupled": [2.0] * 8},           # in-trace CEGB
+], ids=["binary", "bagging", "feature_fraction", "bagging+ff", "goss",
+        "cegb"])
 def test_pack_bitwise_identical_binary(extra):
     _assert_identical(_train(extra, 1), _train(extra, 4))
 
@@ -132,8 +136,23 @@ def test_pack_degrades_for_host_paths():
     """Configs that need the host every round must degrade to the per-round
     path (with a warning), not crash or silently change semantics."""
     X, y = _data()
-    gbdt = lgb.train(dict(BASE, tpu_iter_pack=4,
+    # GOSS packs by default (the tpu_device_goss auto/on in-trace mask);
+    # only the host-RNG sampler (off) pins the per-round loop.
+    gdev = lgb.train(dict(BASE, tpu_iter_pack=4,
                           data_sample_strategy="goss"),
+                     lgb.Dataset(X, label=y), 5)._gbdt
+    assert gdev.iter_pack_degrade_reason() is None
+    # CEGB packs too: the first-use used vector is device state carried
+    # through the scan
+    gcegb = lgb.train(dict(BASE, tpu_iter_pack=4, cegb_tradeoff=0.5,
+                           cegb_penalty_split=0.02,
+                           cegb_penalty_feature_coupled=[2.0] * 8),
+                      lgb.Dataset(X, label=y), 5)._gbdt
+    assert gcegb.iter_pack_degrade_reason() is None
+    assert gcegb.iter_pack_plan(4) == (4, True)
+    gbdt = lgb.train(dict(BASE, tpu_iter_pack=4,
+                          data_sample_strategy="goss",
+                          tpu_device_goss="off"),
                      lgb.Dataset(X, label=y), 5)._gbdt
     assert gbdt.iter_pack_degrade_reason() is not None
     assert gbdt.iter_pack_plan(5) == (1, False)

@@ -1,22 +1,41 @@
-"""tools/profile_iter.py non-fused dispatch census (ISSUE-4 satellite):
-the GOSS / CEGB / linear_tree fallbacks (``gbdt.train_one_iter``
-``used_fused=False``) must report MORE compiled-program dispatches per
-boosting iteration than the fused hot path (1.0) — the measured fused-path
-coverage gap, visible in profiles instead of silent."""
+"""tools/profile_iter.py dispatch census (ISSUE-4 satellite, re-pinned by
+ISSUE-5): GOSS (device, the ``tpu_device_goss`` auto default) and CEGB now
+ride the fused ONE-dispatch iteration — the census must report exactly 1.0
+compiled-program dispatches per boosting round for them, same as the plain
+fused hot path.  The remaining ``used_fused=False`` paths are the host
+GOSS sampler (``tpu_device_goss=off``, kept for host-RNG replay) and
+linear trees — whose leaf models solve in ONE batched device dispatch, so
+their host-sync count is a constant independent of the leaf count (zero
+per-leaf syncs in the solve)."""
 
 from tools.profile_iter import nonfused_dispatch_census
 
 
-def test_nonfused_census_shapes_and_gap():
+def test_census_fused_paths_one_dispatch():
     blobs = {b["path"]: b for b in
              nonfused_dispatch_census(rows=4096, iters=3, num_leaves=15)}
-    assert set(blobs) == {"fused", "goss", "cegb", "linear_tree"}
-    assert blobs["fused"]["used_fused"] is True
-    assert blobs["fused"]["dispatches_per_iter"] == 1.0
-    for path in ("goss", "cegb", "linear_tree"):
-        assert blobs[path]["used_fused"] is False
-        assert blobs[path]["dispatches_per_iter"] > 1.0, blobs[path]
-    # linear_tree does host leaf solves: its per-iteration host syncs are
-    # the worst of the family — the census must expose that, not hide it
-    assert (blobs["linear_tree"]["host_syncs_per_iter"]
-            > blobs["fused"]["host_syncs_per_iter"])
+    assert set(blobs) == {"fused", "goss", "goss_host", "cegb",
+                          "linear_tree"}
+    for path in ("fused", "goss", "cegb"):
+        assert blobs[path]["used_fused"] is True, blobs[path]
+        assert blobs[path]["dispatches_per_iter"] == 1.0, blobs[path]
+    # tpu_device_goss=off replays the reference's host sampler: extra
+    # dispatches (gradients + grower) plus the gradient pull to the host.
+    assert blobs["goss_host"]["used_fused"] is False
+    assert blobs["goss_host"]["dispatches_per_iter"] > 1.0
+    assert (blobs["goss_host"]["host_syncs_per_iter"]
+            > blobs["goss"]["host_syncs_per_iter"])
+
+
+def test_census_linear_solve_no_per_leaf_syncs():
+    """The batched linear-leaf solve: host syncs per iteration must NOT
+    scale with num_leaves (the per-leaf Python solve loop pulled 6 arrays
+    per leaf batch; the batched op does one constant-size readback)."""
+    lo, hi = (nonfused_dispatch_census(rows=4096, iters=3, num_leaves=nl,
+                                       paths=("linear_tree",))[0]
+              for nl in (7, 31))
+    assert lo["used_fused"] is False and hi["used_fused"] is False
+    assert hi["host_syncs_per_iter"] == lo["host_syncs_per_iter"], (lo, hi)
+    # one grower + one gradient + one batched-solve program per round —
+    # nothing per-leaf
+    assert hi["dispatches_per_iter"] <= 4.0, hi

@@ -62,13 +62,18 @@ class TestDiscretize:
         np.testing.assert_allclose(hq[1000:].mean() * float(hs), 0.21, rtol=0.1)
 
     def test_deterministic_rounding(self):
+        # Reference scales (gradient_discretizer.cpp): delta_g =
+        # max|g|/(B/2), delta_h = max h/B — at B=4, g levels span -2..2
+        # and the max hessian lands on level B, not B-1.
         g = jnp.asarray([0.6, -0.6, 0.2], jnp.float32)
         h = jnp.asarray([0.5, 0.25, 1.0], jnp.float32)
         gs, hs = gradient_scales(g, h, 4)
+        np.testing.assert_allclose(float(gs), 0.3, rtol=1e-6)
+        np.testing.assert_allclose(float(hs), 0.25, rtol=1e-6)
         gq, hq = discretize_gradients(g, h, gs, hs, jax.random.PRNGKey(0),
                                       stochastic=False)
-        np.testing.assert_array_equal(np.asarray(gq), [1, -1, 0])
-        assert np.asarray(hq)[2] == 3  # max hess -> top level
+        np.testing.assert_array_equal(np.asarray(gq), [2, -2, 1])
+        assert np.asarray(hq)[2] == 4  # max hess -> top level (B)
 
 
 class TestQuantizedTraining:

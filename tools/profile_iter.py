@@ -57,11 +57,15 @@ def _count_dispatches_and_syncs(bst, iters):
         setattr(obj, name, counting)
         wrapped.append((obj, name, fn))
 
+    import lightgbm_tpu.ops.linear as linear_ops_mod
+
     for name in _DISPATCH_ATTRS:
         wrap(gbdt, name)
-    for name in ("_add_leaf_outputs", "_scale_tree_arrays"):
+    for name in ("_add_leaf_outputs", "_scale_tree_arrays",
+                 "_mark_features_used"):
         wrap(gbdt_mod, name)
     wrap(sampling_mod, "goss_mask_device")
+    wrap(linear_ops_mod, "fit_linear_leaves_device")
     orig_get = jax.device_get
 
     def counting_get(x):
@@ -79,12 +83,29 @@ def _count_dispatches_and_syncs(bst, iters):
     return counts["dispatch"], counts["sync"]
 
 
-def nonfused_dispatch_census(rows=8192, iters=4, num_leaves=31):
-    """Per-iteration dispatch/host-sync counts for the bench config's fused
-    hot path AND the three non-fused fallbacks (GOSS, CEGB, linear_tree —
-    ``gbdt.train_one_iter`` ``used_fused=False``).  Returns one blob per
-    path so the fused-path coverage gap is a measured number in profiles
-    instead of a silent branch."""
+_CENSUS_PATHS = (
+    ("fused", {}),
+    ("goss", {"data_sample_strategy": "goss"}),
+    ("goss_host", {"data_sample_strategy": "goss",
+                   "tpu_device_goss": "off"}),
+    ("cegb", {"cegb_penalty_split": 0.1,
+              "cegb_penalty_feature_coupled": [1.0] * 8}),
+    ("linear_tree", {"linear_tree": True}),
+)
+
+
+def nonfused_dispatch_census(rows=8192, iters=4, num_leaves=31,
+                             paths=None):
+    """Per-iteration dispatch/host-sync counts for the bench config's hot
+    path and the sampling/penalty variants.  Since ISSUE-5, GOSS
+    (tpu_device_goss auto/on) and CEGB ride the fused ONE-dispatch
+    iteration (``used_fused=True``, 1.0 dispatches/iter); the remaining
+    ``used_fused=False`` fallbacks are the host GOSS sampler
+    (tpu_device_goss=off) and linear trees — whose leaf models now solve
+    in one batched device dispatch, so their host-sync count is a small
+    CONSTANT independent of num_leaves (0 per-leaf syncs; run this
+    census at two leaf counts to witness it).  Returns one blob per
+    path."""
     import numpy as np
 
     import lightgbm_tpu as lgb
@@ -94,14 +115,10 @@ def nonfused_dispatch_census(rows=8192, iters=4, num_leaves=31):
     y = (X[:, 0] + X[:, 1] * X[:, 2] > 0).astype(np.float64)
     base = {"objective": "binary", "num_leaves": num_leaves,
             "metric": "none", "verbosity": -1}
-    paths = [
-        ("fused", {}),
-        ("goss", {"data_sample_strategy": "goss"}),
-        ("cegb", {"cegb_penalty_split": 0.1}),
-        ("linear_tree", {"linear_tree": True}),
-    ]
     out = []
-    for name, extra in paths:
+    for name, extra in _CENSUS_PATHS:
+        if paths is not None and name not in paths:
+            continue
         ds = lgb.Dataset(X, label=y)
         bst = lgb.Booster(params=dict(base, **extra), train_set=ds)
         g = bst._gbdt
@@ -109,6 +126,7 @@ def nonfused_dispatch_census(rows=8192, iters=4, num_leaves=31):
         out.append({
             "path": name,
             "used_fused": g.fused_path_active,
+            "num_leaves": num_leaves,
             "dispatches_per_iter": round(dispatches / iters, 2),
             "host_syncs_per_iter": round(syncs / iters, 2),
         })
