@@ -326,10 +326,49 @@ def _cache_drop(path):
         pass
 
 
+def _load_watchdog():
+    """Load resilience/watchdog.py by FILE PATH, not package import: the
+    outer bench process must never import lightgbm_tpu (whose package
+    __init__ pulls in jax — the very thing that hangs on a wedged plugin);
+    the watchdog module is stdlib-only at module level for this reason."""
+    import importlib.util as ilu
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "lightgbm_tpu", "resilience", "watchdog.py")
+    spec = ilu.spec_from_file_location("lightgbm_tpu_watchdog_standalone",
+                                      path)
+    mod = ilu.module_from_spec(spec)
+    # register BEFORE exec: the module's @dataclass decorators resolve
+    # their defining module through sys.modules on py3.10+
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _probe_block(platform, n_dev, init_s):
+    """The ``probe`` block every BENCH json carries (ROADMAP 3b: a wedged
+    plugin silently degraded rounds r03-r05 to the CPU proxy and the blobs
+    could not say so).  The outer watchdog's subprocess verdict rides in
+    via ``_BENCH_PROBE``; a directly-invoked inner run synthesizes the
+    block from its own backend init."""
+    raw = os.environ.get("_BENCH_PROBE")
+    if raw:
+        try:
+            return json.loads(raw)
+        except ValueError:
+            pass
+    # build through ProbeResult.as_dict() so both invocation paths emit
+    # the SAME schema (the outer watchdog's block and this synthesized one)
+    return _load_watchdog().ProbeResult(
+        verdict="live", backend=platform, devices=n_dev, latency_s=init_s,
+        budget_s=BACKEND_PROBE_TIMEOUT).as_dict()
+
+
 def _probe_backend():
     """Initialize the jax backend in a side thread so a wedged accelerator
-    plugin fails fast instead of blocking forever.  Returns platform name."""
+    plugin fails fast instead of blocking forever.  Returns
+    ``(platform, devices, init_seconds)``."""
     result = {}
+    t0 = time.time()
 
     def probe():
         try:
@@ -356,7 +395,13 @@ def _probe_backend():
             f"(accelerator plugin wedged)")
     if "error" in result:
         raise RuntimeError(f"jax backend init failed: {result['error']}")
-    return result["platform"], result["n"]
+    if os.environ.get("_BENCH_FORCE_CPU") == "1" \
+            and result["platform"] != "cpu":
+        # honesty guard: a forced-CPU fallback rung must never report an
+        # accelerator label (the mis-reporting ROADMAP 3b calls out)
+        raise RuntimeError(
+            f"forced-CPU rung resolved backend {result['platform']!r}")
+    return result["platform"], result["n"], time.time() - t0
 
 
 def _timed_train(bst, iters, pack, jax):
@@ -392,7 +437,8 @@ def _timed_train(bst, iters, pack, jax):
 
 
 def run_bench(rows, iters):
-    platform, n_dev = _probe_backend()
+    platform, n_dev, init_s = _probe_backend()
+    probe_block = _probe_block(platform, n_dev, init_s)
 
     import jax
 
@@ -479,6 +525,11 @@ def run_bench(rows, iters):
                 "histogram_impl": _resolve_impl(
                     bst._gbdt.grower_cfg.histogram_impl, platform),
                 "platform": platform, "devices": n_dev,
+                # Watchdog verdict (resilience/watchdog.py): backend, probe
+                # verdict and probe latency — so a CPU-fallback number can
+                # never be mistaken for a TPU number again (ROADMAP 3b).
+                "probe": probe_block,
+                "cpu_fallback": platform == "cpu",
                 # Iteration packing: training dispatches per boosting round
                 # (1.0 = per-round loop; 1/K with K-round packs — the
                 # host-sync elimination the pack path is for).
@@ -661,6 +712,20 @@ def main():
 
     import _hermetic
     cpu_env = _hermetic.cpu_env(1)
+
+    # Budgeted watchdog probe (resilience/watchdog.py) BEFORE committing to
+    # the accelerator: a wedged verdict skips the accelerator ladder rungs
+    # entirely (each would burn ATTEMPT_TIMEOUT seconds re-discovering the
+    # hang) and the verdict lands in every emitted JSON via _BENCH_PROBE.
+    watchdog = _load_watchdog()
+    probe = watchdog.probe_backend(timeout=BACKEND_PROBE_TIMEOUT)
+    probe_dict = probe.as_dict()
+    os.environ["_BENCH_PROBE"] = json.dumps(probe_dict)
+    print(f"bench: watchdog probe verdict={probe.verdict} "
+          f"backend={probe.backend} latency={probe.latency_s:.1f}s",
+          file=sys.stderr)
+    sys.stderr.flush()
+
     attempts = [
         ("accelerator", {}, ROWS, ITERS),
         ("accelerator-retry", {}, ROWS, ITERS),
@@ -698,6 +763,18 @@ def main():
         attempts_log["relay_tcp_8082"] = {
             "elapsed_s": 0.0, "ok": False, "wedged": False,
             "error": f"unreachable ({e})"}
+    if probe.verdict == "wedged":
+        # Only a WEDGED verdict skips the accelerator ladder (each rung
+        # would hang for ATTEMPT_TIMEOUT re-discovering it); an "error"
+        # verdict can be transient (e.g. the lease held at probe time,
+        # freed before the retry rung's sleep), so those rungs still run
+        # and surface the real failure themselves.
+        attempts_log["probe"] = {
+            "elapsed_s": round(probe.latency_s, 1), "ok": False,
+            "wedged": True,
+            "error": (probe.error or probe.verdict)[:500]}
+        saw_wedge = True
+        attempts = [a for a in attempts if not a[0].startswith("accelerator")]
     prev_wedged = False
     for name, env_extra, rows, iters in attempts:
         if name.startswith("accelerator-retry") and prev_wedged:
@@ -737,7 +814,8 @@ def main():
         "unit": "rows*iters/s",
         "vs_baseline": 0.0,
         "detail": {"error": "all bench attempts failed",
-                   "backend_wedged": saw_wedge, "attempts": attempts_log},
+                   "backend_wedged": saw_wedge, "probe": probe_dict,
+                   "attempts": attempts_log},
     })
     _record(fail_line, errors)
     print(fail_line)

@@ -86,6 +86,7 @@ def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
     cmp_op: List[Callable] = []
     enabled = [True]
     first_metric = [""]
+    inited = [False]
 
     def _init(env: CallbackEnv) -> None:
         enabled[0] = bool(env.evaluation_result_list)
@@ -105,7 +106,11 @@ def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
                 cmp_op.append(lambda new, best: new < best - min_delta)
 
     def _callback(env: CallbackEnv) -> None:
-        if env.iteration == env.begin_iteration:
+        # init at the run's first round, OR on this callback's first firing
+        # — a resumed run (engine.train resume_from=) starts mid-stream
+        # with begin_iteration still 0, so the first-firing arm covers it
+        if env.iteration == env.begin_iteration or not inited[0]:
+            inited[0] = True
             _init(env)
         if not enabled[0]:
             return

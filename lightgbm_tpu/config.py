@@ -228,6 +228,27 @@ _PARAMS: List[Tuple[str, Any, Any, Tuple[str, ...], Optional[Tuple[Any, Any]]]] 
     # device.  The LIGHTGBM_TPU_NATIVE_PREDICT_MAX_ROWS env var, where
     # set, overrides this knob.
     ("tpu_native_predict_max_rows", int, 262144, (), (0, None)),
+    # ---- Resilience / fault tolerance (docs/ROBUSTNESS.md) ----
+    # Atomic training snapshots (resilience/checkpoint.py) every N
+    # committed boosting rounds, emitted at iter-pack commit boundaries;
+    # 0 disables.  Resume via engine.train(..., resume_from=...) is
+    # bitwise-identical to the uninterrupted run.
+    ("checkpoint_interval", int, 0, ("ckpt_interval",), (0, None)),
+    # Snapshot directory; "" derives "<output_model>.ckpt".
+    ("checkpoint_dir", str, "", ("ckpt_dir",), None),
+    # Snapshot generations retained (older ones are the corruption
+    # fallback chain).
+    ("checkpoint_keep", int, 2, (), (1, None)),
+    # Hard wall-clock budget (seconds) for the backend watchdog's
+    # subprocess probe (resilience/watchdog.py): compile + tiny dispatch
+    # must answer within it or the backend is classified wedged.
+    ("tpu_probe_timeout", float, 60.0, (), (0.0, None)),
+    # Serve admission control (serve/predictor.py MicroBatcher): queued
+    # requests beyond this are shed with ServeOverloadError; 0 = unbounded.
+    ("serve_max_queue", int, 0, (), (0, None)),
+    # Per-request serving deadline: requests still queued past it are
+    # failed with ServeDeadlineError instead of dispatched late; 0 = none.
+    ("serve_deadline_ms", float, 0.0, (), (0.0, None)),
 ]
 
 _CANONICAL: Dict[str, Tuple[str, Any, Any, Optional[Tuple[Any, Any]]]] = {}

@@ -14,6 +14,7 @@ import numpy as np
 from . import callback as callback_mod
 from .basic import Booster, Dataset
 from .callback import CallbackEnv, EarlyStopException
+from .resilience import faults
 
 
 def train(
@@ -26,8 +27,21 @@ def train(
     init_model: Optional[Union[str, Booster]] = None,
     keep_training_booster: bool = False,
     callbacks: Optional[List[Callable]] = None,
+    resume_from: Optional[str] = None,
 ) -> Booster:
-    """Train a booster (reference ``engine.train``)."""
+    """Train a booster (reference ``engine.train``).
+
+    ``resume_from`` continues training from a resilience checkpoint (a
+    snapshot file or a checkpoint directory — the newest valid generation
+    wins); the resumed run's trees are bitwise-identical to the
+    uninterrupted run's (docs/ROBUSTNESS.md).  ``checkpoint_interval`` in
+    ``params`` emits such snapshots every N committed rounds, at iter-pack
+    commit boundaries."""
+    # Backend watchdog preflight (opt-in LIGHTGBM_TPU_WATCHDOG=1): classify
+    # a wedged accelerator in a budgeted subprocess BEFORE this process
+    # touches the device — a clear error instead of an indefinite hang.
+    from .resilience.watchdog import preflight
+    preflight(params)
     # Callable objective (reference: params["objective"] may be a function
     # (grad, hess) = fobj(preds, train_data) since lightgbm 4.x).
     fobj = None
@@ -166,22 +180,80 @@ def train(
     # Booster.predict's num_iteration slicing keeps the full base ensemble.
     n_base = base.iter_ if base is not None else 0
 
+    # Checkpoint/resume (docs/ROBUSTNESS.md).  Snapshots are emitted only
+    # at iter-pack commit boundaries — mid-pack, scores already include
+    # uncommitted rounds — so with packing the interval is a floor: the
+    # snapshot lands at the first boundary at/after each interval multiple.
+    start_it = 0
+    # Per-round eval history, recorded while checkpointing (and carried in
+    # every snapshot): after-callback closure state — early_stopping's
+    # best/wait counters, record_evaluation's dict — is DERIVED from these
+    # values, so a resumed run replays them below instead of trying to
+    # pickle user callback closures.
+    booster._ckpt_eval_history = []
+    if resume_from is not None:
+        from .resilience import checkpoint as checkpoint_mod
+        start_it = checkpoint_mod.restore(booster, resume_from)
+        try:
+            for it_h, evals_h in booster._ckpt_eval_history:
+                if it_h >= start_it:
+                    continue
+                for cb in cbs_after:
+                    cb(CallbackEnv(booster, params, it_h, 0,
+                                   num_boost_round, evals_h))
+        except EarlyStopException as e:
+            # cannot fire for rounds the original run trained past (a
+            # stop breaks the loop before the next snapshot), but handle
+            # it exactly as _fire_after would, defensively
+            booster.best_iteration = e.best_iteration + 1 + n_base
+            booster.best_score = e.best_score
+            return booster
+    ckpt_interval = booster.cfg.checkpoint_interval
+    if ckpt_interval > 0 and not booster._gbdt._supports_checkpoint:
+        from .utils.log import Log
+        Log.warning(
+            f"checkpoint_interval is ignored for boosting="
+            f"{booster.cfg.boosting}: per-round host state is not captured")
+        ckpt_interval = 0
+    ckpt_dir = booster.cfg.checkpoint_dir or f"{snapshot_base}.ckpt"
+    last_ckpt = [start_it]
+
+    def _maybe_checkpoint(done_it: int) -> None:
+        if ckpt_interval <= 0 \
+                or done_it // ckpt_interval <= last_ckpt[0] // ckpt_interval:
+            return
+        from .resilience import checkpoint as checkpoint_mod
+        checkpoint_mod.save_snapshot(booster, ckpt_dir,
+                                     keep=booster.cfg.checkpoint_keep)
+        last_ckpt[0] = done_it
+
     def _fire_after(it: int) -> bool:
         """Eval + after-callbacks for round ``it``; True = early stop."""
         if not _round_needs_eval(it):
             return False
         evals = booster._evals(feval)
+        # no after-callbacks -> nothing to replay on resume: skip the
+        # history (each snapshot re-pickles the whole list, so for long
+        # runs this is the difference between O(1) and O(rounds) extra
+        # bytes per generation)
+        if ckpt_interval > 0 and cbs_after:
+            booster._ckpt_eval_history.append((it, evals))
         try:
             for cb in cbs_after:
-                cb(CallbackEnv(booster, params, it, 0, num_boost_round,
-                               evals))
+                # begin_iteration stays 0 on resume: callbacks see the same
+                # absolute (iteration, begin, end) stream as the
+                # uninterrupted run, so reset_parameter schedules index the
+                # same values and the bitwise-resume contract holds
+                # (early_stopping self-initializes on its first firing).
+                cb(CallbackEnv(booster, params, it, 0,
+                               num_boost_round, evals))
         except EarlyStopException as e:
             booster.best_iteration = e.best_iteration + 1 + n_base
             booster.best_score = e.best_score
             return True
         return False
 
-    it = 0
+    it = start_it
     while it < num_boost_round:
         if use_pack:
             rounds, finished = booster._gbdt.train_pack(
@@ -197,6 +269,9 @@ def train(
                     # iteration).
                     booster._gbdt.commit_round(rnd)
                     committed += 1
+                    # fault seam: a mid-training SIGKILL lands right after
+                    # a commit, the worst legal place for a crash
+                    faults.maybe_kill(it + j + 1)
                     if _fire_after(it + j):
                         stopped = True
                         break
@@ -210,17 +285,20 @@ def train(
             it += committed
             if stopped or finished:
                 break
+            _maybe_checkpoint(it)
         else:
             for cb in cbs_before:
-                cb(CallbackEnv(booster, params, it, 0, num_boost_round,
-                               None))
+                cb(CallbackEnv(booster, params, it, 0,
+                               num_boost_round, None))
             finished = booster.update(fobj=fobj)
+            faults.maybe_kill(it + 1)
             if snapshot_freq > 0 and (it + 1) % snapshot_freq == 0:
                 booster.save_model(f"{snapshot_base}.snapshot_iter_{it + 1}")
             stopped = _fire_after(it)
             it += 1
             if stopped or finished:
                 break
+            _maybe_checkpoint(it)
     return booster
 
 

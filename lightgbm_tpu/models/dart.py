@@ -20,6 +20,7 @@ from .tree import predict_tree_bins_device
 class DART(GBDT):
     _deterministic_iters = False   # drop/renorm mutates scores between iters
     _supports_iter_pack = False    # per-round host drop/renorm decisions
+    _supports_checkpoint = False   # drop bookkeeping/drop_rng not captured
 
     def __init__(self, cfg, train, valids=(), base_model=None):
         super().__init__(cfg, train, valids, base_model=base_model)

@@ -213,6 +213,22 @@ class GBDT:
                     f"{pname} has no effect on the TPU build (XLA/the jax "
                     "backend owns threading, histogram memory and device "
                     "selection)")
+        # Clear degrade warning (resilience/watchdog.py): an EXPLICITLY
+        # requested accelerator that resolved to the cpu backend means the
+        # plugin was absent or bypassed — say so instead of silently
+        # training a CPU proxy (ROADMAP 3b: bench rounds mis-read exactly
+        # this way).  Checked here because the backend is initialized
+        # either way by the uploads below; the no-hang pre-check is the
+        # budgeted subprocess probe (LIGHTGBM_TPU_WATCHDOG=1).
+        if (str(cfg.raw_params.get("device_type",
+                                   cfg.raw_params.get("device", ""))
+                ).lower() in ("tpu", "gpu", "cuda")
+                and jax.default_backend() == "cpu"):
+            Log.warning(
+                f"device_type={cfg.device_type} requested but the live jax "
+                "backend is 'cpu': training DEGRADES to the CPU fallback "
+                "(probe the accelerator with python -m "
+                "lightgbm_tpu.resilience.watchdog)")
         from ..parallel.mesh import DATA_AXIS, FEATURE_AXIS
         # Data-only meshes use the sharded permutation layout (shard_map:
         # per-shard pallas histograms + one psum per wave).  Feature-only
@@ -1006,6 +1022,102 @@ class GBDT:
         if self._pack_used_pending:
             self._cegb_used_dev = self._pack_used_pending.pop(0)
         self.iter_ += 1
+
+    # ------------------------------------------------------------ checkpointing
+    # DART (host drop/renorm bookkeeping) and RF (averaged scores) carry
+    # per-round host state outside the captured set; they opt out until a
+    # subclass capture exists (docs/ROBUSTNESS.md).
+    _supports_checkpoint = True
+
+    def capture_train_state(self) -> dict:
+        """Everything the boosting loop mutates, pulled to the host in ONE
+        batched transfer — the payload resilience/checkpoint.py frames and
+        publishes atomically.  Only valid at an iter-pack commit boundary:
+        mid-pack, ``scores`` already include uncommitted rounds and a
+        snapshot would resume into a diverged stream."""
+        if not self._supports_checkpoint:
+            raise NotImplementedError(
+                f"checkpoint/resume is not supported for "
+                f"boosting={self.cfg.boosting} (per-round host state is "
+                "not captured); train without checkpoint_interval")
+        if self._pack_used_pending:
+            raise RuntimeError(
+                "capture_train_state called mid-pack (uncommitted rounds "
+                "pending); snapshots are only sound at iter-pack commit "
+                "boundaries")
+        dev = {
+            "scores": self.scores,
+            "valid_scores": list(self.valid_scores),
+            "models": [list(cls) for cls in self.dev_models],
+        }
+        if self._use_cegb:
+            dev["cegb_used"] = self._cegb_used_dev
+        host = jax.device_get(dev)
+        host.setdefault("cegb_used", None)
+        return {
+            "iter_": int(self.iter_),
+            **host,
+            # linear trees live in HOST mirrors (leaf models never go to
+            # the device); everything else re-materializes lazily.
+            "host_cache": (self._host_cache if self.cfg.linear_tree
+                           else None),
+            "sample_rng": self.sample_strategy.rng.get_state(),
+            "bag_cached": (None if self.sample_strategy._cached is None
+                           else np.asarray(self.sample_strategy._cached)),
+            "feature_rng": self.feature_sampler.rng.get_state(),
+            "linear_nls": [int(x) for x in jax.device_get(self._linear_nls)],
+            "nls_pending": (None if self._nls_pending is None else
+                            [int(x)
+                             for x in jax.device_get(self._nls_pending)]),
+            "pred_version": int(self._pred_version),
+            "objective": (self.objective.mutable_state()
+                          if self.objective is not None else None),
+        }
+
+    def restore_train_state(self, state: dict) -> None:
+        """Inverse of :meth:`capture_train_state` onto a freshly-built
+        booster over the SAME dataset and config — the device RNG keys are
+        seed-derived and key-folded by absolute iteration, so restoring
+        the host-side state here is sufficient for bitwise continuation."""
+        if not self._supports_checkpoint:
+            raise NotImplementedError(
+                f"checkpoint/resume is not supported for "
+                f"boosting={self.cfg.boosting}")
+        if len(state["models"]) != self.num_class:
+            raise ValueError(
+                f"checkpoint has {len(state['models'])} model classes, "
+                f"booster has {self.num_class}")
+        if tuple(state["scores"].shape) != tuple(self.scores.shape):
+            raise ValueError(
+                f"checkpoint scores shape {state['scores'].shape} != "
+                f"{self.scores.shape}: the snapshot was taken on a "
+                "different dataset")
+        if len(state["valid_scores"]) != len(self.valid_scores):
+            raise ValueError(
+                f"checkpoint carries {len(state['valid_scores'])} valid "
+                f"sets, booster has {len(self.valid_scores)}")
+        self.scores = jnp.asarray(state["scores"])
+        self.valid_scores = [jnp.asarray(v) for v in state["valid_scores"]]
+        self.dev_models = [[jax.tree.map(jnp.asarray, a) for a in cls]
+                           for cls in state["models"]]
+        if state.get("host_cache") is not None:
+            self._host_cache = [list(c) for c in state["host_cache"]]
+        else:
+            self._host_cache = [[None] * len(cls) for cls in self.dev_models]
+        if self._use_cegb and state.get("cegb_used") is not None:
+            self._cegb_used_dev = jnp.asarray(state["cegb_used"])
+        self._pack_used_pending = []
+        self.iter_ = int(state["iter_"])
+        self.sample_strategy.rng.set_state(state["sample_rng"])
+        self.sample_strategy._cached = state["bag_cached"]
+        self._bag_mask_dev = (None if state["bag_cached"] is None
+                              else jnp.asarray(state["bag_cached"]))
+        self.feature_sampler.rng.set_state(state["feature_rng"])
+        self._linear_nls = list(state["linear_nls"])
+        self._nls_pending = state["nls_pending"]
+        self._pred_version = int(state["pred_version"])
+        if self.objective is not None and state.get("objective"):
+            self.objective.set_mutable_state(state["objective"])
 
     def discard_rounds(self, rounds) -> None:
         """Drop uncommitted pack rounds (mid-pack early stop): their trees

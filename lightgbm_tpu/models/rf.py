@@ -17,6 +17,7 @@ from .tree import predict_tree_bins_device
 
 class RandomForest(GBDT):
     _supports_iter_pack = False    # averaged scores, per-round host bagging
+    _supports_checkpoint = False   # running-average score state not captured
 
     def __init__(self, cfg, train, valids=(), base_model=None):
         if not (cfg.bagging_freq > 0 and (cfg.bagging_fraction < 1.0

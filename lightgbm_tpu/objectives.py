@@ -52,6 +52,16 @@ class ObjectiveFunction:
             return grad, hess
         return grad * self.weight, hess * self.weight
 
+    def mutable_state(self) -> dict:
+        """Iteration-mutable objective state for checkpoint/resume
+        (resilience/checkpoint.py) — e.g. lambdarank's position-bias
+        vector, xendcg's advancing PRNG key.  Stateless objectives (the
+        default) return {}; overrides must return host (numpy) values."""
+        return {}
+
+    def set_mutable_state(self, state: dict) -> None:
+        """Restore what :meth:`mutable_state` captured (no-op default)."""
+
     def get_gradients(self, score: Array) -> Tuple[Array, Array]:
         raise NotImplementedError
 

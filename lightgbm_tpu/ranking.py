@@ -187,6 +187,18 @@ class LambdaRankNDCG(ObjectiveFunction):
                 jnp.abs(sd) + 0.001)
         return grad, hess
 
+    def mutable_state(self) -> dict:
+        # the position-bias vector advances every iteration; a resume that
+        # reset it would re-learn the bias and diverge from the
+        # uninterrupted run's trees
+        if self.pos_ids is None:
+            return {}
+        return {"pos_bias": np.asarray(jax.device_get(self.pos_bias))}
+
+    def set_mutable_state(self, state: dict) -> None:
+        if self.pos_ids is not None and "pos_bias" in state:
+            self.pos_bias = jnp.asarray(state["pos_bias"])
+
 
 class RankXENDCG(ObjectiveFunction):
     """Listwise XE-NDCG (reference ``RankXENDCG``): per-query softmax cross
@@ -220,6 +232,15 @@ class RankXENDCG(ObjectiveFunction):
         grad, hess = _xendcg_grads(score, gammas, self.doc_idx, self.valid,
                                    self.phi_base)
         return grad, hess
+
+    def mutable_state(self) -> dict:
+        # the gamma stream splits off this key each iteration; resume must
+        # continue the SAME stream, not restart it at objective_seed
+        return {"key": np.asarray(jax.device_get(self.key))}
+
+    def set_mutable_state(self, state: dict) -> None:
+        if "key" in state:
+            self.key = jnp.asarray(state["key"])
 
 
 @jax.jit

@@ -1,0 +1,21 @@
+"""Cross-cutting resilience layer (docs/ROBUSTNESS.md).
+
+Four pieces, each its own module so they can be imported independently
+(bench.py's outer watchdog process loads :mod:`watchdog` by file path and
+must not drag the package — and therefore jax — in):
+
+- :mod:`checkpoint` — atomic write-temp-fsync-rename training snapshots
+  (Booster model + trainer state), emitted at iter-pack commit boundaries,
+  with checksum validation and older-generation fallback on corruption.
+- :mod:`watchdog` — budgeted subprocess probes that classify a backend as
+  live/wedged/error BEFORE committing to it (a wedged accelerator plugin
+  hangs indefinitely inside backend init; the probe never can).
+- :mod:`faults` — the deterministic fault-injection seam
+  (``LIGHTGBM_TPU_FAULTS=wedge_dispatch:600,kill_after_iter:7,...``) the
+  recovery-path tests drive.
+- serve-side graceful degradation lives in :mod:`lightgbm_tpu.serve`
+  (bounded queue, deadlines, one-shot host fallback) and only consumes
+  the fault seam from here.
+"""
+
+from . import faults  # noqa: F401  (re-export: the seam is the public API)

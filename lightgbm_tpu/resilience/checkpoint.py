@@ -1,0 +1,159 @@
+"""Atomic checkpoint/resume for training (docs/ROBUSTNESS.md).
+
+A snapshot is everything the boosting loop mutates — the device ensemble,
+train/valid scores, objective state (lambdarank position bias, xendcg PRNG
+key), the host sampling RNG streams, the CEGB used-feature vector and the
+iteration/best-iteration counters — captured at an **iter-pack commit
+boundary** (no uncommitted pack rounds pending), so a resumed run replays
+the exact commit-and-replay sequence and produces trees **bitwise
+identical** to the uninterrupted run (pinned by tests/test_resilience.py).
+
+On disk a snapshot is one checksummed frame (serialization.write_atomic_frame:
+write-temp -> fsync -> rename -> fsync(dir)) named ``ckpt-<iter>.lgtck``;
+``keep`` generations are retained and the restore scan falls back to older
+generations when the newest fails validation (torn write, bitrot — or the
+``corrupt_ckpt:latest`` fault, which truncates it deliberately).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import re
+from typing import List, Optional, Tuple
+
+from ..serialization import FrameCorruptError, read_frame, write_atomic_frame
+from ..utils.log import Log
+from . import faults
+
+FORMAT_VERSION = 1
+SNAPSHOT_SUFFIX = ".lgtck"
+_NAME_RE = re.compile(r"^ckpt-(\d+)\.lgtck$")
+
+# Params a resumed run must agree on: a mismatch silently changes the
+# gradient/tree stream and the "bitwise identical" contract with it.
+_COMPAT_KEYS = ("objective", "boosting", "num_class", "seed", "num_leaves",
+                "learning_rate", "data_sample_strategy", "linear_tree",
+                "use_quantized_grad",
+                # sampling rates: the restored RNG streams draw masks at
+                # whatever rate the resumed config says — any drift here
+                # silently diverges the tree stream
+                "bagging_fraction", "bagging_freq", "feature_fraction",
+                "feature_fraction_bynode", "top_rate", "other_rate")
+
+
+def snapshot_path(ckpt_dir: str, iteration: int) -> str:
+    return os.path.join(ckpt_dir, f"ckpt-{int(iteration):08d}{SNAPSHOT_SUFFIX}")
+
+
+def list_snapshots(ckpt_dir: str) -> List[Tuple[int, str]]:
+    """``(iteration, path)`` pairs, newest first."""
+    if not os.path.isdir(ckpt_dir):
+        return []
+    out = []
+    for name in os.listdir(ckpt_dir):
+        m = _NAME_RE.match(name)
+        if m:
+            out.append((int(m.group(1)), os.path.join(ckpt_dir, name)))
+    return sorted(out, reverse=True)
+
+
+def save_snapshot(booster, ckpt_dir: str, keep: int = 2) -> str:
+    """Capture and atomically publish one snapshot; prunes generations
+    beyond ``keep`` (oldest first, AFTER the new one is durable)."""
+    state = booster._gbdt.capture_train_state()
+    meta = {
+        "format": FORMAT_VERSION,
+        "iteration": state["iter_"],
+        "best_iteration": int(getattr(booster, "best_iteration", -1)),
+        "best_score": getattr(booster, "best_score", {}),
+        # after-callback state (early_stopping counters, record_evaluation)
+        # is derived from the per-round evals: the engine replays these on
+        # resume instead of pickling callback closures
+        "eval_history": list(getattr(booster, "_ckpt_eval_history", [])),
+        "compat": {k: getattr(booster.cfg, k) for k in _COMPAT_KEYS},
+    }
+    payload = pickle.dumps({"meta": meta, "state": state}, protocol=4)
+    os.makedirs(ckpt_dir, exist_ok=True)
+    path = snapshot_path(ckpt_dir, state["iter_"])
+    write_atomic_frame(path, payload)
+    for _it, old in list_snapshots(ckpt_dir)[max(int(keep), 1):]:
+        try:
+            os.unlink(old)
+        except OSError:
+            pass
+    return path
+
+
+def load_latest(ckpt: str) -> Tuple[dict, str]:
+    """Load the newest VALID snapshot from a directory (or the one file
+    given).  Corrupt/truncated generations are detected by the frame
+    checksum, warned about, and skipped — the scan falls back to the next
+    older generation."""
+    if os.path.isdir(ckpt):
+        candidates = list_snapshots(ckpt)
+    elif os.path.exists(ckpt):
+        candidates = [(-1, ckpt)]
+    else:
+        candidates = []
+    if not candidates:
+        raise FileNotFoundError(f"no checkpoint snapshots under {ckpt!r}")
+    if faults.corrupt_latest_due():
+        # fault seam: tear the newest generation (truncate to half) so the
+        # detection + fallback path runs deterministically in tests
+        newest = candidates[0][1]
+        size = os.path.getsize(newest)
+        with open(newest, "r+b") as fh:
+            fh.truncate(max(size // 2, 1))
+    last_err: Optional[Exception] = None
+    for _it, path in candidates:
+        try:
+            blob = pickle.loads(read_frame(path))
+            if blob.get("meta", {}).get("format") != FORMAT_VERSION:
+                raise FrameCorruptError(
+                    f"{path}: unsupported checkpoint format "
+                    f"{blob.get('meta', {}).get('format')!r}")
+            return blob, path
+        except (FrameCorruptError, OSError, pickle.UnpicklingError,
+                EOFError) as e:
+            last_err = e
+            Log.warning(f"checkpoint {path} failed validation ({e}); "
+                        "falling back to the previous generation")
+    raise FrameCorruptError(
+        f"no valid checkpoint generation under {ckpt!r} "
+        f"(last error: {last_err})")
+
+
+def restore(booster, ckpt: str) -> int:
+    """Restore a booster's training state from ``ckpt`` (a snapshot file or
+    a checkpoint directory).  Returns the iteration training resumes AT
+    (== the number of committed rounds in the snapshot)."""
+    blob, path = load_latest(ckpt)
+    meta = blob["meta"]
+    for key, want in meta["compat"].items():
+        have = getattr(booster.cfg, key, None)
+        if have == want:
+            continue
+        if key == "learning_rate":
+            # learning_rate is legitimately mutated mid-run (the
+            # reset_parameter schedule callback), so the snapshot's
+            # boundary value IS training state: restore it instead of
+            # rejecting — a schedule's next before-iteration callback
+            # overwrites it exactly as the uninterrupted run would.
+            Log.warning(
+                f"resume: restoring learning_rate={want!r} from {path} "
+                f"(booster had {have!r}; bitwise-identical continuation "
+                "is the contract)")
+            booster.reset_parameter({"learning_rate": want})
+            continue
+        raise ValueError(
+            f"checkpoint {path} was trained with {key}={want!r} but this "
+            f"booster has {key}={have!r}; resume needs the same config "
+            "(bitwise-identical continuation is the contract)")
+    booster._gbdt.restore_train_state(blob["state"])
+    booster.best_iteration = meta.get("best_iteration", -1)
+    booster.best_score = meta.get("best_score", {})
+    booster._ckpt_eval_history = list(meta.get("eval_history", []))
+    it = int(meta["iteration"])
+    Log.info(f"resumed from {path} at iteration {it}")
+    return it

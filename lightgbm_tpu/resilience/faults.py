@@ -1,0 +1,131 @@
+"""Deterministic fault injection: the ONE seam the recovery-path tests drive.
+
+``LIGHTGBM_TPU_FAULTS`` is a comma-separated ``name:value`` list; each name
+is a specific seam a production failure enters through:
+
+- ``wedge_dispatch:<seconds>`` — a device dispatch hangs for ``seconds``
+  (default 3600).  Honored by the watchdog probe child (so a probe can be
+  tested to return "wedged" within its budget) and by the serve predictor's
+  device dispatch (so deadline handling can be exercised deterministically).
+- ``kill_after_iter:<n>`` — SIGKILL this process right after the ``n``-th
+  boosting round commits (1-based).  The checkpoint/resume tests use it to
+  simulate a mid-training crash that no ``finally:`` block can soften.
+- ``corrupt_ckpt:latest`` — physically truncate the newest checkpoint
+  generation once, before the restore scan validates it (a torn write).
+- ``serve_device_error:<n>`` — the ``n``-th serve device dispatch in this
+  process raises (default the 1st); drives the one-shot host-predict
+  fallback and its ServeMetrics counters.
+
+Tests can also :func:`install` a spec in-process instead of mutating the
+environment.  Unknown fault names warn once and are ignored — a typo must
+not silently disable the intended fault.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+import time
+from typing import Dict, Optional
+
+ENV_VAR = "LIGHTGBM_TPU_FAULTS"
+
+KNOWN_FAULTS = ("wedge_dispatch", "kill_after_iter", "corrupt_ckpt",
+                "serve_device_error")
+
+_lock = threading.Lock()
+_override: Optional[str] = None
+_counters: Dict[str, int] = {}
+_consumed: Dict[str, bool] = {}
+_warned: Dict[str, bool] = {}
+
+
+def install(spec_str: Optional[str]) -> None:
+    """Process-local override of the env spec (tests).  ``None`` removes the
+    override; installing always resets the per-process fire counters so a
+    test never inherits another test's ``serve_device_error`` count."""
+    global _override
+    with _lock:
+        _override = spec_str
+        _counters.clear()
+        _consumed.clear()
+
+
+def spec() -> Dict[str, str]:
+    """Parse the active fault spec (override first, else the env var) —
+    re-read every call so a seam keeps working after ``monkeypatch.setenv``."""
+    raw = _override if _override is not None else os.environ.get(ENV_VAR, "")
+    out: Dict[str, str] = {}
+    for part in raw.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, val = part.partition(":")
+        name = name.strip()
+        if name not in KNOWN_FAULTS:
+            with _lock:
+                first = not _warned.get(name)
+                _warned[name] = True
+            if first:
+                from ..utils.log import Log
+                Log.warning(
+                    f"unknown fault {name!r} in {ENV_VAR} ignored "
+                    f"(known: {', '.join(KNOWN_FAULTS)})")
+            continue
+        out[name] = val.strip()
+    return out
+
+
+def active(name: str) -> bool:
+    return name in spec()
+
+
+def wedge_seconds() -> Optional[float]:
+    val = spec().get("wedge_dispatch")
+    if val is None:
+        return None
+    return float(val) if val else 3600.0
+
+
+def maybe_wedge(seam: str = "dispatch") -> None:
+    """Block at a dispatch seam when ``wedge_dispatch`` is armed —
+    simulating the wedged-accelerator hang the watchdog budget exists
+    for.  ``seam`` only labels the sleep for debuggers."""
+    secs = wedge_seconds()
+    if secs is not None:
+        time.sleep(secs)
+
+
+def maybe_kill(iteration: int) -> None:
+    """SIGKILL the process when ``kill_after_iter`` matches ``iteration``
+    (the count of COMMITTED boosting rounds, 1-based) — an unsoftenable
+    crash, exactly what a preempted host delivers."""
+    val = spec().get("kill_after_iter")
+    if val is not None and int(val) == int(iteration):
+        os.kill(os.getpid(), signal.SIGKILL)
+
+
+def serve_error_due() -> bool:
+    """True exactly on the ``n``-th call (the ``serve_device_error:<n>``
+    dispatch); the counter is per-process and reset by :func:`install`."""
+    val = spec().get("serve_device_error")
+    if val is None:
+        return False
+    n = int(val) if val else 1
+    with _lock:
+        _counters["serve_device_error"] = \
+            _counters.get("serve_device_error", 0) + 1
+        return _counters["serve_device_error"] == n
+
+
+def corrupt_latest_due() -> bool:
+    """True once per :func:`install` when ``corrupt_ckpt:latest`` is armed —
+    the checkpoint restore scan truncates its newest generation on it."""
+    if spec().get("corrupt_ckpt") != "latest":
+        return False
+    with _lock:
+        if _consumed.get("corrupt_ckpt"):
+            return False
+        _consumed["corrupt_ckpt"] = True
+        return True

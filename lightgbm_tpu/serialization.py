@@ -16,6 +16,8 @@ prediction runs on raw features without bin mappers (reference ``Tree::Predict``
 from __future__ import annotations
 
 import dataclasses
+import hashlib
+import os
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -24,6 +26,71 @@ from .config import Config
 
 _CAT_MASK = 1
 _DEFAULT_LEFT_MASK = 2
+
+
+# ------------------------------------------------- checksummed atomic frames
+# Durable single-file publication for checkpoints (resilience/checkpoint.py):
+# a fixed header carries a magic, the payload length and a sha256 digest, so
+# a torn write (truncation) or bitrot is DETECTED at read time instead of
+# deserializing garbage; the write path is write-temp -> flush -> fsync ->
+# rename -> fsync(dir), so a crash leaves either the old generation or the
+# complete new one, never a partial file under the published name.
+
+FRAME_MAGIC = b"LGTPUCK1"
+_FRAME_HEADER_LEN = len(FRAME_MAGIC) + 8 + 32
+
+
+class FrameCorruptError(ValueError):
+    """The frame failed validation (bad magic, truncation, checksum)."""
+
+
+def write_atomic_frame(path: str, payload: bytes) -> None:
+    """Atomically publish ``payload`` at ``path`` inside a checksummed frame."""
+    header = (FRAME_MAGIC + len(payload).to_bytes(8, "little")
+              + hashlib.sha256(payload).digest())
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "wb") as fh:
+            fh.write(header)
+            fh.write(payload)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+    except OSError:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    # fsync the directory so the rename itself survives a crash
+    try:
+        dfd = os.open(os.path.dirname(os.path.abspath(path)), os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+    except OSError:
+        pass
+
+
+def read_frame(path: str) -> bytes:
+    """Read and validate a frame; :class:`FrameCorruptError` on any damage."""
+    with open(path, "rb") as fh:
+        header = fh.read(_FRAME_HEADER_LEN)
+        if len(header) < _FRAME_HEADER_LEN \
+                or header[: len(FRAME_MAGIC)] != FRAME_MAGIC:
+            raise FrameCorruptError(f"{path}: bad or truncated frame header")
+        n = int.from_bytes(header[len(FRAME_MAGIC): len(FRAME_MAGIC) + 8],
+                           "little")
+        digest = header[len(FRAME_MAGIC) + 8:]
+        payload = fh.read(n + 1)
+    if len(payload) != n:
+        raise FrameCorruptError(
+            f"{path}: payload length {len(payload)} != declared {n} "
+            "(torn write)")
+    if hashlib.sha256(payload).digest() != digest:
+        raise FrameCorruptError(f"{path}: sha256 mismatch (corrupt payload)")
+    return payload
 
 
 def _fmt_arr(arr, fmt="%.17g") -> str:

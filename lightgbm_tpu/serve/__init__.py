@@ -22,9 +22,11 @@ from .bucketing import BucketLadder
 from .metrics import ServeMetrics
 from .plan import (PredictPlan, cache_stats, clear_plan_cache,
                    plan_for_model)
-from .predictor import MicroBatcher, Predictor
+from .predictor import (MicroBatcher, Predictor, ServeDeadlineError,
+                        ServeOverloadError)
 
 __all__ = [
     "BucketLadder", "MicroBatcher", "PredictPlan", "Predictor",
-    "ServeMetrics", "cache_stats", "clear_plan_cache", "plan_for_model",
+    "ServeDeadlineError", "ServeMetrics", "ServeOverloadError",
+    "cache_stats", "clear_plan_cache", "plan_for_model",
 ]

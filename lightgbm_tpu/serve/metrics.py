@@ -28,6 +28,14 @@ class ServeMetrics:
         self.queue_depth = 0
         self.max_queue_depth = 0
         self.padded_rows = 0
+        # Graceful-degradation counters (docs/ROBUSTNESS.md): requests shed
+        # by admission control, requests failed past their deadline, device
+        # dispatch faults seen, and dispatches answered by the one-shot
+        # host-predict fallback.
+        self.shed = 0
+        self.deadline_misses = 0
+        self.device_faults = 0
+        self.host_fallbacks = 0
 
     # ------------------------------------------------------------- recording
     def observe_request(self, rows: int, seconds: float) -> None:
@@ -46,6 +54,22 @@ class ServeMetrics:
         with self._lock:
             self.queue_depth = int(depth)
             self.max_queue_depth = max(self.max_queue_depth, int(depth))
+
+    def observe_shed(self, requests: int = 1) -> None:
+        with self._lock:
+            self.shed += int(requests)
+
+    def observe_deadline_miss(self, requests: int = 1) -> None:
+        with self._lock:
+            self.deadline_misses += int(requests)
+
+    def observe_device_fault(self) -> None:
+        with self._lock:
+            self.device_faults += 1
+
+    def observe_host_fallback(self) -> None:
+        with self._lock:
+            self.host_fallbacks += 1
 
     # ------------------------------------------------------------ reporting
     def latency_quantiles_ms(self) -> Dict[str, Optional[float]]:
@@ -72,6 +96,10 @@ class ServeMetrics:
                 "max_queue_depth": self.max_queue_depth,
                 "padded_rows": self.padded_rows,
                 "mean_batch_rows": float(bs.mean()) if bs.size else None,
+                "shed": self.shed,
+                "deadline_misses": self.deadline_misses,
+                "device_faults": self.device_faults,
+                "host_fallbacks": self.host_fallbacks,
             }
         out.update(self.latency_quantiles_ms())
         if plan is not None:

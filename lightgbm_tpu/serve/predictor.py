@@ -6,6 +6,13 @@ small requests into one device dispatch up to a max wait).
 it was frozen with (raw scores summed per class + init scores, then the
 objective's output transform) — the differential tests pin the two
 bitwise-equal on the device path.
+
+Graceful degradation (docs/ROBUSTNESS.md): a device dispatch that faults
+is answered ONCE from a host-side raw-threshold mirror (the serialized
+model, no device touch) instead of failing the request; the MicroBatcher
+adds admission control (``serve_max_queue`` -> :class:`ServeOverloadError`)
+and per-request deadlines (``serve_deadline_ms`` ->
+:class:`ServeDeadlineError`), all counted in :class:`ServeMetrics`.
 """
 
 from __future__ import annotations
@@ -19,10 +26,50 @@ from typing import Optional
 import numpy as np
 
 from ..binning import _is_sparse
+from ..resilience import faults
 from ..utils.log import Log
 from .bucketing import BucketLadder
 from .metrics import ServeMetrics
 from .plan import plan_for_model
+
+
+class ServeOverloadError(RuntimeError):
+    """Request shed by admission control: the queue is at ``serve_max_queue``.
+    Callers should back off — queueing deeper only grows tail latency."""
+
+
+class ServeDeadlineError(RuntimeError):
+    """Request expired in the queue past its ``serve_deadline_ms`` — failed
+    instead of dispatched late (the caller has already given up)."""
+
+
+def _host_convert_output(cfg, raw: np.ndarray) -> np.ndarray:
+    """Numpy re-implementation of the objective output transform for the
+    host fallback path — the jax ``convert_output`` would dispatch to the
+    very device that just faulted.  Covers the closed-form transforms
+    (matching objectives.py); unknown objectives degrade to raw margins
+    with a warning rather than failing the request."""
+    obj = cfg.objective
+    if obj in ("binary", "multiclassova"):
+        return 1.0 / (1.0 + np.exp(-cfg.sigmoid * raw))
+    if obj == "cross_entropy":
+        return 1.0 / (1.0 + np.exp(-raw))
+    if obj == "cross_entropy_lambda":
+        return np.log1p(np.exp(raw))
+    if obj == "multiclass":
+        z = raw - raw.max(axis=-1, keepdims=True)
+        e = np.exp(z)
+        return e / e.sum(axis=-1, keepdims=True)
+    if obj in ("poisson", "gamma", "tweedie"):
+        return np.exp(raw)
+    if obj == "regression" and cfg.reg_sqrt:
+        return np.sign(raw) * raw * raw
+    if obj in ("regression", "regression_l1", "huber", "fair", "quantile",
+               "mape", "lambdarank", "rank_xendcg", "custom"):
+        return raw
+    Log.warning(f"serve host fallback: no host transform for objective="
+                f"{obj}; returning raw scores")
+    return raw
 
 
 class Predictor:
@@ -34,7 +81,8 @@ class Predictor:
                  num_iteration: Optional[int] = None,
                  start_iteration: int = 0,
                  ladder: Optional[BucketLadder] = None,
-                 max_compiles: int = 16):
+                 max_compiles: int = 16,
+                 host_fallback: bool = True):
         model = getattr(booster, "_gbdt", booster)
         if not hasattr(model, "train_data"):
             raise ValueError(
@@ -64,6 +112,15 @@ class Predictor:
         self.metrics = ServeMetrics()
         self.max_compiles = int(max_compiles)
         self._compile_warned = False
+        # One-shot host fallback (docs/ROBUSTNESS.md): the request that
+        # sees a device fault is answered from a host raw-threshold mirror
+        # built lazily on first fault; subsequent requests try the device
+        # again (a transient fault heals, a dead device faults per request
+        # and every fault is counted).
+        self._host_fallback = bool(host_fallback)
+        self._num_iteration = num_iteration
+        self._start_iteration = max(int(start_iteration), 0)
+        self._host_mirror_cache = None
 
     # ------------------------------------------------------------------ API
     @property
@@ -73,24 +130,56 @@ class Predictor:
     def predict(self, X, _record: bool = True) -> np.ndarray:
         """Scores for a batch of rows — one compiled dispatch, recorded in
         the serving metrics.  Accepts dense arrays (device binning) or
-        scipy sparse (host binning from CSC, device traversal)."""
+        scipy sparse (host binning from CSC, device traversal).  A faulted
+        device dispatch is answered once from the host mirror
+        (``host_fallback``) instead of failing the request."""
         t0 = time.perf_counter()
-        if _is_sparse(X):
+        sparse = _is_sparse(X)
+        if sparse:
             if X.shape[1] != self.plan.num_features:
                 # same clear error the dense path raises, instead of an
                 # IndexError deep inside column-wise sparse binning
                 raise ValueError(
                     f"plan expects (N, {self.plan.num_features}) rows, "
                     f"got {X.shape}")
-            bins = self._model.train_data.binned.apply(X)
-            raw = self.plan.raw_scores_binned(bins, metrics=self.metrics)
-            n = bins.shape[0]
+            n = X.shape[0]
         else:
             X = np.asarray(X, np.float64)
             if X.ndim == 1:
                 X = X.reshape(1, -1)
-            raw = self.plan.raw_scores(X, metrics=self.metrics)
+            if X.shape[1] != self.plan.num_features:
+                raise ValueError(
+                    f"plan expects (N, {self.plan.num_features}) rows, "
+                    f"got {X.shape}")
             n = X.shape[0]
+        try:
+            out = self._predict_device(X, sparse)
+        except (ValueError, TypeError):
+            # caller input errors are the caller's to see — only
+            # infrastructure faults route to the host mirror
+            raise
+        except Exception as e:  # noqa: BLE001 — device fault -> host answer
+            if not self._host_fallback:
+                raise
+            out = self._predict_host(X, sparse, e)
+        if _record:   # the microbatcher records per-CALLER requests itself
+            self.metrics.observe_request(n, time.perf_counter() - t0)
+        self._check_compile_guard()
+        return out
+
+    def _predict_device(self, X, sparse: bool) -> np.ndarray:
+        # fault seam (resilience/faults.py): a wedged or erroring device
+        # dispatch enters serving exactly here
+        faults.maybe_wedge("serve")
+        if faults.serve_error_due():
+            raise RuntimeError(
+                "injected serve device fault "
+                "(LIGHTGBM_TPU_FAULTS=serve_device_error)")
+        if sparse:
+            bins = self._model.train_data.binned.apply(X)
+            raw = self.plan.raw_scores_binned(bins, metrics=self.metrics)
+        else:
+            raw = self.plan.raw_scores(X, metrics=self.metrics)
         out = raw[:, 0] if self.plan.num_class == 1 else raw
         obj = getattr(self._model, "objective", None)
         if not self._raw_score and obj is not None:
@@ -104,19 +193,72 @@ class Predictor:
             import jax.numpy as jnp
             out = np.asarray(jax.device_get(
                 obj.convert_output(jnp.asarray(out))))
-        if _record:   # the microbatcher records per-CALLER requests itself
-            self.metrics.observe_request(n, time.perf_counter() - t0)
-        self._check_compile_guard()
         return out
+
+    def _predict_host(self, X, sparse: bool, cause: Exception) -> np.ndarray:
+        """One-shot host fallback: raw-threshold traversal of the
+        serialized model mirror — no device touch anywhere, including the
+        output transform (numpy re-implementation)."""
+        self.metrics.observe_device_fault()
+        Log.warning(
+            f"serve: device dispatch faulted ({str(cause)[:160]}); "
+            "answering this request from the host mirror")
+        mirror = self._host_mirror()
+        if sparse:
+            # densify in bounded chunks: one full todense() of the huge
+            # sparse batches that route here would turn a degraded request
+            # into a host OOM
+            step = 65536
+            out = np.concatenate([
+                mirror.predict_raw(
+                    np.asarray(X[lo:lo + step].todense(), np.float64),
+                    num_iteration=self._num_iteration,
+                    start_iteration=self._start_iteration)
+                for lo in range(0, X.shape[0], step)], axis=0)
+        else:
+            out = mirror.predict_raw(
+                np.asarray(X, np.float64),
+                num_iteration=self._num_iteration,
+                start_iteration=self._start_iteration)
+        if not self._raw_score \
+                and getattr(self._model, "objective", None) is not None:
+            out = _host_convert_output(self._model.cfg, out)
+        self.metrics.observe_host_fallback()
+        return out
+
+    def _host_mirror(self):
+        """Serialized raw-threshold mirror of the frozen model, rebuilt
+        only when trees were added/removed or rewritten in place
+        (the same (num_trees, _pred_version) key the pred-early-stop
+        mirror uses)."""
+        from ..serialization import load_model_string, model_to_string
+        key = (self._model.num_trees,
+               getattr(self._model, "_pred_version", 0))
+        cache = self._host_mirror_cache
+        if cache is None or cache[0] != key:
+            cache = (key, load_model_string(
+                model_to_string(self._model, fold_bias=False)))
+            self._host_mirror_cache = cache
+        return cache[1]
 
     def warmup(self, max_rows: int = 1024) -> int:
         """Compile every ladder rung up to ``max_rows`` ahead of traffic."""
         return self.plan.warmup(max_rows)
 
-    def batcher(self, max_batch: int = 1024,
-                max_wait_ms: float = 2.0) -> "MicroBatcher":
+    def batcher(self, max_batch: int = 1024, max_wait_ms: float = 2.0,
+                max_queue: Optional[int] = None,
+                deadline_ms: Optional[float] = None) -> "MicroBatcher":
+        """``max_queue``/``deadline_ms`` default to the model's
+        ``serve_max_queue``/``serve_deadline_ms`` config knobs (0 =
+        unbounded / no deadline)."""
+        cfg = self._model.cfg
+        if max_queue is None:
+            max_queue = int(getattr(cfg, "serve_max_queue", 0))
+        if deadline_ms is None:
+            deadline_ms = float(getattr(cfg, "serve_deadline_ms", 0.0))
         return MicroBatcher(self, max_batch=max_batch,
-                            max_wait_ms=max_wait_ms)
+                            max_wait_ms=max_wait_ms, max_queue=max_queue,
+                            deadline_ms=deadline_ms)
 
     def metrics_snapshot(self) -> dict:
         return self.metrics.snapshot(plan=self.plan)
@@ -145,13 +287,24 @@ class MicroBatcher:
     ``max_batch`` rows accumulate), predicts ONCE, and slices results back
     per request.  Queue depth / batch sizes / per-request latency land in
     the predictor's metrics.
+
+    Degradation semantics (docs/ROBUSTNESS.md): ``max_queue`` > 0 sheds
+    submits past that many queued REQUESTS with :class:`ServeOverloadError`
+    (admission control — failing fast beats queueing into a latency cliff);
+    ``deadline_ms`` > 0 fails requests still queued past their deadline
+    with :class:`ServeDeadlineError` right before the batch dispatches (a
+    dispatch already in flight is not interrupted — the deadline governs
+    queue wait, the dominant tail-latency term).
     """
 
     def __init__(self, predictor: Predictor, *, max_batch: int = 1024,
-                 max_wait_ms: float = 2.0):
+                 max_wait_ms: float = 2.0, max_queue: int = 0,
+                 deadline_ms: float = 0.0):
         self.predictor = predictor
         self.max_batch = int(max_batch)
         self.max_wait_s = float(max_wait_ms) / 1e3
+        self.max_queue = int(max_queue)
+        self.deadline_s = float(deadline_ms) / 1e3
         self._queue: Queue = Queue()
         self._closed = False
         # Serializes submits against close(): the None sentinel must be the
@@ -177,6 +330,14 @@ class MicroBatcher:
         with self._submit_lock:
             if self._closed:
                 raise RuntimeError("MicroBatcher is closed")
+            if self.max_queue > 0 and self._queue.qsize() >= self.max_queue:
+                # Admission control: shed-with-error at the door.  Counted,
+                # and raised OUTSIDE the future so the caller's submit path
+                # sees backpressure immediately.
+                self.predictor.metrics.observe_shed()
+                raise ServeOverloadError(
+                    f"serve queue full ({self._queue.qsize()} requests >= "
+                    f"serve_max_queue={self.max_queue}); request shed")
             self._queue.put((X, fut, time.perf_counter()))
         self.predictor.metrics.observe_queue_depth(self._queue.qsize())
         return fut
@@ -229,6 +390,24 @@ class MicroBatcher:
             return False
 
     def _flush(self, batch) -> None:
+        if self.deadline_s > 0:
+            # Requests that expired while QUEUED are failed here, not
+            # dispatched: their caller has already timed out, and padding
+            # the batch with them only slows the live ones.
+            now = time.perf_counter()
+            live, expired = [], []
+            for entry in batch:
+                (expired if now - entry[2] > self.deadline_s
+                 else live).append(entry)
+            batch = live
+            for _x, fut, t_in in expired:
+                if self._settle(fut, exc=ServeDeadlineError(
+                        f"request waited {(now - t_in) * 1e3:.1f}ms > "
+                        f"serve_deadline_ms="
+                        f"{self.deadline_s * 1e3:g}")):
+                    self.predictor.metrics.observe_deadline_miss()
+            if not batch:
+                return
         xs = [x for x, _f, _t in batch]
         try:
             out = self.predictor.predict(np.concatenate(xs, axis=0),

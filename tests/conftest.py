@@ -2,6 +2,7 @@
 tests run without TPU hardware (mirrors the reference's localhost mock-cluster
 pattern, tests/distributed/_test_distributed.py)."""
 
+import faulthandler
 import os
 import sys
 
@@ -10,6 +11,20 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import _hermetic  # noqa: E402
 
 _hermetic.force_cpu(8)
+
+# A wedged dispatch must leave a traceback, not a silent timeout kill:
+# enable faulthandler here for any non-pytest import of this harness, and
+# pytest.ini's faulthandler_timeout arms the per-test dump (the builtin
+# faulthandler plugin re-registers per test).  SIGTERM also dumps — the
+# tier-1 runner's `timeout` sends SIGTERM before SIGKILL, so even a
+# whole-run overrun names the test it died in.
+faulthandler.enable()
+try:
+    import signal
+
+    faulthandler.register(signal.SIGTERM, chain=True)
+except (AttributeError, ValueError, OSError):
+    pass  # platforms without signal support keep the plain enable
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

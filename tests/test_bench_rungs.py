@@ -7,9 +7,13 @@ GOSS rung must witness the device-resident sampler's ONE compiled dispatch
 per boosting round.  Scaled-down geometries here; bench.py's env knobs
 carry the full sizes."""
 
-import jax
+import json
 
-from bench import run_goss_rung, run_ltr_rung, run_wide_rung
+import jax
+import pytest
+
+from bench import (_load_watchdog, _probe_backend, _probe_block,
+                   run_goss_rung, run_ltr_rung, run_wide_rung)
 
 
 def test_ltr_rung_blob():
@@ -44,3 +48,60 @@ def test_goss_rung_blob_one_dispatch():
     assert blob["used_fused"] is True
     assert blob["dispatches_per_iter"] == 1.0
     assert blob["host_syncs_per_iter"] <= 2.0
+
+
+# --------------------------- watchdog probe block (ISSUE-6 satellite) ----
+PROBE_KEYS = {"verdict", "backend", "devices", "latency_s", "budget_s",
+              "error"}
+
+
+def test_probe_block_carries_outer_watchdog_verdict(monkeypatch):
+    """The outer bench process's subprocess probe verdict rides into the
+    inner run's JSON via _BENCH_PROBE, verbatim."""
+    blk = {"verdict": "wedged", "backend": None, "devices": 0,
+           "latency_s": 240.0, "budget_s": 240, "error": "budget exceeded"}
+    monkeypatch.setenv("_BENCH_PROBE", json.dumps(blk))
+    assert _probe_block("cpu", 1, 0.5) == blk
+
+
+def test_probe_block_synthesized_when_direct(monkeypatch):
+    """A directly-invoked inner run (no outer watchdog) still emits a
+    complete probe block from its own backend init."""
+    monkeypatch.delenv("_BENCH_PROBE", raising=False)
+    blk = _probe_block("cpu", 8, 1.2345)
+    assert PROBE_KEYS <= set(blk)
+    assert blk["verdict"] == "live" and blk["backend"] == "cpu"
+    assert blk["devices"] == 8 and blk["latency_s"] == 1.234
+
+
+def test_watchdog_loads_by_file_path_and_budgets():
+    """bench.main() loads the watchdog WITHOUT importing lightgbm_tpu (a
+    wedged plugin can hang even at package import); the loaded module's
+    probe must return a wedged verdict AT its budget, not hang."""
+    wd = _load_watchdog()
+    res = wd.probe_backend(
+        timeout=2.0,
+        extra_env={"LIGHTGBM_TPU_FAULTS": "wedge_dispatch:600"})
+    assert res.verdict == "wedged"
+    assert PROBE_KEYS <= set(res.as_dict())
+
+
+def test_forced_cpu_rung_refuses_accelerator_label(monkeypatch):
+    """The honesty guard (ROADMAP 3b): a forced-CPU fallback rung that
+    somehow resolves an accelerator backend must die, not publish a
+    mislabeled number."""
+    import _hermetic
+
+    class _FakeJax:
+        @staticmethod
+        def devices():
+            return [object()]
+
+        @staticmethod
+        def default_backend():
+            return "tpu"
+
+    monkeypatch.setenv("_BENCH_FORCE_CPU", "1")
+    monkeypatch.setattr(_hermetic, "force_cpu", lambda n: _FakeJax)
+    with pytest.raises(RuntimeError, match="forced-CPU"):
+        _probe_backend()

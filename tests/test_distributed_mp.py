@@ -21,7 +21,21 @@ import sys
 import numpy as np
 import pytest
 
+from lightgbm_tpu.resilience.watchdog import probe_multiprocess
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Capability gate (ISSUE-6 satellite): CPU jaxlib raises "Multiprocess
+# computations aren't implemented on the CPU backend" — a known platform
+# gap, not a regression.  Probe it ONCE (two subprocess workers bootstrap
+# jax.distributed over loopback; verdict cached per test process) and skip
+# the whole module when real cross-process collectives can't run, so a
+# FAILURE here always means a regression.
+_MP = probe_multiprocess(num_processes=2, timeout=120.0)
+pytestmark = pytest.mark.skipif(
+    not _MP.ok,
+    reason="jaxlib cannot run multiprocess collectives on this backend: "
+           f"{_MP.reason}")
 
 N, F, LEAVES = 8 * 2304, 12, 31
 

@@ -66,6 +66,32 @@ _DESCRIPTIONS = {
         "predict batches up to this many rows take the native C++ host "
         "traversal; larger batches go through the compiled serve plan "
         "(docs/SERVING.md); 0 routes everything to the device"),
+    "checkpoint_interval": (
+        "atomic training snapshots (resilience/checkpoint.py, "
+        "docs/ROBUSTNESS.md) every N committed boosting rounds, emitted at "
+        "iter-pack commit boundaries (with packing the interval is a "
+        "floor); resume via `engine.train(..., resume_from=)` is "
+        "bitwise-identical to the uninterrupted run; 0 = disabled"),
+    "checkpoint_dir": (
+        "snapshot directory; '' derives `<output_model>.ckpt`"),
+    "checkpoint_keep": (
+        "snapshot generations retained — the older ones are the fallback "
+        "chain when the newest fails its checksum (torn write/bitrot)"),
+    "tpu_probe_timeout": (
+        "hard wall-clock budget (seconds) for the backend watchdog's "
+        "subprocess probe (resilience/watchdog.py, armed via "
+        "LIGHTGBM_TPU_WATCHDOG=1): compile + tiny dispatch must answer "
+        "within it or the backend is classified wedged and training "
+        "refuses to start instead of hanging"),
+    "serve_max_queue": (
+        "serve admission control (serve/predictor.py MicroBatcher): "
+        "requests queued past this many are shed with ServeOverloadError "
+        "(counted in ServeMetrics.shed); 0 = unbounded"),
+    "serve_deadline_ms": (
+        "per-request serving deadline: requests still QUEUED past it are "
+        "failed with ServeDeadlineError instead of dispatched late "
+        "(counted in ServeMetrics.deadline_misses); an in-flight dispatch "
+        "is never interrupted; 0 = none"),
 }
 
 

@@ -9,12 +9,15 @@
 set -e
 cd "$(dirname "$0")/.."
 
-echo "== probe =="
-timeout 95 python -c "
-import jax, jax.numpy as jnp, time
-t0 = time.time(); x = jnp.ones((64, 64)); (x @ x).block_until_ready()
-print('TPU OK %.1fs' % (time.time() - t0))" || {
-  echo "chip wedged; aborting"; exit 1; }
+echo "== watchdog probe =="
+# Budgeted subprocess probe (lightgbm_tpu/resilience/watchdog.py): the
+# parent never touches jax, so a wedged plugin cannot hang the playbook —
+# the probe child is killed at the budget and the verdict says "wedged".
+# Invoked by FILE PATH (not -m): python -m would import the package
+# __init__ — and therefore jax — in the parent, the very hang the
+# watchdog exists to avoid.
+python lightgbm_tpu/resilience/watchdog.py --timeout 90 || {
+  echo "backend wedged or broken; aborting"; exit 1; }
 
 echo "== scaled bench (1M x 20) =="
 BENCH_ROWS=1000000 BENCH_ITERS=20 BENCH_QUANT_CHECK=0 \
