@@ -75,6 +75,12 @@ WIDE_POOL_MB = float(os.environ.get("BENCH_WIDE_POOL_MB", 256.0))
 # (tpu_device_goss auto), witnessed as dispatches_per_iter in the blob.
 GOSS_CHECK = os.environ.get("BENCH_GOSS", "1") == "1"
 GOSS_ITERS = int(os.environ.get("BENCH_GOSS_ITERS", 15))
+# Quantized-fused rung (ISSUE-7): Higgs shape, tpu_wave_kernel=fused + the
+# int8 quantized wire — one pallas dispatch per wave builds, subtracts and
+# scans in VMEM.  On non-TPU platforms the kernel runs in interpret mode
+# (a correctness vehicle, not a speed number; the blob says so).
+FUSED_CHECK = os.environ.get("BENCH_FUSED", "1") == "1"
+FUSED_ITERS = int(os.environ.get("BENCH_FUSED_ITERS", 12))
 
 
 def _pack_eff(iters, pack):
@@ -173,6 +179,19 @@ def make_epsilon_like(n, f, seed=0):
     return d["X"], d["y"]
 
 
+def _hlo_cost_block(bst):
+    """The per-rung HLO cost block (ROADMAP 3b, ISSUE-7 satellite): XLA's
+    own cost model (FLOPs / bytes accessed) for the rung's compiled grower
+    program, so every kernel PR lands with a compile-time cost number even
+    when the TPU probe verdict is not live.  Deltas across BENCH rounds =
+    the kernel's cost trajectory."""
+    try:
+        from tools.profile_iter import train_step_hlo_cost
+        return train_step_hlo_cost(bst)
+    except Exception as e:  # noqa: BLE001 — cost is garnish on the rate
+        return {"error": f"{e!r}"[:200]}
+
+
 def _rung_train(params, ds_kw, iters, jax):
     """Train one side-rung booster and return (booster, elapsed_s)."""
     import lightgbm_tpu as lgb
@@ -220,6 +239,7 @@ def run_ltr_rung(rows, iters, platform, jax, features=None, group=None,
         "train_time_s": round(elapsed, 3),
         "row_iters_per_sec": round(rows * iters / elapsed, 1),
         "ndcg5_train_sample": None if ndcg is None else round(ndcg, 6),
+        "hlo_cost": _hlo_cost_block(bst),
     }
 
 
@@ -259,6 +279,7 @@ def run_wide_rung(rows, iters, platform, jax, features=None,
             num_leaves * features * bins * 3 * 4 / 2**20, 1),
         "leaf_hist_mb_pooled": round(
             slots * features * bins * 3 * 4 / 2**20, 1),
+        "hlo_cost": _hlo_cost_block(bst),
     }
 
 
@@ -296,7 +317,43 @@ def run_goss_rung(rows, iters, platform, jax, features=None,
         blob["host_syncs_per_iter"] = round(s / 2, 2)
     except Exception as e:  # noqa: BLE001 — census is garnish on the rate
         blob["dispatches_per_iter"] = f"failed: {e!r}"[:120]
+    blob["hlo_cost"] = _hlo_cost_block(bst)
     return blob
+
+
+def run_fused_rung(rows, iters, platform, jax, features=None,
+                   num_leaves=None):
+    """Quantized-fused rung (ISSUE-7): Higgs shape trained with
+    ``tpu_wave_kernel=fused`` on the int8 quantized wire — ONE pallas
+    dispatch per wave builds the smaller-sibling histograms, derives the
+    larger siblings by parent subtraction and runs the split scan without
+    the (W, G, B, 3) tensors leaving VMEM.  On non-TPU platforms the
+    kernel runs in interpret mode (correctness vehicle, not a speed
+    number — ``interpret_mode`` in the blob says so); the blob's
+    ``hlo_cost`` is the compile-time number that travels across rounds."""
+    features = features or FEATURES
+    cpu = platform == "cpu"
+    num_leaves = num_leaves or (63 if cpu else NUM_LEAVES)
+    X, y = make_higgs_like(rows, features)
+    params = {"objective": "binary", "num_leaves": num_leaves,
+              "learning_rate": 0.1, "max_bin": 255, "min_data_in_leaf": 0,
+              "min_sum_hessian_in_leaf": 100.0, "metric": "none",
+              "verbosity": -1, "tpu_leaf_batch": min(LEAF_BATCH, 8),
+              "use_quantized_grad": True, "tpu_wave_kernel": "fused"}
+    bst, elapsed = _rung_train(params, dict(X=X, label=y), iters, jax)
+    g = bst._gbdt
+    return {
+        "rows": rows, "features": features, "iters": iters,
+        "num_leaves": num_leaves, "platform": platform,
+        "quantized": True, "wave_kernel": "fused",
+        "wave_fused_active": bool(g.wave_fused_active),
+        "hist_dispatches_per_wave": (
+            1 if g.wave_fused_active else int(g.grower_cfg.leaf_batch)),
+        "interpret_mode": platform != "tpu",
+        "train_time_s": round(elapsed, 3),
+        "row_iters_per_sec": round(rows * iters / elapsed, 1),
+        "hlo_cost": _hlo_cost_block(bst),
+    }
 
 
 def _cache_path(name):
@@ -508,8 +565,13 @@ def run_bench(rows, iters):
             "plan_cache_hits": snap["plan_cache"]["hits"],
         }
 
+    # Per-rung HLO cost (ROADMAP 3b / ISSUE-7): the primary config's
+    # compile-time FLOPs / bytes-accessed ride EVERY emitted line, so a
+    # kernel PR lands with a cost delta even when the chip is wedged.
+    hlo_cost = _hlo_cost_block(bst)
+
     def emit(quant_rate, predict_stats=None, ltr_stats=None,
-             wide_stats=None, goss_stats=None):
+             wide_stats=None, goss_stats=None, fused_stats=None):
         print(json.dumps({
             "metric": "binary_255leaves_row_iters_per_sec",
             "value": round(row_iters_per_sec, 1),
@@ -530,6 +592,10 @@ def run_bench(rows, iters):
                 # never be mistaken for a TPU number again (ROADMAP 3b).
                 "probe": probe_block,
                 "cpu_fallback": platform == "cpu",
+                # XLA cost-model block for the compiled grower program
+                # (tools/profile_iter.train_step_hlo_cost): flops /
+                # bytes_accessed — per-rung deltas across BENCH rounds.
+                "hlo_cost": hlo_cost,
                 # Iteration packing: training dispatches per boosting round
                 # (1.0 = per-round loop; 1/K with K-round packs — the
                 # host-sync elimination the pack path is for).
@@ -550,6 +616,9 @@ def run_bench(rows, iters):
                 # GOSS rung (ISSUE-5): device-resident sampling at the
                 # Higgs shape — one compiled dispatch per boosting round.
                 "goss": goss_stats,
+                # Quantized-fused rung (ISSUE-7): tpu_wave_kernel=fused on
+                # the int8 wire — one pallas dispatch per wave.
+                "fused_wave": fused_stats,
                 "reference": "LightGBM CPU 16t Higgs 10.5Mx28 500it in "
                              "130.094s (docs/Experiments.rst:113)",
             },
@@ -573,7 +642,7 @@ def run_bench(rows, iters):
     # later rung can never forfeit an earlier one (the outer runner
     # salvages the LAST metric line).  Row/iter budgets derive from the
     # primary budget, so the CPU fallback shrinks them automatically.
-    ltr_stats = wide_stats = goss_stats = None
+    ltr_stats = wide_stats = goss_stats = fused_stats = None
     if LTR_CHECK:
         try:
             ltr_stats = run_ltr_rung(
@@ -598,6 +667,18 @@ def run_bench(rows, iters):
         except Exception as e:  # noqa: BLE001
             goss_stats = {"error": f"{e!r}"[:200]}
         emit(None, predict_stats, ltr_stats, wide_stats, goss_stats)
+    if FUSED_CHECK:
+        try:
+            # interpret-mode pallas on the CPU fallback is a correctness
+            # vehicle, not a throughput path — shrink the rung harder than
+            # the others so the blob always materializes.
+            fused_stats = run_fused_rung(
+                max(min(rows // 16, 65536), 4096),
+                max(min(FUSED_ITERS, iters // 2), 2), platform, jax)
+        except Exception as e:  # noqa: BLE001
+            fused_stats = {"error": f"{e!r}"[:200]}
+        emit(None, predict_stats, ltr_stats, wide_stats, goss_stats,
+             fused_stats)
 
     quant_rate = None
     if QUANT_CHECK and not QUANTIZED:
@@ -610,7 +691,8 @@ def run_bench(rows, iters):
         except Exception as e:  # noqa: BLE001
             quant_rate = f"failed: {e!r}"[:200]
     if quant_rate is not None:
-        emit(quant_rate, predict_stats, ltr_stats, wide_stats, goss_stats)
+        emit(quant_rate, predict_stats, ltr_stats, wide_stats, goss_stats,
+             fused_stats)
 
 
 def _scan_json(stdout):

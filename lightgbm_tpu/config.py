@@ -187,6 +187,20 @@ _PARAMS: List[Tuple[str, Any, Any, Tuple[str, ...], Optional[Tuple[Any, Any]]]] 
     ("tpu_4bit_bins", bool, True, (), None),
     # Leaves split per growth step (wave growth); 1 = strict best-first.
     ("tpu_leaf_batch", int, 1, (), (1, 128)),
+    # Fused wave kernel (ops/pallas_wave.py): one pallas_call per leaf-
+    # batch wave runs histogram build -> sibling subtraction -> split scan
+    # while the accumulators stay VMEM-resident, vs one histogram dispatch
+    # per leaf plus two more HBM passes (subtract + scan) unfused.  auto =
+    # fused only where the capability checks pass and the flat pallas
+    # histogram is the live impl (TPU); fused = force (interpret-mode on
+    # CPU — slow, test vehicle); unfused = always the per-leaf path.
+    # Identity: quantized trees are bitwise-identical either way (integer
+    # histograms); fp32 trees are identical whenever histogram sums are
+    # exactly representable, ULP-level otherwise — the wave's shared row
+    # bucket may regroup f32 partial sums vs the per-leaf buckets, the
+    # same caveat as the histogram pool's recompute-on-miss
+    # (tests/test_wave_fused.py, docs/PERF.md round 9).
+    ("tpu_wave_kernel", str, "auto", (), None),  # auto|fused|unfused
     # Cross-shard histogram reduction on data-parallel meshes
     # (tree_learner=data): reduce_scatter = feature-sliced psum_scatter +
     # per-shard split scan + SplitInfo payload broadcast (~2x less comm
@@ -293,7 +307,7 @@ def _coerce(name: str, typ: Any, value: Any) -> Any:
         return str(value).strip().lower() if name in ("objective", "boosting", "tree_learner",
                                                       "device_type", "monotone_constraints_method",
                                                       "data_sample_strategy", "tpu_histogram_impl",
-                                                      "tpu_hist_comm") \
+                                                      "tpu_hist_comm", "tpu_wave_kernel") \
             else str(value)
     if typ in ("list_int", "list_float", "list_str"):
         if value is None:

@@ -23,8 +23,9 @@ from typing import Callable, List, Optional, Tuple
 
 @dataclasses.dataclass
 class Composition:
-    """The mutable facts ``resolve`` adjudicates.  ``voting``/``leaf_batch``
-    are the two downgrade targets; everything else is read-only context."""
+    """The mutable facts ``resolve`` adjudicates.  ``voting``/
+    ``leaf_batch``/``wave_kernel`` are the downgrade targets; everything
+    else is read-only context."""
 
     voting: bool
     leaf_batch: int
@@ -32,11 +33,21 @@ class Composition:
     forced_splits: bool
     extra_trees: bool
     feature_fraction_bynode: bool
+    # "auto" | "fused" | "unfused" (tpu_wave_kernel).  Only an EXPLICIT
+    # "fused" request fires the downgrade rules below — "auto" resolves
+    # silently through grower.wave_fused_for, which owns the full
+    # (dataset-fact-dependent) predicate; the rules here cover the
+    # composition axes a user can contradict in params alone.
+    wave_kernel: str = "auto"
 
 
 def _mono_refresh(c: Composition) -> bool:
     # intermediate/advanced recompute bounds + best splits every step
     return c.mono_method in ("intermediate", "advanced")
+
+
+def _fused_wave(c: Composition) -> bool:
+    return c.wave_kernel == "fused"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -80,6 +91,40 @@ RULES: Tuple[Rule, ...] = (
          "error",
          "monotone_constraints_method=advanced does not compose with "
          "forced_splits; use intermediate"),
+    # ---- fused wave kernel (tpu_wave_kernel=fused, ops/pallas_wave.py).
+    # The kernel scans both children inside one pallas_call, so anything
+    # that changes the scan per NODE (monotone bounds, forced overwrites,
+    # per-node randomness) or replaces the scan entirely (voting) keeps
+    # the unfused wave path.
+    Rule("fused-wave-x-forced",
+         lambda c: _fused_wave(c) and c.forced_splits,
+         "fallback",
+         "tpu_wave_kernel=fused does not compose with forced splits "
+         "(_apply_forced overwrites stored splits mid-growth); keeping "
+         "the unfused wave path",
+         lambda c: dataclasses.replace(c, wave_kernel="unfused")),
+    Rule("fused-wave-x-monotone",
+         lambda c: _fused_wave(c) and c.mono_method != "none",
+         "fallback",
+         "tpu_wave_kernel=fused does not compose with monotone "
+         "constraints (the in-kernel scan carries no per-child output "
+         "bounds); keeping the unfused wave path",
+         lambda c: dataclasses.replace(c, wave_kernel="unfused")),
+    Rule("fused-wave-x-randomness",
+         lambda c: _fused_wave(c) and (c.extra_trees
+                                       or c.feature_fraction_bynode),
+         "fallback",
+         "tpu_wave_kernel=fused does not compose with extra_trees / "
+         "feature_fraction_bynode (per-node masks and thresholds); "
+         "keeping the unfused wave path",
+         lambda c: dataclasses.replace(c, wave_kernel="unfused")),
+    Rule("fused-wave-x-voting",
+         lambda c: _fused_wave(c) and c.voting,
+         "fallback",
+         "tpu_wave_kernel=fused does not compose with "
+         "tree_learner=voting (voting scans compact vote-winner slices); "
+         "keeping the unfused wave path",
+         lambda c: dataclasses.replace(c, wave_kernel="unfused")),
 )
 
 

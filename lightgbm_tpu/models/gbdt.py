@@ -281,15 +281,21 @@ class GBDT:
         # declarative capability matrix (models/capabilities.py) — ONE
         # enumerable table instead of scattered warn-and-fallback branches.
         from .capabilities import Composition, resolve
+        if cfg.tpu_wave_kernel not in ("auto", "fused", "unfused"):
+            raise ValueError(
+                f"tpu_wave_kernel={cfg.tpu_wave_kernel!r}: expected auto, "
+                "fused or unfused")
         comp, _ = resolve(Composition(
             voting=voting,
             leaf_batch=leaf_batch,
             mono_method=mono_method if has_mono else "none",
             forced_splits=forced is not None,
             extra_trees=cfg.extra_trees,
-            feature_fraction_bynode=cfg.feature_fraction_bynode < 1.0),
+            feature_fraction_bynode=cfg.feature_fraction_bynode < 1.0,
+            wave_kernel=cfg.tpu_wave_kernel),
             warn=Log.warning)
         voting, leaf_batch = comp.voting, comp.leaf_batch
+        wave_kernel = comp.wave_kernel
         if cfg.tpu_hist_comm not in ("auto", "allreduce", "reduce_scatter"):
             raise ValueError(
                 f"tpu_hist_comm={cfg.tpu_hist_comm!r}: expected auto, "
@@ -325,6 +331,7 @@ class GBDT:
                          if self._mono_advanced else None),
             hist_comm=cfg.tpu_hist_comm,
             histogram_pool_size=cfg.histogram_pool_size,
+            wave_kernel=wave_kernel,
         )
         from .grower import fp_capable_for, pool_active_for, rs_active_for
         if (cfg.tpu_hist_comm == "reduce_scatter"
@@ -380,6 +387,27 @@ class GBDT:
                 cfg.extra_seed * 92821 + cfg.feature_fraction_seed)
         self.grow = make_grower(self.grower_cfg, mesh=self.mesh,
                                 data_axis=DATA_AXIS)
+        # Fused wave kernel (tpu_wave_kernel, ops/pallas_wave.py): the
+        # composition gate lives on the grower; AND the shape gates here —
+        # the shared VMEM-fit predicate plus the perm-layout row floor
+        # (_grow_impl routes n <= _MIN_BUCKET to the mask layout, where no
+        # wave runs at all) — so reporting (bench blobs, the fused-wave
+        # census) states exactly what _grow_wave traces.
+        self.wave_fused_active = False
+        if getattr(self.grow, "wave_fused", False):
+            from ..ops.pallas_wave import wave_fits_for
+            from .grower import _MIN_BUCKET
+            self.wave_fused_active = (
+                train.num_data > _MIN_BUCKET
+                and wave_fits_for(self.grower_cfg, train.num_features))
+        if wave_kernel == "fused" and not self.wave_fused_active:
+            Log.warning(
+                "tpu_wave_kernel=fused cannot engage for this composition/"
+                "shape (device mesh, voting, EFB bundling, monotone "
+                "constraints, sorted-categorical scans, CEGB, "
+                "feature_contri, a feature space too wide for one VMEM "
+                "block, or too few rows for the wave layout); keeping the "
+                "unfused path")
         if self.bundles is not None:
             self.bins_dev = train.bundled_bins_device()
             self._fg_dev = jnp.asarray(self.bundles.feat_group, jnp.int32)
@@ -1194,8 +1222,12 @@ class GBDT:
             "Pallas histogram kernel failed to compile; falling back to "
             f"tpu_histogram_impl=onehot ({msg.splitlines()[0][:160]})")
         import dataclasses as _dc
+        # The fused wave kernel shares the failing Mosaic pipeline — a
+        # degrade that kept it would just crash again one dispatch later.
         self.grower_cfg = _dc.replace(self.grower_cfg,
-                                      histogram_impl="onehot")
+                                      histogram_impl="onehot",
+                                      wave_kernel="unfused")
+        self.wave_fused_active = False
         self.grow = make_grower(self.grower_cfg, mesh=self.mesh,
                                 data_axis=DATA_AXIS)
         self._build_iter_fns()

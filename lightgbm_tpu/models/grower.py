@@ -193,6 +193,16 @@ class GrowerConfig:
     # layout, voting and the intermediate/advanced monotone refresh keep
     # full residency.
     histogram_pool_size: float = -1.0
+    # Fused wave kernel (ops/pallas_wave.py): ONE pallas_call per wave
+    # builds the smaller-sibling histograms, derives the larger siblings
+    # by parent subtraction and runs the split scan without the (W, G, B,
+    # 3) tensors leaving VMEM — vs one histogram dispatch per leaf plus
+    # two more HBM passes (subtract + scan) unfused.  "auto" fuses only
+    # where the capability checks pass AND the flat pallas kernel is the
+    # live histogram impl (TPU backends); "fused" forces the kernel
+    # (interpret-mode on CPU — how tier-1 exercises the kernel body);
+    # "unfused" keeps the per-leaf path.  See wave_fused_for.
+    wave_kernel: str = "auto"
 
 
 class TreeArrays(NamedTuple):
@@ -391,6 +401,69 @@ def pool_active_for(cfg: GrowerConfig, mesh=None,
     if (cfg.mono_intermediate or cfg.mono_advanced) and cfg.split.has_monotone:
         return False
     return True
+
+
+def wave_fused_for(cfg: GrowerConfig, mesh=None,
+                   data_axis: str = "data") -> bool:
+    """Static predicate: may this composition route wave growth through
+    the fused histogram->subtract->scan Pallas kernel
+    (``ops/pallas_wave.py``, ``tpu_wave_kernel``)?  Shared by
+    make_grower's dispatch, GBDT's knob resolution and the census/bench
+    tooling so they cannot disagree.  The final answer is this AND the
+    shape-dependent ``pallas_wave.wave_layout_fits`` (checked at trace
+    time in _grow_wave, and by GBDT for reporting).
+
+    Excluded compositions (these keep the unfused wave):
+    - any device mesh / the GSPMD mask layout: the cross-shard histogram
+      reduce (psum / reduce-scatter) lands MID-fusion, between build and
+      scan;
+    - voting: it scans compact vote-winner slices, not full histograms;
+    - EFB bundling: the scan runs in EXPANDED original-feature space
+      (bundle-offset gathers are not Mosaic-expressible);
+    - monotone constraints (any mode): the scan needs per-child output
+      bounds / the per-step refresh;
+    - forced splits: _apply_forced overwrites stored splits mid-growth;
+    - extra_trees / feature_fraction_bynode / interaction constraints:
+      per-NODE feature masks and thresholds (the kernel takes one static
+      wave-level mask);
+    - CEGB: per-child gain-penalty columns;
+    - feature_contri: static full-F multipliers stay host-resolved;
+    - sorted categoricals: the many-vs-many scan argsorts (one-hot
+      categoricals compose fine).
+
+    Under "auto" the kernel additionally engages only where the flat
+    pallas histogram is the live impl (TPU) — on CPU backends the
+    interpret-mode kernel is a test vehicle, not a win, so auto keeps the
+    unfused path and only an explicit ``tpu_wave_kernel=fused`` forces
+    it."""
+    if cfg.wave_kernel not in ("auto", "fused", "unfused"):
+        raise ValueError(
+            f"wave_kernel={cfg.wave_kernel!r}: expected auto, fused or "
+            "unfused")
+    if cfg.wave_kernel == "unfused":
+        return False
+    if mesh is not None:
+        return False
+    if not cfg.gather_rows:
+        return False
+    if cfg.voting or cfg.bundled:
+        return False
+    if cfg.forced_splits:
+        return False
+    if cfg.split.has_monotone:
+        return False
+    if cfg.split.extra_trees or cfg.feature_fraction_bynode < 1.0:
+        return False
+    if cfg.interaction_groups:
+        return False
+    if cfg.split.use_cegb or cfg.split.feature_contri:
+        return False
+    if cfg.split.has_categorical and cfg.split.use_sorted_categorical:
+        return False
+    if cfg.wave_kernel == "fused":
+        return True
+    from ..ops.histogram import resolve_impl
+    return resolve_impl(cfg.histogram_impl) in ("pallas", "flat")
 
 
 def _split_buckets(n: int) -> list:
@@ -629,6 +702,12 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
     # cache miss).  P slots replace the full (L, ...) leaf_hist carry; the
     # leaf->slot indirection lives in the growth state.
     pool_capable = pool_active_for(cfg, mesh, data_axis)
+    # ---- fused wave kernel (ops/pallas_wave.py, tpu_wave_kernel): the
+    # composition-level gate; the shape-level wave_layout_fits check runs
+    # at trace time inside _grow_wave.  Interpret mode on non-TPU backends
+    # is how tier-1 exercises the kernel body on CPU.
+    wave_fused_req = wave_fused_for(cfg, mesh, data_axis)
+    wave_interpret = jax.default_backend() != "tpu"
     _W_FRONTIER = min(cfg.leaf_batch, max(L - 1, 1))
 
     def _pool_slots(hist_cols: int) -> int:
@@ -2020,6 +2099,78 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
             return jnp.clip(jnp.searchsorted(buckets_arr, cnt, side="left"),
                             0, len(buckets) - 1).astype(jnp.int32)
 
+        # ---- fused wave kernel (ops/pallas_wave.py): composition gate
+        # resolved in make_grower (wave_fused_req), shape gate here —
+        # trace-time statics, so degrade costs nothing.
+        use_fused = wave_fused_req and axis is None and not voting
+        if use_fused:
+            from ..ops.pallas_common import C_PAD
+            from ..ops.pallas_wave import (fused_wave_call, hist_from_flat,
+                                           hist_to_flat, payload_to_best,
+                                           plane_order, wave_dtype_for,
+                                           wave_layout, wave_meta)
+            wave_dtype = wave_dtype_for(cfg)
+            _lay = wave_layout(f, HB, wave_dtype, cfg.rows_block,
+                               cfg.packed4)
+            use_fused = _lay["fits"]
+        if use_fused:
+            _w_order, _w_inv = plane_order(f, cfg.packed4)
+            wave_meta_w = wave_meta(meta[0], meta[1], meta[2], feature_mask,
+                                    features=f, num_bins=HB,
+                                    packed4=cfg.packed4)
+            wave_scale = (None if scale3 is None
+                          else jnp.pad(scale3, (0, 1))
+                          .reshape(1, 4).astype(jnp.float32))
+
+            def _fused_wave(perm, small_start, small_cnt, small_left,
+                            parent_hist, g2c, h2c, c2c, o2c, active):
+                """ONE pallas dispatch for the whole wave: gather the W
+                smaller siblings' contiguous perm segments (padded to the
+                wave's largest bucket — phantom rows hit the zero row, so
+                the accumulated values match the per-leaf buckets
+                exactly), build + subtract + scan in VMEM, and return
+                ``(hist_left, hist_right, bs)`` with the 2W-child
+                BestSplit batch in the unfused path's cat2 ordering."""
+                parent_flat = hist_to_flat(parent_hist, _lay["ftile"],
+                                           _lay["b_pad"], _w_order)
+                sl2 = jnp.broadcast_to(
+                    small_left.astype(jnp.float32)[:, None], g2c.shape)
+                act2 = jnp.broadcast_to(
+                    active.astype(jnp.float32)[:, None], g2c.shape)
+                z2 = jnp.zeros_like(g2c)
+                stats = jnp.stack([g2c, h2c, c2c, o2c, sl2, act2, z2, z2],
+                                  axis=-1)                   # (W, 2, 8)
+
+                def branch_for(S):
+                    def br(_):
+                        seg = jax.vmap(
+                            lambda s0: jax.lax.dynamic_slice(
+                                perm, (s0,), (S,)))(small_start)
+                        valid = (jnp.arange(S, dtype=jnp.int32)[None, :]
+                                 < small_cnt[:, None])
+                        seg = jnp.where(valid, seg, n)
+                        gbins = bins_pad[seg]                # (W, S, ct)
+                        gvT = jnp.transpose(
+                            jnp.pad(vals_pad[seg],
+                                    ((0, 0), (0, 0), (0, C_PAD - 3))),
+                            (0, 2, 1))                       # (W, C_PAD, S)
+                        return fused_wave_call(
+                            gbins, gvT, parent_flat, stats, wave_meta_w,
+                            wave_scale, num_bins=HB, features=f,
+                            rows_block=min(cfg.rows_block, S),
+                            dtype=wave_dtype, packed4=cfg.packed4,
+                            scfg=cfg.split, interpret=wave_interpret)
+                    return br
+
+                bi = jnp.max(jnp.where(active, _bucket_of(small_cnt), 0))
+                hist2, payload = jax.lax.switch(
+                    bi, [branch_for(S) for S in buckets], 0)
+                child = hist_from_flat(hist2, f, HB, _lay["b_pad"],
+                                       _w_inv)               # (W,2,F,HB,3)
+                bs = payload_to_best(jnp.concatenate(
+                    [payload[:, 0], payload[:, 1]], axis=0))
+                return child[:, 0], child[:, 1], bs
+
         def body(st: _GrowState) -> _GrowState:
             budget = L - st.num_leaves
             top_g, top_l = jax.lax.top_k(st.best_gain, W)
@@ -2116,34 +2267,6 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
             small_start = jnp.where(small_left, starts, starts + nl_phys)
             small_cnt = jnp.where(small_left, nl_phys, cnts - nl_phys)
 
-            def hist_one(j, hs):
-                h = jax.lax.switch(
-                    _bucket_of(small_cnt[j]), hist_branches, perm,
-                    small_start[j], small_cnt[j])
-                return hs.at[j].set(h)
-
-            hist_small = jax.lax.fori_loop(
-                0, W, hist_one,
-                jnp.zeros((W, f if cfg.packed4 else gcols, HB, 3),
-                          raw_dtype))                         # (W, G, B, 3)
-            if axis is not None and not voting:
-                # ONE cross-shard reduce per wave — integer tensors under
-                # quantized training (bin.h:48-81; int16 on the wire when
-                # the reduce-scatter overflow guard allows).  Voting mode
-                # reduces only the vote winners' slices (_vote_best_batch);
-                # reduce-scatter mode leaves each shard its owned feature
-                # block (the reference's ReduceScatter,
-                # data_parallel_tree_learner.cpp:284).
-                hist_small = (rs["scatter"](hist_small) if rs is not None
-                              else jax.lax.psum(hist_small, axis))
-
-            if not pool_on:
-                parent_hist = st.leaf_hist[top_l]
-            hist_big = parent_hist - hist_small
-            sl = small_left[:, None, None, None]
-            hist_left = jnp.where(sl, hist_small, hist_big)
-            hist_right = jnp.where(sl, hist_big, hist_small)
-
             pg = st.leaf_sum_grad[top_l]
             ph = st.leaf_sum_hess[top_l]
             pc = st.leaf_count[top_l]
@@ -2152,6 +2275,48 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
             pout = st.leaf_out[top_l]
             out_l = smoothed_output(gl, hl, cl, pout, cfg.split)
             out_r = smoothed_output(gr, hr, cr, pout, cfg.split)
+
+            if not pool_on:
+                parent_hist = st.leaf_hist[top_l]
+            fused_bs = None
+            if use_fused:
+                # ONE fused pallas dispatch for the whole wave (ISSUE-7):
+                # histogram build + sibling subtract + split scan while
+                # the (C_PAD, F*B) accumulators stay VMEM-resident.  The
+                # monotone/voting/CEGB branches below are statically off
+                # on this path (wave_fused_for).
+                hist_left, hist_right, fused_bs = _fused_wave(
+                    perm, small_start, small_cnt, small_left, parent_hist,
+                    jnp.stack([gl, gr], 1), jnp.stack([hl, hr], 1),
+                    jnp.stack([cl, cr], 1), jnp.stack([out_l, out_r], 1),
+                    active)
+            else:
+                def hist_one(j, hs):
+                    h = jax.lax.switch(
+                        _bucket_of(small_cnt[j]), hist_branches, perm,
+                        small_start[j], small_cnt[j])
+                    return hs.at[j].set(h)
+
+                hist_small = jax.lax.fori_loop(
+                    0, W, hist_one,
+                    jnp.zeros((W, f if cfg.packed4 else gcols, HB, 3),
+                              raw_dtype))                     # (W, G, B, 3)
+                if axis is not None and not voting:
+                    # ONE cross-shard reduce per wave — integer tensors
+                    # under quantized training (bin.h:48-81; int16 on the
+                    # wire when the reduce-scatter overflow guard allows).
+                    # Voting mode reduces only the vote winners' slices
+                    # (_vote_best_batch); reduce-scatter mode leaves each
+                    # shard its owned feature block (the reference's
+                    # ReduceScatter, data_parallel_tree_learner.cpp:284).
+                    hist_small = (rs["scatter"](hist_small)
+                                  if rs is not None
+                                  else jax.lax.psum(hist_small, axis))
+
+                hist_big = parent_hist - hist_small
+                sl = small_left[:, None, None, None]
+                hist_left = jnp.where(sl, hist_small, hist_big)
+                hist_right = jnp.where(sl, hist_big, hist_small)
             bounds2 = None
             if cfg.split.has_monotone and inter:
                 # Intermediate/advanced: clip to the pre-wave refreshed
@@ -2319,11 +2484,14 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
                                       groups_mat)
 
             # ---- best splits for all 2W children in one vmapped search
+            # (already computed IN the kernel on the fused path)
             node_key = None
             if need_key:
                 rng, node_key = jax.random.split(st.rng)
                 st = st._replace(rng=rng)
-            if voting:
+            if use_fused:
+                bs = fused_bs
+            elif voting:
                 bs = _vote_best_batch(
                     cat2(hist_left, hist_right), cat2(gl, gr),
                     cat2(hl, hr), cat2(cl, cr), cat2(out_l, out_r), scale3,
@@ -2679,7 +2847,10 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
                                            meta, cegb, split_key)
         elif (mesh is None and cfg.gather_rows
                 and bins.shape[0] > _MIN_BUCKET):
-            grow_fn = _grow_wave if cfg.leaf_batch > 1 else _grow_perm
+            # The fused wave kernel lives in _grow_wave; a fused-capable
+            # config routes through it even at leaf_batch=1 (a wave of 1).
+            grow_fn = (_grow_wave if (cfg.leaf_batch > 1 or wave_fused_req)
+                       else _grow_perm)
             tree, row_leaf = grow_fn(bins, vals, scale3, feature_mask,
                                      meta, cegb, split_key)
         else:
@@ -2708,6 +2879,10 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
     grow.rs_active = rs_on
     grow.pool_capable = pool_capable
     grow.pool_slots = _pool_slots
+    # Composition-level fused-wave gate (tpu_wave_kernel); the full answer
+    # ANDs the shape-level pallas_wave.wave_layout_fits (GBDT reports it
+    # as wave_fused_active, the same predicate _grow_wave traces with).
+    grow.wave_fused = wave_fused_req
     # Scan-able handle: the iteration-packed path traces grow INSIDE a
     # lax.scan body that is already under jit; the raw function skips the
     # redundant inner-jit trace (semantics identical — nested jit inlines).

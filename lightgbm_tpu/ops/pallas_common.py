@@ -1,0 +1,59 @@
+"""Shared scaffolding for the Pallas TPU kernels (histogram + fused wave).
+
+One copy of the jax-version shims and layout constants both kernels need,
+so the fused wave kernel (``ops/pallas_wave.py``) reuses the histogram
+kernel's exact compile parameters and dtype table instead of duplicating
+the rename shim (the ISSUE-7 cleanup satellite).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental.pallas import tpu as pltpu
+
+# Channels (grad, hess, count) padded; BlockSpec dim == array dim so
+# sublane alignment is not required, and 4 halves the streamed valsT bytes
+# vs a full 8-sublane tile.
+C_PAD = 4
+
+# Mosaic scoped-vmem ceiling (v5e has 128MB).
+VMEM_LIMIT = 64 * 1024 * 1024
+
+# one-hot/compute dtype -> (operand dtype, accumulator dtype, itemsize)
+DTYPES = {
+    "f32": (jnp.float32, jnp.float32, 4),
+    "bf16": (jnp.bfloat16, jnp.float32, 2),
+    "int8": (jnp.int8, jnp.int32, 1),
+}
+
+
+def compiler_params_cls():
+    """pltpu compiler-params class across the jax rename
+    (TPUCompilerParams -> CompilerParams); fails with the attribute names
+    rather than an opaque NoneType call on a third rename."""
+    cls = getattr(pltpu, "CompilerParams",
+                  getattr(pltpu, "TPUCompilerParams", None))
+    if cls is None:
+        raise AttributeError(
+            "jax.experimental.pallas.tpu exposes neither CompilerParams "
+            "nor TPUCompilerParams; unsupported jax version")
+    return cls
+
+
+def onehot_contract(bins_blk, valsT, *, num_bins, oh_dtype, acc_dtype,
+                    precision):
+    """One row-block's histogram contribution as a matmul against the
+    in-VMEM one-hot: ``(C_PAD, blk) x (blk, ft*num_bins)``.  ``num_bins``
+    is the LANE-PADDED bin count (multiple of 128) — Mosaic only supports
+    the (blk, ft, B) -> (blk, ft*B) flatten when the merged minor dim
+    stays 128-aligned.  The ONE implementation shared by the flat
+    histogram kernel and the fused wave kernel, so their accumulation is
+    op-for-op identical."""
+    blk, ft = bins_blk.shape
+    iota_b = jax.lax.broadcasted_iota(jnp.int32, (blk, ft, num_bins), 2)
+    oh = (bins_blk[:, :, None] == iota_b).astype(oh_dtype)
+    oh = oh.reshape(blk, ft * num_bins)             # lane-aligned merge
+    return jax.lax.dot_general(
+        valsT, oh, dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=acc_dtype, precision=precision)

@@ -39,29 +39,14 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-C_PAD = 4  # channels (grad, hess, count) padded; BlockSpec dim == array dim
-           # so sublane alignment is not required, and 4 halves the streamed
-           # valsT bytes vs a full 8-sublane tile.
-_VMEM_LIMIT = 64 * 1024 * 1024  # Mosaic scoped-vmem ceiling (v5e has 128MB)
-
-
-def _compiler_params_cls():
-    """pltpu compiler-params class across the jax rename
-    (TPUCompilerParams -> CompilerParams); fails with the attribute names
-    rather than an opaque NoneType call on a third rename."""
-    cls = getattr(pltpu, "CompilerParams",
-                  getattr(pltpu, "TPUCompilerParams", None))
-    if cls is None:
-        raise AttributeError(
-            "jax.experimental.pallas.tpu exposes neither CompilerParams "
-            "nor TPUCompilerParams; unsupported jax version")
-    return cls
-
-_DTYPES = {
-    "f32": (jnp.float32, jnp.float32, 4),
-    "bf16": (jnp.bfloat16, jnp.float32, 2),
-    "int8": (jnp.int8, jnp.int32, 1),
-}
+# Shared kernel scaffolding (ops/pallas_common.py): the fused wave kernel
+# reuses the SAME compile-params shim / dtype table / one-hot contraction,
+# so the two kernels cannot drift apart.  The old private names stay as
+# aliases for back-compat with external callers/tests.
+from .pallas_common import (C_PAD, DTYPES as _DTYPES,
+                            VMEM_LIMIT as _VMEM_LIMIT,
+                            compiler_params_cls as _compiler_params_cls,
+                            onehot_contract)
 
 
 def _pick_tiles(f: int, num_bins: int, itemsize: int, rows_block: int,
@@ -153,18 +138,13 @@ def _flat_kernel(bins_ref, valsT_ref, out_ref, *, num_bins, ftile,
 
     bins_blk = bins_ref[:].astype(jnp.int32)            # (blk, ct)
     valsT = valsT_ref[:]                                # (C_PAD, blk)
-    blk = bins_blk.shape[0]
     if oh_dtype != valsT.dtype:
         valsT = valsT.astype(oh_dtype)
 
     def contract(b2d):
-        ft = b2d.shape[1]
-        iota_b = jax.lax.broadcasted_iota(jnp.int32, (blk, ft, num_bins), 2)
-        oh = (b2d[:, :, None] == iota_b).astype(oh_dtype)
-        oh = oh.reshape(blk, ft * num_bins)             # lane-aligned merge
-        return jax.lax.dot_general(
-            valsT, oh, dimension_numbers=(((1,), (0,)), ((), ())),
-            preferred_element_type=acc_dtype, precision=precision)
+        return onehot_contract(b2d, valsT, num_bins=num_bins,
+                               oh_dtype=oh_dtype, acc_dtype=acc_dtype,
+                               precision=precision)
 
     if packed4:
         # 4-bit mode: the streamed tile carries two features per byte
@@ -243,10 +223,6 @@ def histogram_flat(
     return jnp.transpose(out, (1, 2, 0))
 
 
-# Backwards-compatible name: the per-feature-loop kernel is superseded by the
-# flat formulation; histogram_pallas now routes to it.
-@functools.partial(jax.jit,
-                   static_argnames=("num_bins", "rows_block", "interpret"))
 def histogram_pallas(
     bins: jnp.ndarray,
     vals: jnp.ndarray,
@@ -255,6 +231,9 @@ def histogram_pallas(
     rows_block: int = 0,
     interpret: bool = False,
 ) -> jnp.ndarray:
+    """Backwards-compatible name for the f32 flat-matmul kernel.  A plain
+    alias (no decorator): ``histogram_flat`` is already jitted, and the old
+    ``jax.jit``-of-``jax.jit`` wrapper only added a second trace level."""
     return histogram_flat(bins, vals, num_bins=num_bins,
                           rows_block=rows_block, dtype="f32",
                           interpret=interpret)

@@ -303,6 +303,319 @@ def _resolve_tile(scan_tile: int, f: int) -> int:
     return 128 if f > 256 else 0
 
 
+class _ScanTables(NamedTuple):
+    """Candidate tables of one (F, B) scan block — everything the argmax
+    selection and the sorted-categorical merge consume.  Produced by
+    :func:`scan_tables`, the half of the split scan that is callable from
+    INSIDE a Pallas kernel (ops/pallas_wave.py): pure elementwise/cumsum
+    arithmetic over (F, B) blocks — no argsort, no dynamic indexing."""
+
+    gain_fb: jnp.ndarray           # (F, B) masked candidate gains
+    num_default_left: jnp.ndarray  # (F, B) bool NaN direction of num. wins
+    stats_mr: tuple                # 6x (F, B) child stats, NaN -> right
+    stats_ml: tuple                # 6x (F, B) child stats, NaN -> left
+    cat_stats: tuple               # 6x (F, B) child stats, one-hot cat.
+    parent_gain: jnp.ndarray       # scalar parent gain shift
+    parent_output: jnp.ndarray     # scalar resolved parent output
+    in_feature: jnp.ndarray        # (F, B) bool valid-bin mask
+    sorted_eligible: Optional[jnp.ndarray]  # (F, 1) sorted-cat eligibility
+    penalty_col: Optional[jnp.ndarray]      # (F, 1) CEGB penalty column
+    min_count: float
+
+
+def _col(a):
+    """Per-feature vector as an (F, 1) column.  The host paths pass (F,)
+    vectors; the Pallas kernel passes (F, 1) columns (Mosaic dislikes 1D
+    operands and lane-dim transposes), and broadcasting against (F, B)
+    blocks is identical either way."""
+    return a if a.ndim == 2 else a[:, None]
+
+
+def scan_tables(
+    G: jnp.ndarray,               # (F, B) grad sums (f32, scaled)
+    H: jnp.ndarray,               # (F, B) hess sums
+    C: jnp.ndarray,               # (F, B) counts
+    parent_grad: jnp.ndarray,     # scalar ΣG over the leaf (incl. NaN bin)
+    parent_hess: jnp.ndarray,     # scalar ΣH
+    parent_count: jnp.ndarray,    # scalar rows
+    *,
+    num_bins_per_feature: jnp.ndarray,  # (F,)/(F,1) i32 (incl. NaN bin)
+    nan_bins: jnp.ndarray,              # (F,)/(F,1) i32; == B when no NaN bin
+    is_categorical: jnp.ndarray,        # (F,)/(F,1) bool
+    feature_mask: jnp.ndarray,          # (F,)/(F,1) bool
+    cfg: SplitConfig,
+    monotone: jnp.ndarray | None = None,       # (F,) i32 in {-1,0,1}
+    gain_penalty: jnp.ndarray | None = None,   # (F,) CEGB DeltaGain
+    parent_output: jnp.ndarray | None = None,  # scalar (path_smooth anchor)
+    rand_bins: jnp.ndarray | None = None,      # (F,) i32 (extra_trees)
+    out_lo: jnp.ndarray | None = None,         # scalar monotone lower bound
+    out_hi: jnp.ndarray | None = None,         # scalar monotone upper bound
+    adv_bounds: tuple | None = None,           # advanced monotone (F, B) x4
+    leaf_depth: jnp.ndarray | None = None,     # scalar (monotone_penalty)
+    feature_contri: jnp.ndarray | None = None,  # (F,) f32 gain multipliers
+) -> _ScanTables:
+    """Evaluate every (feature, threshold, missing-direction) candidate of
+    one (F, B) histogram block into masked gain/stat tables.  Phantom bins
+    (``bin >= num_bins_per_feature[f]``, e.g. the fused kernel's
+    lane-padded columns) are masked to ``-inf`` so a wider B never changes
+    the candidate set."""
+    f, b = G.shape
+    nbpf_c = _col(num_bins_per_feature)
+    nanb_c = _col(nan_bins)
+    fmask_c = _col(feature_mask)
+    biota = jax.lax.broadcasted_iota(jnp.int32, (f, b), 1)
+    in_feature = biota < nbpf_c
+    nan_pos = biota == nanb_c
+    value_mask = in_feature & ~nan_pos
+    if parent_output is None:
+        parent_output = leaf_output(parent_grad, parent_hess, cfg)
+
+    Gv = jnp.where(value_mask, G, 0.0)
+    Hv = jnp.where(value_mask, H, 0.0)
+    Cv = jnp.where(value_mask, C, 0.0)
+    Gn = jnp.sum(jnp.where(nan_pos, G, 0.0), axis=1, keepdims=True)  # (F,1)
+    Hn = jnp.sum(jnp.where(nan_pos, H, 0.0), axis=1, keepdims=True)
+    Cn = jnp.sum(jnp.where(nan_pos, C, 0.0), axis=1, keepdims=True)
+
+    cumG = jnp.cumsum(Gv, axis=1)
+    cumH = jnp.cumsum(Hv, axis=1)
+    cumC = jnp.cumsum(Cv, axis=1)
+
+    # Parent gain shift: closed form without smoothing, output-based with
+    # (reference BeforeNumerical / FindBestThresholdCategoricalInner).
+    if cfg.path_smooth > 0.0:
+        parent_gain = gain_given_output(parent_grad, parent_hess,
+                                        parent_output, cfg)
+    else:
+        parent_gain = leaf_gain(parent_grad, parent_hess, cfg)
+    min_count = float(max(cfg.min_data_in_leaf, 1))
+
+    mono_bounds = (out_lo is not None and out_hi is not None
+                   and cfg.has_monotone)
+    blo = out_lo if mono_bounds else None
+    bhi = out_hi if mono_bounds else None
+    # Advanced monotone mode (reference AdvancedLeafConstraints,
+    # monotone_constraints.hpp:583): numerical candidates clip each child to
+    # its PER-THRESHOLD bound slice instead of the whole-leaf scalar;
+    # categorical columns (not covered by the reference's slice machinery
+    # either) fall back to the scalar leaf bounds.
+    use_adv = adv_bounds is not None and cfg.has_monotone
+    if use_adv:
+        icc0 = _col(is_categorical)
+        s_lo = blo if mono_bounds else -jnp.inf
+        s_hi = bhi if mono_bounds else jnp.inf
+        a_llo = jnp.where(icc0, s_lo, adv_bounds[0])
+        a_lhi = jnp.where(icc0, s_hi, adv_bounds[1])
+        a_rlo = jnp.where(icc0, s_lo, adv_bounds[2])
+        a_rhi = jnp.where(icc0, s_hi, adv_bounds[3])
+        num_lb, num_rb = (a_llo, a_lhi), (a_rlo, a_rhi)
+    else:
+        num_lb = num_rb = None
+
+    def eval_dir(GL, HL, CL, l2_extra=0.0, lb=None, rb=None):
+        GR = parent_grad - GL
+        HR = parent_hess - HL
+        CR = parent_count - CL
+        valid = (
+            (CL >= min_count)
+            & (CR >= min_count)
+            & (HL >= cfg.min_sum_hessian_in_leaf)
+            & (HR >= cfg.min_sum_hessian_in_leaf)
+        )
+        llo, lhi = lb if lb is not None else (blo, bhi)
+        rlo, rhi = rb if rb is not None else (blo, bhi)
+        gain = (child_gain(GL, HL, CL, parent_output, cfg, l2_extra, llo, lhi)
+                + child_gain(GR, HR, CR, parent_output, cfg, l2_extra,
+                             rlo, rhi)
+                - parent_gain)
+        gain = jnp.where(valid & (gain > cfg.min_gain_to_split + _EPS), gain, -jnp.inf)
+        return gain, (GL, HL, CL, GR, HR, CR)
+
+    # Numerical: threshold t means "value-bin <= t goes left".
+    gain_mr, stats_mr = eval_dir(cumG, cumH, cumC,
+                                 lb=num_lb, rb=num_rb)                # NaN -> right
+    if cfg.has_nan:
+        gain_ml, stats_ml = eval_dir(cumG + Gn, cumH + Hn, cumC + Cn,
+                                     lb=num_lb, rb=num_rb)            # NaN -> left
+        # Without a NaN bin both directions coincide; keep missing-right.
+        has_nan = nanb_c < b
+        gain_ml = jnp.where(has_nan, gain_ml, -jnp.inf)
+        num_gain = jnp.maximum(gain_mr, gain_ml)
+        num_default_left = gain_ml > gain_mr
+    else:
+        stats_ml = stats_mr
+        num_gain = gain_mr
+        num_default_left = jnp.zeros_like(gain_mr, bool)
+    num_gain = jnp.where(value_mask, num_gain, -jnp.inf)
+
+    if cfg.has_categorical:
+        # One-hot categorical: "bin == k goes left" (reference one-hot branch
+        # of FindBestThresholdCategoricalInner — plain lambda_l2, not cat_l2,
+        # which only applies in the sorted branch).
+        cat_gain, cat_stats = eval_dir(G, H, C)
+        cat_gain = jnp.where(in_feature, cat_gain, -jnp.inf)
+        # Sorted features are excluded from the one-hot table; they compete
+        # through the per-feature sorted scan merged by the caller.
+        sorted_eligible = (_col(is_categorical)
+                           & (nbpf_c > cfg.max_cat_to_onehot))
+        is_cat_col = _col(is_categorical)
+        gain_fb = jnp.where(is_cat_col, cat_gain, num_gain)
+        gain_fb = jnp.where(sorted_eligible, -jnp.inf, gain_fb)
+    else:
+        cat_stats = stats_mr
+        sorted_eligible = None
+        is_cat_col = jnp.zeros((f, 1), bool)
+        gain_fb = num_gain
+
+    if rand_bins is not None and cfg.extra_trees:
+        # extra_trees (reference USE_RAND scans): one random threshold per
+        # (node, feature); all other candidates are masked out.
+        gain_fb = jnp.where(biota == _col(rand_bins), gain_fb, -jnp.inf)
+
+    if monotone is not None and cfg.has_monotone:
+        # Basic monotone mode: reject splits whose child outputs violate the
+        # direction (reference monotone_constraints.hpp BasicLeafConstraints).
+        GLm = jnp.where(is_cat_col, cat_stats[0], jnp.where(num_default_left,
+                        stats_ml[0], stats_mr[0]))
+        HLm = jnp.where(is_cat_col, cat_stats[1], jnp.where(num_default_left,
+                        stats_ml[1], stats_mr[1]))
+        GRm = parent_grad - GLm
+        HRm = parent_hess - HLm
+        out_l = leaf_output(GLm, HLm, cfg)
+        out_r = leaf_output(GRm, HRm, cfg)
+        if use_adv:
+            out_l = jnp.clip(out_l, a_llo, a_lhi)
+            out_r = jnp.clip(out_r, a_rlo, a_rhi)
+        elif mono_bounds:
+            out_l = jnp.clip(out_l, blo, bhi)
+            out_r = jnp.clip(out_r, blo, bhi)
+        mono = _col(monotone)
+        viol = ((mono > 0) & (out_l > out_r)) | ((mono < 0) & (out_l < out_r))
+        gain_fb = jnp.where(viol, -jnp.inf, gain_fb)
+        if cfg.monotone_penalty > 0.0 and leaf_depth is not None:
+            # reference ComputeMonotoneSplitGainPenalty
+            # (monotone_constraints.hpp:357): multiplies the gain of splits
+            # on monotone features, fading with depth.
+            p = cfg.monotone_penalty
+            d = leaf_depth.astype(jnp.float32)
+            pen = jnp.where(
+                p >= d + 1.0, _EPS,
+                jnp.where(p <= 1.0, 1.0 - p / (2.0 ** d) + _EPS,
+                          1.0 - 2.0 ** (p - 1.0 - d) + _EPS))
+            gain_fb = jnp.where(mono != 0, gain_fb * pen, gain_fb)
+
+    penalty_col = None
+    if gain_penalty is not None and cfg.use_cegb:
+        penalty_col = _col(gain_penalty)
+        gain_fb = gain_fb - penalty_col
+        # Penalized gains that drop to <= 0 are no longer worth splitting
+        # (reference stops on "gain <= 0").
+        gain_fb = jnp.where(gain_fb > _EPS, gain_fb, -jnp.inf)
+
+    if feature_contri is not None:
+        scaled = gain_fb * _col(feature_contri)
+        # reference stops on best gain <= 0: a zeroed-out feature must not
+        # win over "no split"
+        gain_fb = jnp.where(jnp.isfinite(gain_fb) & (scaled > _EPS),
+                            scaled, -jnp.inf)
+    gain_fb = jnp.where(fmask_c, gain_fb, -jnp.inf)
+
+    return _ScanTables(
+        gain_fb=gain_fb, num_default_left=num_default_left,
+        stats_mr=stats_mr, stats_ml=stats_ml, cat_stats=cat_stats,
+        parent_gain=parent_gain, parent_output=parent_output,
+        in_feature=in_feature, sorted_eligible=sorted_eligible,
+        penalty_col=penalty_col, min_count=min_count)
+
+
+def _select_from_tables(t: _ScanTables, is_categorical, cfg: SplitConfig
+                        ) -> BestSplit:
+    """Argmax + winner-stat gather over the scan tables (the host half):
+    lowest flat (feature, bin) index wins ties — the tie-break every other
+    reducer in the framework replays.  Must stay selection-identical to
+    :func:`select_payload` (the Pallas-safe one-hot variant; pinned in
+    tests/test_wave_fused.py)."""
+    gain_fb = t.gain_fb
+    f, b = gain_fb.shape
+    flat = jnp.argmax(gain_fb)
+    bf = (flat // b).astype(jnp.int32)
+    bb = (flat % b).astype(jnp.int32)
+    bgain = gain_fb[bf, bb]
+    bis_cat = (is_categorical[bf] if cfg.has_categorical
+               else jnp.asarray(False))
+    bdefault_left = jnp.where(bis_cat, False, t.num_default_left[bf, bb])
+
+    def pick(stats_cat, stats_numl, stats_numr, i):
+        return jnp.where(
+            bis_cat, stats_cat[i][bf, bb],
+            jnp.where(bdefault_left, stats_numl[i][bf, bb], stats_numr[i][bf, bb]),
+        )
+
+    GL, HL, CL, GR, HR, CR = (pick(t.cat_stats, t.stats_ml, t.stats_mr, i)
+                              for i in range(6))
+    cat_mask = (jnp.arange(b, dtype=jnp.int32) == bb) & bis_cat
+
+    return BestSplit(
+        gain=bgain, feature=bf, bin=bb,
+        default_left=bdefault_left, is_cat=bis_cat, cat_mask=cat_mask,
+        sum_grad_left=GL, sum_hess_left=HL, count_left=CL,
+        sum_grad_right=GR, sum_hess_right=HR, count_right=CR,
+    )
+
+
+def select_payload(t: _ScanTables, is_categorical, cfg: SplitConfig, *,
+                   flat_keys=None, key_bins: int = 0):
+    """Mosaic-safe winner selection: the same max-gain / lowest-flat-key
+    tie-break as :func:`_select_from_tables`'s ``argmax``, expressed as a
+    full-block max + one-hot masked gathers (no dynamic indexing, which
+    Pallas TPU kernels cannot lower).  The extracted values are exact —
+    each gather sums exactly one selected element.
+
+    ``flat_keys`` (int32, same shape as the gain table) assigns every
+    candidate its tie-break priority; lower wins.  The default row-major
+    ``feat * B + bin`` reproduces ``argmax`` exactly; the fused kernel's
+    packed4 path passes ORIGINAL-feature-order keys so the nibble-plane
+    layout cannot perturb the tie-break.  Candidates keyed ``INT32_MAX``
+    (phantom lane-padding) can win only if every real candidate is also
+    ``-inf`` — and every real key < INT32_MAX, so they never do.
+
+    Returns the scalar tuple ``(gain, feature, bin, default_left, is_cat,
+    GL, HL, CL, GR, HR, CR)`` with feature/bin decoded through
+    ``key_bins`` (defaults to the table width)."""
+    gain_fb = t.gain_fb
+    f, b = gain_fb.shape
+    key_bins = key_bins or b
+    if flat_keys is None:
+        flat_keys = (jax.lax.broadcasted_iota(jnp.int32, (f, b), 0) * b
+                     + jax.lax.broadcasted_iota(jnp.int32, (f, b), 1))
+    imax = jnp.iinfo(jnp.int32).max
+    mx = jnp.max(gain_fb)
+    tie = gain_fb == mx
+    kwin = jnp.min(jnp.where(tie, flat_keys, imax))
+    sel = tie & (flat_keys == kwin)
+    bf = (kwin // key_bins).astype(jnp.int32)
+    bb = (kwin % key_bins).astype(jnp.int32)
+    bgain = jnp.max(jnp.where(sel, gain_fb, -jnp.inf))
+    if cfg.has_categorical:
+        bis_cat = jnp.any(sel & _col(is_categorical))
+    else:
+        bis_cat = jnp.asarray(False)
+    bdefault_left = jnp.where(bis_cat, False,
+                              jnp.any(sel & t.num_default_left))
+
+    def take(a):
+        return jnp.sum(jnp.where(sel, a, 0.0))
+
+    def pick(i):
+        return jnp.where(
+            bis_cat, take(t.cat_stats[i]),
+            jnp.where(bdefault_left, take(t.stats_ml[i]),
+                      take(t.stats_mr[i])))
+
+    GL, HL, CL, GR, HR, CR = (pick(i) for i in range(6))
+    return bgain, bf, bb, bdefault_left, bis_cat, GL, HL, CL, GR, HR, CR
+
+
 def _best_split_impl(
     hist: jnp.ndarray,            # (F, B, 3) leaf histogram
     parent_grad: jnp.ndarray,     # scalar ΣG over the leaf (includes NaN bin)
@@ -341,200 +654,28 @@ def _best_split_impl(
     reducer needs it to reproduce the untiled "sorted wins only strictly"
     rule — and ``fg`` is the per-feature gain vector (None unless
     ``with_feature_gains``)."""
-    f, b, _ = hist.shape
     G, H, C = hist[..., 0], hist[..., 1], hist[..., 2]
-    biota = jnp.arange(b, dtype=jnp.int32)[None, :]
-    in_feature = biota < num_bins_per_feature[:, None]
-    nan_pos = biota == nan_bins[:, None]
-    value_mask = in_feature & ~nan_pos
-    if parent_output is None:
-        parent_output = leaf_output(parent_grad, parent_hess, cfg)
-
-    Gv = jnp.where(value_mask, G, 0.0)
-    Hv = jnp.where(value_mask, H, 0.0)
-    Cv = jnp.where(value_mask, C, 0.0)
-    Gn = jnp.sum(jnp.where(nan_pos, G, 0.0), axis=1)  # (F,)
-    Hn = jnp.sum(jnp.where(nan_pos, H, 0.0), axis=1)
-    Cn = jnp.sum(jnp.where(nan_pos, C, 0.0), axis=1)
-
-    cumG = jnp.cumsum(Gv, axis=1)
-    cumH = jnp.cumsum(Hv, axis=1)
-    cumC = jnp.cumsum(Cv, axis=1)
-
-    # Parent gain shift: closed form without smoothing, output-based with
-    # (reference BeforeNumerical / FindBestThresholdCategoricalInner).
-    if cfg.path_smooth > 0.0:
-        parent_gain = gain_given_output(parent_grad, parent_hess,
-                                        parent_output, cfg)
-    else:
-        parent_gain = leaf_gain(parent_grad, parent_hess, cfg)
-    min_count = float(max(cfg.min_data_in_leaf, 1))
-
-    mono_bounds = (out_lo is not None and out_hi is not None
-                   and cfg.has_monotone)
-    blo = out_lo if mono_bounds else None
-    bhi = out_hi if mono_bounds else None
-    # Advanced monotone mode (reference AdvancedLeafConstraints,
-    # monotone_constraints.hpp:583): numerical candidates clip each child to
-    # its PER-THRESHOLD bound slice instead of the whole-leaf scalar;
-    # categorical columns (not covered by the reference's slice machinery
-    # either) fall back to the scalar leaf bounds.
-    use_adv = adv_bounds is not None and cfg.has_monotone
-    if use_adv:
-        icc0 = is_categorical[:, None]
-        s_lo = blo if mono_bounds else -jnp.inf
-        s_hi = bhi if mono_bounds else jnp.inf
-        a_llo = jnp.where(icc0, s_lo, adv_bounds[0])
-        a_lhi = jnp.where(icc0, s_hi, adv_bounds[1])
-        a_rlo = jnp.where(icc0, s_lo, adv_bounds[2])
-        a_rhi = jnp.where(icc0, s_hi, adv_bounds[3])
-        num_lb, num_rb = (a_llo, a_lhi), (a_rlo, a_rhi)
-    else:
-        num_lb = num_rb = None
-
-    def eval_dir(GL, HL, CL, l2_extra=0.0, lb=None, rb=None):
-        GR = parent_grad - GL
-        HR = parent_hess - HL
-        CR = parent_count - CL
-        valid = (
-            (CL >= min_count)
-            & (CR >= min_count)
-            & (HL >= cfg.min_sum_hessian_in_leaf)
-            & (HR >= cfg.min_sum_hessian_in_leaf)
-        )
-        llo, lhi = lb if lb is not None else (blo, bhi)
-        rlo, rhi = rb if rb is not None else (blo, bhi)
-        gain = (child_gain(GL, HL, CL, parent_output, cfg, l2_extra, llo, lhi)
-                + child_gain(GR, HR, CR, parent_output, cfg, l2_extra,
-                             rlo, rhi)
-                - parent_gain)
-        gain = jnp.where(valid & (gain > cfg.min_gain_to_split + _EPS), gain, -jnp.inf)
-        return gain, (GL, HL, CL, GR, HR, CR)
-
-    # Numerical: threshold t means "value-bin <= t goes left".
-    gain_mr, stats_mr = eval_dir(cumG, cumH, cumC,
-                                 lb=num_lb, rb=num_rb)                # NaN -> right
-    if cfg.has_nan:
-        gain_ml, stats_ml = eval_dir(cumG + Gn[:, None], cumH + Hn[:, None],
-                                     cumC + Cn[:, None],
-                                     lb=num_lb, rb=num_rb)            # NaN -> left
-        # Without a NaN bin both directions coincide; keep missing-right.
-        has_nan = (nan_bins < b)[:, None]
-        gain_ml = jnp.where(has_nan, gain_ml, -jnp.inf)
-        num_gain = jnp.maximum(gain_mr, gain_ml)
-        num_default_left = gain_ml > gain_mr
-    else:
-        stats_ml = stats_mr
-        num_gain = gain_mr
-        num_default_left = jnp.zeros_like(gain_mr, bool)
-    num_gain = jnp.where(value_mask, num_gain, -jnp.inf)
-
-    if cfg.has_categorical:
-        # One-hot categorical: "bin == k goes left" (reference one-hot branch
-        # of FindBestThresholdCategoricalInner — plain lambda_l2, not cat_l2,
-        # which only applies in the sorted branch).
-        cat_gain, cat_stats = eval_dir(G, H, C)
-        cat_gain = jnp.where(in_feature, cat_gain, -jnp.inf)
-        # Sorted features are excluded from the one-hot table; they compete
-        # through the per-feature sorted scan below.
-        sorted_eligible = (is_categorical
-                           & (num_bins_per_feature > cfg.max_cat_to_onehot))
-        is_cat_col = is_categorical[:, None]
-        gain_fb = jnp.where(is_cat_col, cat_gain, num_gain)
-        gain_fb = jnp.where(sorted_eligible[:, None], -jnp.inf, gain_fb)
-    else:
-        cat_stats = stats_mr
-        sorted_eligible = None
-        is_cat_col = jnp.zeros_like(is_categorical, bool)[:, None]
-        gain_fb = num_gain
-
-    if rand_bins is not None and cfg.extra_trees:
-        # extra_trees (reference USE_RAND scans): one random threshold per
-        # (node, feature); all other candidates are masked out.
-        gain_fb = jnp.where(biota == rand_bins[:, None], gain_fb, -jnp.inf)
-
-    if monotone is not None and cfg.has_monotone:
-        # Basic monotone mode: reject splits whose child outputs violate the
-        # direction (reference monotone_constraints.hpp BasicLeafConstraints).
-        GLm = jnp.where(is_cat_col, cat_stats[0], jnp.where(num_default_left,
-                        stats_ml[0], stats_mr[0]))
-        HLm = jnp.where(is_cat_col, cat_stats[1], jnp.where(num_default_left,
-                        stats_ml[1], stats_mr[1]))
-        GRm = parent_grad - GLm
-        HRm = parent_hess - HLm
-        out_l = leaf_output(GLm, HLm, cfg)
-        out_r = leaf_output(GRm, HRm, cfg)
-        if use_adv:
-            out_l = jnp.clip(out_l, a_llo, a_lhi)
-            out_r = jnp.clip(out_r, a_rlo, a_rhi)
-        elif mono_bounds:
-            out_l = jnp.clip(out_l, blo, bhi)
-            out_r = jnp.clip(out_r, blo, bhi)
-        mono = monotone[:, None]
-        viol = ((mono > 0) & (out_l > out_r)) | ((mono < 0) & (out_l < out_r))
-        gain_fb = jnp.where(viol, -jnp.inf, gain_fb)
-        if cfg.monotone_penalty > 0.0 and leaf_depth is not None:
-            # reference ComputeMonotoneSplitGainPenalty
-            # (monotone_constraints.hpp:357): multiplies the gain of splits
-            # on monotone features, fading with depth.
-            p = cfg.monotone_penalty
-            d = leaf_depth.astype(jnp.float32)
-            pen = jnp.where(
-                p >= d + 1.0, _EPS,
-                jnp.where(p <= 1.0, 1.0 - p / (2.0 ** d) + _EPS,
-                          1.0 - 2.0 ** (p - 1.0 - d) + _EPS))
-            gain_fb = jnp.where(mono != 0, gain_fb * pen, gain_fb)
-
-    penalty_col = None
-    if gain_penalty is not None and cfg.use_cegb:
-        penalty_col = gain_penalty[:, None]
-        gain_fb = gain_fb - penalty_col
-        # Penalized gains that drop to <= 0 are no longer worth splitting
-        # (reference stops on "gain <= 0").
-        gain_fb = jnp.where(gain_fb > _EPS, gain_fb, -jnp.inf)
-
-    if feature_contri is not None:
-        scaled = gain_fb * feature_contri[:, None]
-        # reference stops on best gain <= 0: a zeroed-out feature must not
-        # win over "no split"
-        gain_fb = jnp.where(jnp.isfinite(gain_fb) & (scaled > _EPS),
-                            scaled, -jnp.inf)
-    gain_fb = jnp.where(feature_mask[:, None], gain_fb, -jnp.inf)
-
-    flat = jnp.argmax(gain_fb)
-    bf = (flat // b).astype(jnp.int32)
-    bb = (flat % b).astype(jnp.int32)
-    bgain = gain_fb[bf, bb]
-    bis_cat = (is_categorical[bf] if cfg.has_categorical
-               else jnp.asarray(False))
-    bdefault_left = jnp.where(bis_cat, False, num_default_left[bf, bb])
-
-    def pick(stats_cat, stats_numl, stats_numr, i):
-        return jnp.where(
-            bis_cat, stats_cat[i][bf, bb],
-            jnp.where(bdefault_left, stats_numl[i][bf, bb], stats_numr[i][bf, bb]),
-        )
-
-    GL, HL, CL, GR, HR, CR = (pick(cat_stats, stats_ml, stats_mr, i) for i in range(6))
-    cat_mask = (jnp.arange(b, dtype=jnp.int32) == bb) & bis_cat
-
-    best = BestSplit(
-        gain=bgain, feature=bf, bin=bb,
-        default_left=bdefault_left, is_cat=bis_cat, cat_mask=cat_mask,
-        sum_grad_left=GL, sum_hess_left=HL, count_left=CL,
-        sum_grad_right=GR, sum_hess_right=HR, count_right=CR,
-    )
+    t = scan_tables(
+        G, H, C, parent_grad, parent_hess, parent_count,
+        num_bins_per_feature=num_bins_per_feature, nan_bins=nan_bins,
+        is_categorical=is_categorical, feature_mask=feature_mask, cfg=cfg,
+        monotone=monotone, gain_penalty=gain_penalty,
+        parent_output=parent_output, rand_bins=rand_bins,
+        out_lo=out_lo, out_hi=out_hi, adv_bounds=adv_bounds,
+        leaf_depth=leaf_depth, feature_contri=feature_contri)
+    best = _select_from_tables(t, is_categorical, cfg)
 
     from_sorted = jnp.asarray(False)
     if cfg.has_categorical and cfg.use_sorted_categorical:
         best, from_sorted = _merge_sorted_categorical(
             best, G, H, C, parent_grad, parent_hess, parent_count,
-            parent_output, parent_gain, in_feature, sorted_eligible,
-            feature_mask, penalty_col, cfg, min_count,
-            rand_bins if cfg.extra_trees else None, feature_contri)
+            t.parent_output, t.parent_gain, t.in_feature,
+            t.sorted_eligible[:, 0], feature_mask, t.penalty_col, cfg,
+            t.min_count, rand_bins if cfg.extra_trees else None,
+            feature_contri)
     fg = None
     if with_feature_gains:
-        fg = jnp.max(gain_fb, axis=1)
+        fg = jnp.max(t.gain_fb, axis=1)
         # NOTE: sorted-categorical gains are not folded into the vote — the
         # vote only ranks features, and one-hot gains rank the same columns.
     return best, from_sorted, fg

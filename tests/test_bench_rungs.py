@@ -13,7 +13,17 @@ import jax
 import pytest
 
 from bench import (_load_watchdog, _probe_backend, _probe_block,
-                   run_goss_rung, run_ltr_rung, run_wide_rung)
+                   run_fused_rung, run_goss_rung, run_ltr_rung,
+                   run_wide_rung)
+
+
+def _assert_hlo_cost(blob):
+    """Every rung blob carries the XLA cost-model block (ISSUE-7
+    satellite: detail.hlo_cost — the compile-time number kernel PRs land
+    with even when no chip answers)."""
+    cost = blob["hlo_cost"]
+    assert cost.get("flops", 0) > 0, cost
+    assert cost.get("bytes_accessed", 0) > 0, cost
 
 
 def test_ltr_rung_blob():
@@ -23,6 +33,22 @@ def test_ltr_rung_blob():
     assert blob["queries"] == 70
     assert blob["row_iters_per_sec"] > 0
     assert 0.0 <= blob["ndcg5_train_sample"] <= 1.0
+    _assert_hlo_cost(blob)
+
+
+def test_fused_rung_blob_one_dispatch_per_wave():
+    """The quantized-fused rung (ISSUE-7): tpu_wave_kernel=fused engages
+    (interpret mode on CPU — the kernel body actually runs), the census
+    fact says one histogram dispatch per wave, and the blob carries the
+    compile-time cost block."""
+    blob = run_fused_rung(4096, 2, "cpu", jax, features=10, num_leaves=15)
+    assert blob["rows"] == 4096 and blob["quantized"] is True
+    assert blob["wave_kernel"] == "fused"
+    assert blob["wave_fused_active"] is True
+    assert blob["hist_dispatches_per_wave"] == 1
+    assert blob["interpret_mode"] is True
+    assert blob["row_iters_per_sec"] > 0
+    _assert_hlo_cost(blob)
 
 
 def test_wide_rung_blob_pool_engaged():
@@ -36,6 +62,7 @@ def test_wide_rung_blob_pool_engaged():
     assert blob["pool_engaged"] is True
     assert blob["pool_slots"] < 31
     assert blob["leaf_hist_mb_pooled"] < blob["leaf_hist_mb_unpooled"]
+    _assert_hlo_cost(blob)
 
 
 def test_goss_rung_blob_one_dispatch():
@@ -48,6 +75,7 @@ def test_goss_rung_blob_one_dispatch():
     assert blob["used_fused"] is True
     assert blob["dispatches_per_iter"] == 1.0
     assert blob["host_syncs_per_iter"] <= 2.0
+    _assert_hlo_cost(blob)
 
 
 # --------------------------- watchdog probe block (ISSUE-6 satellite) ----

@@ -291,6 +291,43 @@ def test_comm_ratio_unpadded_and_int16_wire():
     assert re.search(r"s32\[[0-9,]*\][^=]*reduce-scatter", quant)
 
 
+def test_fused_wave_no_hbm_scan_roundtrip():
+    """ISSUE-7 structural pin: the fused wave program must not round-trip
+    the batched child histograms through HBM between build and scan.  The
+    unfused wave feeds all 2W children's (F, B) cumsum/gain tables through
+    a vmapped best_split — the (2W, F, B) f32 scan buffers are its
+    signature shape; the fused program scans per leaf INSIDE the kernel
+    (interpret mode inlines it as per-grid-step (F, b_pad) blocks), so no
+    wave-batched scan tensor may exist anywhere in the compiled text."""
+    NW, FW, BW, LW, WW = 4096, 12, 64, 63, 8
+    scfg = G.SplitConfig(has_nan=False, has_categorical=False,
+                         use_sorted_categorical=False, has_monotone=False,
+                         min_data_in_leaf=1)
+    rng = np.random.RandomState(0)
+    bins = jnp.asarray(rng.randint(0, BW, (NW, FW)).astype(np.uint8))
+    args = [bins, jnp.zeros(NW, jnp.float32), jnp.ones(NW, jnp.float32),
+            jnp.ones(NW, jnp.float32), jnp.ones(FW, bool),
+            jnp.full(FW, BW, jnp.int32), jnp.full(FW, BW, jnp.int32),
+            jnp.zeros(FW, bool), jnp.zeros(FW, jnp.int32)]
+
+    def compile_txt(mode):
+        gcfg = G.GrowerConfig(num_leaves=LW, num_bins=BW, split=scfg,
+                              leaf_batch=WW, wave_kernel=mode)
+        grow = G.make_grower(gcfg)
+        assert grow.wave_fused == (mode == "fused")
+        return grow.lower(*args).compile().as_text()
+
+    fused, unfused = compile_txt("fused"), compile_txt("unfused")
+    scan_buf = f"f32[{2 * WW},{FW},{BW}]"
+    assert scan_buf in unfused, "unfused signature shape missing"
+    assert scan_buf not in fused, (
+        "fused wave program materializes the batched HBM scan tensor")
+    # the unfused build batches all W smaller siblings into one HBM
+    # tensor; the fused kernel accumulates per leaf in VMEM, so the only
+    # wave-batched histogram left is the (W, 2, ...) child writeback
+    assert f"f32[{WW},{FW},{BW},3]" in unfused
+
+
 def test_program_flops_bounded(hlo):
     """XLA's own FLOP count for the bench-shaped program (while bodies
     counted once) must stay near the one-hot contraction's analytic cost.

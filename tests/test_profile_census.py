@@ -27,6 +27,24 @@ def test_census_fused_paths_one_dispatch():
             > blobs["goss"]["host_syncs_per_iter"])
 
 
+def test_fused_wave_census_one_dispatch_per_wave():
+    """ISSUE-7: the fused wave grower issues ONE histogram-kernel dispatch
+    per wave (leaf batches pipelined through the pallas grid); unfused
+    issues one per leaf (a W-trip fori_loop).  Either way the boosting
+    round stays ONE compiled program launch."""
+    from tools.profile_iter import fused_wave_census
+
+    blobs = {b["wave_kernel"]: b for b in fused_wave_census(
+        rows=4096, features=10, num_leaves=15, leaf_batch=4)}
+    fused, unfused = blobs["fused"], blobs["unfused"]
+    assert fused["fused_active"] is True
+    assert unfused["fused_active"] is False
+    assert fused["hist_dispatches_per_wave"] == 1
+    assert unfused["hist_dispatches_per_wave"] == 4 == unfused["leaf_batch"]
+    assert fused["dispatches_per_iter"] == 1.0
+    assert unfused["dispatches_per_iter"] == 1.0
+
+
 def test_census_linear_solve_no_per_leaf_syncs():
     """The batched linear-leaf solve: host syncs per iteration must NOT
     scale with num_leaves (the per-leaf Python solve loop pulled 6 arrays

@@ -1,0 +1,365 @@
+"""Fused wave kernel (ISSUE-7 tentpole, ``ops/pallas_wave.py``,
+``tpu_wave_kernel``): one pallas_call per wave builds the smaller-sibling
+histograms, derives the larger siblings by parent subtraction and runs the
+split scan in VMEM.
+
+Bitwise discipline mirrors tests/test_hist_pool.py: with exact-sum inputs
+(first-iteration binary gradients +-0.5 / hess 0.25) every histogram value
+is exact regardless of accumulation order, the kernel's scan is the SAME
+refactored arithmetic (``ops/split.scan_tables``) the unfused path runs,
+and the Mosaic-safe one-hot selection replays the unfused argmax's
+tie-break exactly — so fused trees pin BITWISE-identical to unfused across
+fp32 x quantized x packed4 x pooled (and EFB, where the capability gate
+degrades fused to the unfused path).  Quantized histograms are integer,
+so those pins are unconditionally exact.  All of this runs the kernel
+body in interpret mode on CPU — the tier-1 coverage the gate's
+``fused``-forces-interpret semantics exist for."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import lightgbm_tpu as lgb
+import lightgbm_tpu.models.grower as G
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.dataset import TrainData
+from lightgbm_tpu.models.gbdt import _split_config
+
+_TREE_FIELDS = ("split_feature", "split_bin", "default_left", "is_cat",
+                "left_child", "right_child", "split_gain", "leaf_value",
+                "leaf_count")
+
+
+def _assert_same_tree(t0, t1, rl0=None, rl1=None):
+    for field in _TREE_FIELDS:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(t0, field)), np.asarray(getattr(t1, field)),
+            err_msg=field)
+    assert int(t0.num_leaves) == int(t1.num_leaves)
+    if rl0 is not None:
+        np.testing.assert_array_equal(np.asarray(rl0), np.asarray(rl1))
+
+
+def _exact_grow_args(td, n, f):
+    """Exact-sum fp32 inputs (grads +-0.5, hess 0.25) — histogram sums are
+    exactly representable, so accumulation order cannot perturb them."""
+    rng = np.random.RandomState(3)
+    sign = (rng.rand(n) > 0.5).astype(np.float32)
+    meta = td.feature_meta_device()
+    return (jnp.asarray(td.binned.bins),
+            jnp.asarray(sign - 0.5), jnp.full(n, 0.25, jnp.float32),
+            jnp.ones(n, jnp.float32), jnp.ones(f, bool),
+            meta["num_bins_per_feature"], meta["nan_bins"],
+            meta["is_categorical"], meta["monotone"])
+
+
+@pytest.fixture(scope="module")
+def grown():
+    """Shared dataset: > _MIN_BUCKET rows, NaNs for default-direction
+    coverage, one low-cardinality int column kept NUMERICAL."""
+    n, f = 3 * 2560, 12
+    rng = np.random.RandomState(7)
+    X = rng.randn(n, f)
+    X[rng.rand(n) < 0.05, 3] = np.nan
+    X[:, 5] = rng.randint(0, 6, n)
+    y = (X[:, 0] + 0.7 * X[:, 1] * X[:, 2] + 0.3 * rng.randn(n) > 0)
+    cfg = Config({"objective": "binary", "num_leaves": 31, "verbosity": -1})
+    td = TrainData.build(X, y.astype(np.float64), cfg)
+    base = G.GrowerConfig(num_leaves=31, num_bins=td.binned.max_num_bins,
+                          split=_split_config(cfg, td))
+    return _exact_grow_args(td, n, f), base
+
+
+def _pair(base, args, **kw):
+    gu = G.make_grower(dataclasses.replace(base, wave_kernel="unfused",
+                                           **kw))
+    gf = G.make_grower(dataclasses.replace(base, wave_kernel="fused", **kw))
+    assert not gu.wave_fused and gf.wave_fused
+    return gu(*args), gf(*args)
+
+
+@pytest.mark.parametrize("leaf_batch", [1, 4])
+def test_fused_bitwise_fp32(grown, leaf_batch):
+    """Fused trees == unfused trees bitwise, W=1 (a wave of one — the
+    fused grower routes through _grow_wave even at leaf_batch=1) and
+    W=4."""
+    args, base = grown
+    (t0, rl0), (t1, rl1) = _pair(base, args, leaf_batch=leaf_batch)
+    _assert_same_tree(t0, t1, rl0, rl1)
+    assert int(t0.num_leaves) > 8      # the pin actually grew a tree
+
+
+def test_fused_bitwise_quantized(grown):
+    """int8 wire / int32 accumulation: integer histograms are exact
+    unconditionally, and the in-kernel scale-to-f32 mirrors _scale_hist
+    elementwise — bitwise without any exact-sum caveat."""
+    args, base = grown
+    (t0, rl0), (t1, rl1) = _pair(base, args, leaf_batch=4, quantized=True)
+    _assert_same_tree(t0, t1, rl0, rl1)
+
+
+@pytest.mark.parametrize("quantized", [False, True])
+def test_fused_bitwise_pooled(grown, quantized):
+    """Bounded histogram pool x fused kernel: the kernel writes into
+    claimed slots, parents recompute-on-miss through the UNFUSED branch
+    and feed the kernel — trees stay bitwise across heavy eviction."""
+    args, base = grown
+    f = args[0].shape[1]
+    slot_mb = f * base.num_bins * 3 * 4 / (1 << 20)
+    (t0, rl0), (t1, rl1) = _pair(
+        base, args, leaf_batch=4, quantized=quantized,
+        histogram_pool_size=10.5 * slot_mb)   # ~10 slots for 31 leaves
+    gf = G.make_grower(dataclasses.replace(
+        base, wave_kernel="fused", leaf_batch=4,
+        histogram_pool_size=10.5 * slot_mb))
+    assert gf.pool_capable and gf.pool_slots(f) < base.num_leaves
+    _assert_same_tree(t0, t1, rl0, rl1)
+
+
+def test_fused_bitwise_packed4():
+    """4-bit nibble packing: the kernel unpacks planes in VMEM and scans
+    in plane order with ORIGINAL-feature-order tie-break keys — bitwise
+    vs the unfused packed4 path (odd F exercises the phantom column)."""
+    n, f = 3 * 2560, 9
+    rng = np.random.RandomState(11)
+    X = np.round(rng.randn(n, f) * 2)      # few distinct values -> <=16 bins
+    y = (X[:, 0] + X[:, 1] > 0)
+    cfg = Config({"objective": "binary", "num_leaves": 31, "max_bin": 15,
+                  "verbosity": -1})
+    td = TrainData.build(X, y.astype(np.float64), cfg)
+    assert td.binned.max_num_bins <= 16
+    from lightgbm_tpu.ops.histogram import pack_bins4
+    args = list(_exact_grow_args(td, n, f))
+    args[0] = pack_bins4(args[0])
+    base = G.GrowerConfig(num_leaves=31, num_bins=td.binned.max_num_bins,
+                          split=_split_config(cfg, td), packed4=True)
+    (t0, rl0), (t1, rl1) = _pair(base, tuple(args), leaf_batch=4)
+    _assert_same_tree(t0, t1, rl0, rl1)
+    assert int(t0.num_leaves) > 8
+
+
+def test_fused_bitwise_onehot_categorical():
+    """One-hot categorical splits INSIDE the kernel (cat_stats gather,
+    bis_cat selection, the cat_mask payload lanes): a low-cardinality
+    categorical feature engineered to win splits must produce bitwise
+    trees — including the (L, B) cat_mask routing — on the fused path.
+    max_cat_to_onehot is raised so no feature is sorted-eligible (the
+    sorted scan is the one categorical path the kernel excludes)."""
+    n, f = 3 * 2560, 4
+    rng = np.random.RandomState(13)
+    cat = rng.randint(0, 6, n).astype(np.float64)
+    X = np.column_stack([cat, rng.randn(n, f - 1)])
+    y = ((cat == 2.0) | (cat == 5.0)) ^ (X[:, 1] > 1.0)
+    cfg = Config({"objective": "binary", "num_leaves": 31,
+                  "max_cat_to_onehot": 16, "verbosity": -1})
+    td = TrainData.build(X, y.astype(np.float64), cfg,
+                         categorical_features=[0])
+    scfg = _split_config(cfg, td)
+    assert scfg.has_categorical and not scfg.use_sorted_categorical
+    base = G.GrowerConfig(num_leaves=31, num_bins=td.binned.max_num_bins,
+                          split=scfg)
+    (t0, rl0), (t1, rl1) = _pair(base, _exact_grow_args(td, n, f),
+                                 leaf_batch=4)
+    _assert_same_tree(t0, t1, rl0, rl1)
+    np.testing.assert_array_equal(np.asarray(t0.cat_mask),
+                                  np.asarray(t1.cat_mask))
+    assert bool(np.any(np.asarray(t0.is_cat)[
+        :int(t0.num_leaves) - 1])), "no categorical split won — dead pin"
+
+
+def test_small_n_reports_fused_inactive():
+    """n <= _MIN_BUCKET routes to the mask layout (no wave at all):
+    wave_fused_active — and everything the census/bench derive from it —
+    must say so instead of reporting a kernel that never runs."""
+    rng = np.random.RandomState(1)
+    X = rng.randn(1500, 4)
+    y = (X[:, 0] > 0).astype(np.float64)
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "tpu_wave_kernel": "fused", "tpu_leaf_batch": 4,
+                     "verbosity": -1, "metric": "none"},
+                    lgb.Dataset(X, label=y), 2)
+    assert bst._gbdt.wave_fused_active is False
+
+
+def test_fused_degrades_under_efb_and_stays_identical():
+    """EFB bundling keeps the unfused wave (bundle-offset expansion is not
+    Mosaic-expressible): tpu_wave_kernel=fused must DEGRADE — and then
+    trivially match the unfused run byte for byte."""
+    n = 4000
+    rng = np.random.RandomState(2)
+    # mutually exclusive one-hot blocks bundle under EFB
+    base_col = rng.randint(0, 4, n)
+    X = np.zeros((n, 8))
+    for j in range(4):
+        X[:, j] = (base_col == j) * rng.rand(n)
+    X[:, 4:] = rng.randn(n, 4)
+    y = (X[:, 4] + base_col > 1.5).astype(np.float64)
+    params = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+              "metric": "none", "deterministic": True, "tpu_leaf_batch": 4}
+    b_f = lgb.train(dict(params, tpu_wave_kernel="fused"),
+                    lgb.Dataset(X, label=y), 3)
+    b_u = lgb.train(dict(params, tpu_wave_kernel="unfused"),
+                    lgb.Dataset(X, label=y), 3)
+    assert b_f._gbdt.bundles is not None          # EFB actually engaged
+    assert b_f._gbdt.wave_fused_active is False   # ... and fused degraded
+    # byte-identical trees; only the echoed parameter block may differ
+    tree_f = b_f.model_to_string().split("end of parameters")[1]
+    tree_u = b_u.model_to_string().split("end of parameters")[1]
+    assert tree_f == tree_u
+
+
+def test_fused_iter_pack_k1_eq_k4():
+    """tpu_wave_kernel=fused composes with iteration packing: K=4 packed
+    rounds (the pallas kernel traced inside the lax.scan body) produce the
+    byte-identical model of 4 per-round updates."""
+    n = 3 * 2560
+    rng = np.random.RandomState(5)
+    X = rng.randn(n, 8)
+    y = (X[:, 0] + X[:, 1] * X[:, 2] > 0).astype(np.float64)
+    params = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+              "metric": "none", "deterministic": True, "tpu_leaf_batch": 4,
+              "tpu_wave_kernel": "fused"}
+    b1 = lgb.Booster(params=params, train_set=lgb.Dataset(X, label=y))
+    for _ in range(4):
+        b1.update()
+    b4 = lgb.Booster(params=params, train_set=lgb.Dataset(X, label=y))
+    assert b4._gbdt.iter_pack_plan(4)[1], "config must be pack-capable"
+    b4.update_pack(4)
+    assert b1.model_to_string() == b4.model_to_string()
+
+
+def test_selection_parity_onehot_vs_argmax(rng):
+    """ops/split.select_payload (the Mosaic-safe one-hot selection the
+    kernel uses) must pick the SAME winner as _select_from_tables' argmax
+    — including on exact gain ties and the all--inf no-split case."""
+    from lightgbm_tpu.ops.split import (SplitConfig, _select_from_tables,
+                                        scan_tables, select_payload)
+
+    F, B = 5, 16
+    cfg = SplitConfig(min_data_in_leaf=1, has_nan=True,
+                      has_categorical=False, use_sorted_categorical=False,
+                      has_monotone=False)
+    hist = np.zeros((F, B, 3), np.float32)
+    hist[:, :, 0] = rng.randn(F, B)
+    hist[:, :, 1] = rng.rand(F, B) + 0.1
+    hist[:, :, 2] = rng.randint(1, 20, (F, B))
+    hist[2] = hist[1]                      # exact duplicate -> gain ties
+    tot = hist[0].sum(axis=0)
+    for variant in ("normal", "no_split"):
+        cfgv = cfg if variant == "normal" else dataclasses.replace(
+            cfg, min_data_in_leaf=10**6)
+        t = scan_tables(
+            jnp.asarray(hist[..., 0]), jnp.asarray(hist[..., 1]),
+            jnp.asarray(hist[..., 2]), *(jnp.asarray(v) for v in tot),
+            num_bins_per_feature=jnp.full(F, B, jnp.int32),
+            nan_bins=jnp.full(F, B, jnp.int32),
+            is_categorical=jnp.zeros(F, bool),
+            feature_mask=jnp.ones(F, bool), cfg=cfgv)
+        ref = _select_from_tables(t, jnp.zeros(F, bool), cfgv)
+        got = select_payload(t, jnp.zeros(F, bool), cfgv)
+        gain, bf, bb, dl, ic, GL, HL, CL, GR, HR, CR = got
+        assert float(gain) == float(ref.gain)
+        assert int(bf) == int(ref.feature) and int(bb) == int(ref.bin)
+        assert bool(dl) == bool(ref.default_left)
+        for a, b in ((GL, ref.sum_grad_left), (HL, ref.sum_hess_left),
+                     (CL, ref.count_left), (GR, ref.sum_grad_right),
+                     (HR, ref.sum_hess_right), (CR, ref.count_right)):
+            assert float(a) == float(b)
+
+
+def test_wave_layout_legal_and_budgeted():
+    """Hermetic kernel_layout-style pin for the fused kernel's VMEM plan:
+    every BlockSpec-relevant dimension Mosaic-legal (128-multiple lane
+    dims, nibble-pair-even feature tiles), histogram block + scan scratch
+    under budget wherever the layout claims to fit, and the shapes that
+    must (bench Higgs) / must not (Epsilon-wide) fuse."""
+    from lightgbm_tpu.ops.pallas_wave import (WAVE_VMEM_BUDGET,
+                                              wave_layout)
+
+    for dtype in ("f32", "bf16", "int8"):
+        for nb in (16, 64, 255, 256):
+            for f in (1, 28, 137):
+                lay = wave_layout(f, nb, dtype)
+                assert lay["b_pad"] % 128 == 0 and lay["b_pad"] >= nb
+                assert (lay["ftile"] * lay["b_pad"]) % 128 == 0
+                assert lay["rows_block"] % 128 == 0
+                if lay["fits"]:
+                    assert lay["single_chunk"]
+                    assert lay["total_bytes"] <= WAVE_VMEM_BUDGET
+                    assert (lay["hist_block_bytes"]
+                            + lay["scan_scratch_bytes"]) <= WAVE_VMEM_BUDGET
+        lay4 = wave_layout(13, 16, dtype, packed4=True)
+        assert lay4["ftile"] % 2 == 0
+    # the bench Higgs shape fuses (fp32 AND the quantized int8 wire) ...
+    assert wave_layout(28, 256, "f32")["fits"]
+    assert wave_layout(28, 256, "int8")["fits"]
+    # ... Epsilon-wide does not (keeps the unfused + pool + tiled scan)
+    assert not wave_layout(2000, 256, "f32")["fits"]
+
+
+def test_capability_predicate_and_knob():
+    """wave_fused_for: the composition gate (shared with GBDT and the
+    census) — excluded axes degrade, explicit fused forces on CPU, auto
+    engages only where the flat pallas impl is live."""
+    from lightgbm_tpu.ops.split import SplitConfig
+
+    plain = SplitConfig(has_nan=True, has_categorical=False,
+                        use_sorted_categorical=False, has_monotone=False)
+    base = G.GrowerConfig(num_leaves=15, num_bins=64, split=plain,
+                          leaf_batch=4)
+    rep = dataclasses.replace
+    assert G.wave_fused_for(rep(base, wave_kernel="fused"))
+    # auto on a CPU backend (resolve_impl -> segment): stays unfused
+    assert not G.wave_fused_for(rep(base, wave_kernel="auto"))
+    # ... but auto with the flat pallas impl engages
+    assert G.wave_fused_for(rep(base, wave_kernel="auto",
+                                histogram_impl="flat"))
+    assert not G.wave_fused_for(rep(base, wave_kernel="unfused"))
+    for bad in (
+        rep(base, wave_kernel="fused", voting=True),
+        rep(base, wave_kernel="fused", bundled=True),
+        rep(base, wave_kernel="fused", gather_rows=False),
+        rep(base, wave_kernel="fused",
+            forced_splits=((0, 1, -1, -1),)),
+        rep(base, wave_kernel="fused",
+            split=rep(plain, has_monotone=True)),
+        rep(base, wave_kernel="fused", split=rep(plain, use_cegb=True)),
+        rep(base, wave_kernel="fused",
+            split=rep(plain, extra_trees=True)),
+        rep(base, wave_kernel="fused", feature_fraction_bynode=0.5),
+        rep(base, wave_kernel="fused", interaction_groups=((0, 1),)),
+        rep(base, wave_kernel="fused",
+            split=rep(plain, feature_contri=(0.5, 1.0))),
+        rep(base, wave_kernel="fused",
+            split=rep(plain, has_categorical=True,
+                      use_sorted_categorical=True)),
+    ):
+        assert not G.wave_fused_for(bad), bad
+    with pytest.raises(ValueError, match="wave_kernel"):
+        G.wave_fused_for(rep(base, wave_kernel="bogus"))
+    with pytest.raises(ValueError, match="tpu_wave_kernel"):
+        lgb.train({"objective": "binary", "tpu_wave_kernel": "bogus",
+                   "verbosity": -1},
+                  lgb.Dataset(np.random.rand(100, 3),
+                              label=np.zeros(100)), 1)
+
+
+def test_explicit_fused_downgrades_through_matrix(capsys):
+    """The capability matrix owns the composition downgrades: an explicit
+    fused request against monotone constraints warns and keeps the
+    unfused wave (same message discipline as every other rule)."""
+    rng = np.random.RandomState(0)
+    X = rng.rand(1500, 4)
+    y = 2 * X[:, 0] + 0.1 * rng.randn(1500)
+    bst = lgb.train({"objective": "regression", "num_leaves": 15,
+                     "monotone_constraints": [1, 0, 0, 0],
+                     "tpu_wave_kernel": "fused", "tpu_leaf_batch": 4,
+                     "verbosity": 1},
+                    lgb.Dataset(X, label=y), 2)
+    out = capsys.readouterr()
+    assert "tpu_wave_kernel=fused" in out.out + out.err
+    assert bst._gbdt.wave_fused_active is False
+    assert bst._gbdt.grower_cfg.wave_kernel == "unfused"

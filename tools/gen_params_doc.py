@@ -40,6 +40,23 @@ _DESCRIPTIONS = {
     "tpu_leaf_batch": (
         "leaves split per growth step (wave growth); 1 = strict "
         "best-first, >1 divides sequential steps per tree"),
+    "tpu_wave_kernel": (
+        "fused wave kernel (ops/pallas_wave.py): auto|fused|unfused — one "
+        "pallas dispatch per leaf-batch wave runs histogram build -> "
+        "sibling subtraction -> split scan while the accumulators stay "
+        "VMEM-resident (vs one histogram dispatch per leaf plus two more "
+        "HBM passes unfused); quantized trees are bitwise-identical "
+        "either way, fp32 trees are identical whenever histogram sums "
+        "are exactly representable (otherwise ULP-level — the wave's "
+        "shared row bucket may regroup f32 partial sums, the histogram "
+        "pool's recompute caveat; tests/test_wave_fused.py, "
+        "docs/PERF.md round 9).  auto = fused only where the "
+        "capability checks pass (no mesh/voting/EFB/monotone/"
+        "sorted-categorical/CEGB/per-node randomness, feature space fits "
+        "one VMEM block) AND the flat pallas histogram is the live impl "
+        "(TPU); fused = force the kernel (interpret mode on CPU — the "
+        "tier-1 coverage vehicle, slow); unfused = always the per-leaf "
+        "path"),
     "tpu_hist_comm": (
         "cross-shard histogram reduction on data meshes: auto|allreduce|"
         "reduce_scatter (auto = feature-sliced psum_scatter + slice-local "

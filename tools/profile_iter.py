@@ -133,6 +133,82 @@ def nonfused_dispatch_census(rows=8192, iters=4, num_leaves=31,
     return out
 
 
+def train_step_hlo_cost(bst):
+    """XLA's own cost model for the booster's compiled grower program (the
+    train step's dominant dispatch): ``compiled.cost_analysis()`` FLOPs /
+    bytes-accessed, AOT-lowered on whatever backend is live — the
+    platform-independent compile-time cost number every kernel PR lands
+    with even when the TPU probe verdict is not live (ROADMAP 3b; the
+    ``detail.hlo_cost`` block in every BENCH json)."""
+    import jax  # noqa: F401 — backend must be up for lower()
+    import jax.numpy as jnp
+
+    g = bst._gbdt
+    n = g.train_data.num_data
+    f = g.train_data.num_features
+    meta = g.meta_dev
+    args = [g.bins_dev, jnp.zeros(n, jnp.float32), jnp.ones(n, jnp.float32),
+            jnp.ones(n, jnp.float32), jnp.ones(f, bool),
+            meta["num_bins_per_feature"], meta["nan_bins"],
+            meta["is_categorical"], meta["monotone"]]
+    if g._fg_dev is not None:
+        # EFB: the grower needs the bundle maps (positional tail)
+        args += [None, None, None, None, g._fg_dev, g._fo_dev]
+    cost = g.grow.lower(*args).compile().cost_analysis()
+    if isinstance(cost, list):
+        cost = cost[0]
+    out = {}
+    for k_out, k_in in (("flops", "flops"),
+                        ("bytes_accessed", "bytes accessed"),
+                        ("transcendentals", "transcendentals")):
+        v = cost.get(k_in)
+        if v is not None:
+            out[k_out] = float(v)
+    return out
+
+
+def fused_wave_census(rows=4096, features=12, num_leaves=15, leaf_batch=4):
+    """Histogram-kernel dispatches per WAVE, fused vs unfused (ISSUE-7):
+    the unfused wave body issues one histogram call per leaf (a W-trip
+    ``fori_loop`` over the bucket switch), the fused kernel issues ONE
+    ``pallas_call`` per wave with leaf batches pipelined through the grid.
+    ``hist_dispatches_per_wave`` is derived from the grower's own declared
+    dispatch structure (``grow.wave_fused`` + the VMEM shape gate — the
+    SAME predicates the trace is built from, so the census cannot disagree
+    with the program), and each blob carries the measured program
+    dispatches/iter so the fused kernel is witnessed not to add launches.
+    On CPU the fused grower runs the kernel body in interpret mode — the
+    census doubles as tier-1 coverage of the fused trace."""
+    import numpy as np
+
+    import lightgbm_tpu as lgb
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(rows, features)
+    y = (X[:, 0] + X[:, 1] * X[:, 2] > 0).astype(np.float64)
+    out = []
+    for mode in ("fused", "unfused"):
+        ds = lgb.Dataset(X, label=y)
+        bst = lgb.Booster(params={"objective": "binary",
+                                  "num_leaves": num_leaves,
+                                  "tpu_leaf_batch": leaf_batch,
+                                  "metric": "none", "verbosity": -1,
+                                  "tpu_wave_kernel": mode}, train_set=ds)
+        g = bst._gbdt
+        active = bool(g.wave_fused_active)
+        dispatches, syncs = _count_dispatches_and_syncs(bst, 2)
+        out.append({
+            "wave_kernel": mode,
+            "fused_active": active,
+            "leaf_batch": int(g.grower_cfg.leaf_batch),
+            "hist_dispatches_per_wave": (
+                1 if active else int(g.grower_cfg.leaf_batch)),
+            "dispatches_per_iter": round(dispatches / 2, 2),
+            "host_syncs_per_iter": round(syncs / 2, 2),
+        })
+    return out
+
+
 def _count_host_syncs(run, warmup):
     """Run ``warmup()`` then ``run()`` with jax.device_get instrumented;
     returns the number of device_get calls ``run`` performed.  Every
@@ -220,6 +296,14 @@ def main():
         print(f"  {blob['path']:<12} used_fused={blob['used_fused']!s:<5} "
               f"dispatches/iter={blob['dispatches_per_iter']:<6} "
               f"host_syncs/iter={blob['host_syncs_per_iter']}")
+
+    # ---- fused wave kernel (tpu_wave_kernel, ISSUE-7) -------------------
+    print("fused-wave census (histogram dispatches per wave):")
+    for blob in fused_wave_census(rows=min(rows, 16384)):
+        print(f"  {blob['wave_kernel']:<8} active={blob['fused_active']!s:<5} "
+              f"hist_dispatches/wave={blob['hist_dispatches_per_wave']} "
+              f"(leaf_batch={blob['leaf_batch']}) "
+              f"program_dispatches/iter={blob['dispatches_per_iter']}")
 
 
 if __name__ == "__main__":
