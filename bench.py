@@ -179,6 +179,19 @@ def make_epsilon_like(n, f, seed=0):
     return d["X"], d["y"]
 
 
+def _health_block(bst, rounds):
+    """The ``detail.health`` block every BENCH/rung blob carries (ISSUE-8):
+    one post-hoc sentinel audit (the same isfinite/max-abs reductions the
+    in-dispatch health vector runs, outside the timed window) plus the
+    process-level int16-wire overflow tally — so a rung that silently
+    trained on NaN can never publish a clean-looking rate."""
+    try:
+        from lightgbm_tpu.resilience.health import bench_health_block
+        return bench_health_block(bst, rounds)
+    except Exception as e:  # noqa: BLE001 — audit is garnish on the rate
+        return {"error": f"{e!r}"[:160]}
+
+
 def _hlo_cost_block(bst):
     """The per-rung HLO cost block (ROADMAP 3b, ISSUE-7 satellite): XLA's
     own cost model (FLOPs / bytes accessed) for the rung's compiled grower
@@ -240,6 +253,7 @@ def run_ltr_rung(rows, iters, platform, jax, features=None, group=None,
         "row_iters_per_sec": round(rows * iters / elapsed, 1),
         "ndcg5_train_sample": None if ndcg is None else round(ndcg, 6),
         "hlo_cost": _hlo_cost_block(bst),
+        "health": _health_block(bst, iters),
     }
 
 
@@ -280,6 +294,7 @@ def run_wide_rung(rows, iters, platform, jax, features=None,
         "leaf_hist_mb_pooled": round(
             slots * features * bins * 3 * 4 / 2**20, 1),
         "hlo_cost": _hlo_cost_block(bst),
+        "health": _health_block(bst, iters),
     }
 
 
@@ -318,6 +333,7 @@ def run_goss_rung(rows, iters, platform, jax, features=None,
     except Exception as e:  # noqa: BLE001 — census is garnish on the rate
         blob["dispatches_per_iter"] = f"failed: {e!r}"[:120]
     blob["hlo_cost"] = _hlo_cost_block(bst)
+    blob["health"] = _health_block(bst, iters)
     return blob
 
 
@@ -353,6 +369,7 @@ def run_fused_rung(rows, iters, platform, jax, features=None,
         "train_time_s": round(elapsed, 3),
         "row_iters_per_sec": round(rows * iters / elapsed, 1),
         "hlo_cost": _hlo_cost_block(bst),
+        "health": _health_block(bst, iters),
     }
 
 
@@ -569,6 +586,9 @@ def run_bench(rows, iters):
     # compile-time FLOPs / bytes-accessed ride EVERY emitted line, so a
     # kernel PR lands with a cost delta even when the chip is wedged.
     hlo_cost = _hlo_cost_block(bst)
+    # Post-hoc sentinel audit (ISSUE-8): the rate above is only publishable
+    # when the final gradients/scores are finite — detail.health says so.
+    health_block = _health_block(bst, iters)
 
     def emit(quant_rate, predict_stats=None, ltr_stats=None,
              wide_stats=None, goss_stats=None, fused_stats=None):
@@ -596,6 +616,10 @@ def run_bench(rows, iters):
                 # (tools/profile_iter.train_step_hlo_cost): flops /
                 # bytes_accessed — per-rung deltas across BENCH rounds.
                 "hlo_cost": hlo_cost,
+                # Training-health audit (resilience/health.py): sentinel
+                # verdict over the final gradients/scores, rounds checked,
+                # rollbacks and int16-wire overflow escalations.
+                "health": health_block,
                 # Iteration packing: training dispatches per boosting round
                 # (1.0 = per-round loop; 1/K with K-round packs — the
                 # host-sync elimination the pack path is for).

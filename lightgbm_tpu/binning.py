@@ -352,6 +352,7 @@ def bin_dataset(
             raise ValueError("max_bin_by_feature values must be > 1")
     mappers: List[BinMapper] = []
     s = sample.shape[0]
+    all_nan_cols: List[int] = []
     for j in range(f):
         mb = max_bin
         if max_bin_by_feature is not None:
@@ -363,6 +364,9 @@ def bin_dataset(
             col[: len(nz)] = nz       # find_bin is order-invariant
         else:
             col = sample[:, j]
+        if (j not in cat_set and s
+                and bool(np.isnan(np.asarray(col, np.float64)).all())):
+            all_nan_cols.append(j)
         mappers.append(
             find_bin(
                 col, mb, min_data_in_bin,
@@ -371,6 +375,23 @@ def bin_dataset(
                 forced_upper_bounds=(forced_bins or {}).get(j),
             )
         )
+    # Ingestion health (docs/ROBUSTNESS.md; reference DatasetLoader
+    # feature_pre_filter warnings): a column that is entirely NaN in the
+    # binning sample, or binned trivially (constant), can never split —
+    # usually an upstream join/pipeline bug worth one loud line.
+    const_cols = [j for j, m in enumerate(mappers)
+                  if m.is_trivial and j not in all_nan_cols]
+    if all_nan_cols or const_cols:
+        from .utils.log import Log
+        if all_nan_cols:
+            Log.warning(
+                f"{len(all_nan_cols)} feature column(s) are entirely NaN "
+                f"in the binning sample (e.g. {all_nan_cols[:8]}); they "
+                "can never split")
+        if const_cols:
+            Log.warning(
+                f"{len(const_cols)} feature column(s) are constant "
+                f"(e.g. {const_cols[:8]}); they can never split")
     return BinnedData.from_mappers(X, mappers)
 
 

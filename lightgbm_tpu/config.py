@@ -263,6 +263,33 @@ _PARAMS: List[Tuple[str, Any, Any, Tuple[str, ...], Optional[Tuple[Any, Any]]]] 
     # Per-request serving deadline: requests still queued past it are
     # failed with ServeDeadlineError instead of dispatched late; 0 = none.
     ("serve_deadline_ms", float, 0.0, (), (0.0, None)),
+    # ---- Training-health sentinel (resilience/health.py) ----
+    # What to do when the sentinel trips (non-finite gradients/hessians/
+    # leaf values/scores in the in-dispatch health vector, a non-finite or
+    # spiking eval loss, or a stagnant-to-saturation loss window):
+    # off = no guards at all (training is bitwise-identical to a build
+    # without the sentinel), warn = log and continue, halt = raise
+    # HealthHaltError, rollback = restore the last good checkpoint
+    # in-process, back off the learning rate and re-fold the device
+    # sampling keys, then resume.
+    ("tpu_health_policy", str, "off", ("health_policy",), None),
+    # Divergence detector: trip when a lower-is-better eval loss exceeds
+    # spike_factor x the best value inside the trailing window.
+    ("tpu_health_spike_factor", float, 10.0, (), (1.0, None)),
+    # Trailing per-round loss window for spike/stagnation detection.
+    ("tpu_health_window", int, 5, (), (2, None)),
+    # Max-abs train score above which the sentinel reports overflow
+    # (pre-NaN saturation); 0 disables the magnitude check.
+    ("tpu_health_score_limit", float, 1e30, (), (0.0, None)),
+    # In-process rollbacks allowed before escalating to HealthHaltError.
+    ("tpu_health_max_rollbacks", int, 2, (), (0, None)),
+    # learning_rate multiplier applied per recovery generation (salt).
+    ("tpu_health_lr_backoff", float, 0.5, (), (0.0, 1.0)),
+    # Recovery generation: >0 re-folds the device sampling keys and backs
+    # off the learning rate exactly as the Nth in-process rollback does —
+    # a fresh run resumed from the same checkpoint with the same salt
+    # reproduces the recovered run's trees bitwise (docs/ROBUSTNESS.md).
+    ("tpu_health_recovery_salt", int, 0, (), (0, None)),
 ]
 
 _CANONICAL: Dict[str, Tuple[str, Any, Any, Optional[Tuple[Any, Any]]]] = {}
@@ -307,7 +334,8 @@ def _coerce(name: str, typ: Any, value: Any) -> Any:
         return str(value).strip().lower() if name in ("objective", "boosting", "tree_learner",
                                                       "device_type", "monotone_constraints_method",
                                                       "data_sample_strategy", "tpu_histogram_impl",
-                                                      "tpu_hist_comm", "tpu_wave_kernel") \
+                                                      "tpu_hist_comm", "tpu_wave_kernel",
+                                                      "tpu_health_policy") \
             else str(value)
     if typ in ("list_int", "list_float", "list_str"):
         if value is None:

@@ -71,6 +71,23 @@ class TrainData:
         from .binning import _is_sparse
         if not _is_sparse(X):
             X = np.asarray(X)
+        # Ingestion validation (docs/ROBUSTNESS.md; reference
+        # Metadata::CheckOrPartition + per-objective CheckLabel): a single
+        # NaN label poisons every gradient and only shows up as a garbage
+        # model hours later — reject at the door with a clear error.
+        label_arr = np.asarray(label, np.float64).ravel()
+        if label_arr.size and not np.isfinite(label_arr).all():
+            bad = np.nonzero(~np.isfinite(label_arr))[0]
+            raise ValueError(
+                f"{bad.size} non-finite label(s) (first at rows "
+                f"{bad[:8].tolist()}); labels must be finite")
+        if weight is not None:
+            w_arr = np.asarray(weight, np.float64).ravel()
+            if w_arr.size and not np.isfinite(w_arr).all():
+                bad = np.nonzero(~np.isfinite(w_arr))[0]
+                raise ValueError(
+                    f"{bad.size} non-finite sample weight(s) (first at "
+                    f"rows {bad[:8].tolist()}); weights must be finite")
         if reference is not None:
             binned = dataclasses.replace(
                 reference.binned, bins=reference.binned.apply(X))

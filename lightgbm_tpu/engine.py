@@ -191,9 +191,25 @@ def train(
     # values, so a resumed run replays them below instead of trying to
     # pickle user callback closures.
     booster._ckpt_eval_history = []
+    # Training-health sentinel (docs/ROBUSTNESS.md, resilience/health.py):
+    # tpu_health_policy != off arms in-dispatch NaN/Inf/overflow guards, a
+    # loss-divergence detector over the per-round eval history and —
+    # under "rollback" — checkpoint-backed auto-recovery.
+    from .resilience import health as health_mod
+    sentinel = None
+    if booster.cfg.tpu_health_policy != "off":
+        sentinel = health_mod.TrainingHealthSentinel(booster.cfg)
+    booster._health_report = (health_mod.off_report() if sentinel is None
+                              else sentinel.report())
     if resume_from is not None:
         from .resilience import checkpoint as checkpoint_mod
         start_it = checkpoint_mod.restore(booster, resume_from)
+        # Recovery generation (tpu_health_recovery_salt > 0): the SAME
+        # lr-backoff + key-refold transformation the in-process rollback
+        # applies — which is what makes a fresh resume with the same salt
+        # reproduce the recovered run's trees bitwise.
+        health_mod.apply_recovery(booster,
+                                  booster.cfg.tpu_health_recovery_salt)
         try:
             for it_h, evals_h in booster._ckpt_eval_history:
                 if it_h >= start_it:
@@ -217,6 +233,13 @@ def train(
         ckpt_interval = 0
     ckpt_dir = booster.cfg.checkpoint_dir or f"{snapshot_base}.ckpt"
     last_ckpt = [start_it]
+    if (sentinel is not None and sentinel.policy == "rollback"
+            and ckpt_interval <= 0):
+        from .utils.log import Log
+        Log.warning(
+            "tpu_health_policy=rollback without checkpoint_interval>0: "
+            "there will be no checkpoint to roll back to, so a tripped "
+            "sentinel escalates straight to HealthHaltError")
 
     def _maybe_checkpoint(done_it: int) -> None:
         if ckpt_interval <= 0 \
@@ -227,11 +250,19 @@ def train(
                                      keep=booster.cfg.checkpoint_keep)
         last_ckpt[0] = done_it
 
+    # evals the sentinel already computed for a round (keyed by 0-based
+    # iteration), reused by _fire_after so arming the sentinel never
+    # doubles the per-round eval cost.  Only populated when feval is None
+    # (the sentinel's _evals() carries no feval rows).
+    sentinel_evals: Dict[int, list] = {}
+
     def _fire_after(it: int) -> bool:
         """Eval + after-callbacks for round ``it``; True = early stop."""
         if not _round_needs_eval(it):
             return False
-        evals = booster._evals(feval)
+        evals = sentinel_evals.pop(it, None)
+        if evals is None:
+            evals = booster._evals(feval)
         # no after-callbacks -> nothing to replay on resume: skip the
         # history (each snapshot re-pickles the whole list, so for long
         # runs this is the difference between O(1) and O(rounds) extra
@@ -253,52 +284,151 @@ def train(
             return True
         return False
 
+    # ---- health sentinel hooks (docs/ROBUSTNESS.md health section) ----
+    rollbacks_done = [0]
+
+    def _health_check(done_it: int) -> bool:
+        """Observe the just-committed round ``done_it`` (1-based count of
+        committed rounds).  Returns True when the engine must roll back;
+        warn logs and continues; halt (and an exhausted rollback budget)
+        raises :class:`~.resilience.health.HealthHaltError`.  Runs BEFORE
+        the round's after-callbacks so halt/rollback policies never feed a
+        diverged metric into early-stopping state."""
+        if sentinel is None:
+            return False
+        hv = booster._gbdt.consume_health()
+        evals = None
+        if valid_pairs or booster.cfg.is_provide_training_metric:
+            evals = booster._evals()
+            if feval is None:
+                sentinel_evals.clear()
+                sentinel_evals[done_it - 1] = evals
+            if use_pack and evals:
+                # Mid-pack, train scores already include the WHOLE pack
+                # (train_pack committed scores2 up front), so the training
+                # metric is the same end-of-pack value at every commit —
+                # feeding it to the detector would trip loss_stagnation
+                # on healthy runs.  Valid scores DO advance per commit
+                # (_store_tree), so only training rows are dropped.
+                evals = [e for e in evals if e[0] != "training"]
+        trip = sentinel.observe_round(done_it, hv, evals)
+        if trip is None:
+            return False
+        from .utils.log import Log
+        if sentinel.policy == "warn":
+            Log.warning(f"health sentinel tripped: {trip} (policy=warn, "
+                        "training continues)")
+            return False
+        if sentinel.policy == "halt":
+            sentinel.note_halt()
+            booster._health_report = sentinel.report()
+            raise health_mod.HealthHaltError(
+                f"training halted by the health sentinel: {trip} "
+                "(tpu_health_policy=halt)", booster)
+        return True   # rollback
+
+    def _do_rollback() -> int:
+        """Restore the newest valid checkpoint in-process and apply the
+        next recovery generation (lr backoff + key refold).  Returns the
+        iteration training resumes at."""
+        trip = sentinel.trips[-1]
+        rollbacks_done[0] += 1
+        cap = booster.cfg.tpu_health_max_rollbacks
+        if rollbacks_done[0] > cap:
+            sentinel.note_halt()
+            booster._health_report = sentinel.report()
+            raise health_mod.HealthHaltError(
+                f"health sentinel: {trip} — tpu_health_max_rollbacks="
+                f"{cap} recovery attempts exhausted", booster)
+        from .resilience import checkpoint as checkpoint_mod
+        from .serialization import FrameCorruptError
+        try:
+            start = checkpoint_mod.restore(booster, ckpt_dir)
+        except (FileNotFoundError, FrameCorruptError) as e:
+            sentinel.note_halt()
+            booster._health_report = sentinel.report()
+            raise health_mod.HealthHaltError(
+                f"health sentinel: {trip} — rollback impossible "
+                f"({e})", booster) from e
+        salt = booster.cfg.tpu_health_recovery_salt + rollbacks_done[0]
+        health_mod.apply_recovery(booster, salt)
+        sentinel.note_rollback(start, salt)
+        sentinel_evals.clear()   # cached evals refer to discarded rounds
+        # checkpoint cadence and eval-history replay state rewind with the
+        # restore; after-callbacks are NOT replayed here (they already saw
+        # rounds <= start in this process — docs/ROBUSTNESS.md).
+        last_ckpt[0] = start
+        return start
+
     it = start_it
-    while it < num_boost_round:
-        if use_pack:
-            rounds, finished = booster._gbdt.train_pack(
-                min(pack_k, num_boost_round - it))
-            committed = 0
-            stopped = False
-            try:
-                for j, rnd in enumerate(rounds):
-                    # Commit one round, then replay its callbacks/eval:
-                    # valid scores update per committed tree, so callbacks
-                    # observe the SAME per-iteration metric sequence as the
-                    # per-round loop (early stopping fires at the identical
-                    # iteration).
-                    booster._gbdt.commit_round(rnd)
-                    committed += 1
-                    # fault seam: a mid-training SIGKILL lands right after
-                    # a commit, the worst legal place for a crash
-                    faults.maybe_kill(it + j + 1)
-                    if _fire_after(it + j):
-                        stopped = True
-                        break
-            finally:
-                # Uncommitted rounds were trained inside the same dispatch
-                # but never observed (mid-pack early stop, or a callback
-                # raising) — drop their score contributions so a caller who
-                # keeps training from this booster sees consistent state.
-                if committed < len(rounds):
-                    booster._gbdt.discard_rounds(rounds[committed:])
-            it += committed
-            if stopped or finished:
-                break
-            _maybe_checkpoint(it)
-        else:
-            for cb in cbs_before:
-                cb(CallbackEnv(booster, params, it, 0,
-                               num_boost_round, None))
-            finished = booster.update(fobj=fobj)
-            faults.maybe_kill(it + 1)
-            if snapshot_freq > 0 and (it + 1) % snapshot_freq == 0:
-                booster.save_model(f"{snapshot_base}.snapshot_iter_{it + 1}")
-            stopped = _fire_after(it)
-            it += 1
-            if stopped or finished:
-                break
-            _maybe_checkpoint(it)
+    try:
+        while it < num_boost_round:
+            if use_pack:
+                rounds, finished = booster._gbdt.train_pack(
+                    min(pack_k, num_boost_round - it))
+                committed = 0
+                stopped = False
+                rollback_due = False
+                try:
+                    for j, rnd in enumerate(rounds):
+                        # Commit one round, then replay its callbacks/eval:
+                        # valid scores update per committed tree, so
+                        # callbacks observe the SAME per-iteration metric
+                        # sequence as the per-round loop (early stopping
+                        # fires at the identical iteration).
+                        booster._gbdt.commit_round(rnd)
+                        committed += 1
+                        # fault seam: a mid-training SIGKILL lands right
+                        # after a commit, the worst legal place for a crash
+                        faults.maybe_kill(it + j + 1)
+                        if _health_check(it + j + 1):
+                            rollback_due = True
+                            break
+                        if _fire_after(it + j):
+                            stopped = True
+                            break
+                finally:
+                    # Uncommitted rounds were trained inside the same
+                    # dispatch but never observed (mid-pack early stop, a
+                    # tripped sentinel, or a callback raising) — drop their
+                    # score contributions so a caller who keeps training
+                    # from this booster sees consistent state.
+                    if committed < len(rounds):
+                        booster._gbdt.discard_rounds(rounds[committed:])
+                it += committed
+                if rollback_due:
+                    it = _do_rollback()
+                    continue
+                if (finished and not stopped and _health_check(it + 1)):
+                    # a degenerate stop can BE the failure: a NaN-poisoned
+                    # round grows no tree, so the trimmed stopping round's
+                    # health vector (surfaced by train_pack) is checked
+                    # before the stop is accepted as convergence
+                    it = _do_rollback()
+                    continue
+                if stopped or finished:
+                    break
+                _maybe_checkpoint(it)
+            else:
+                for cb in cbs_before:
+                    cb(CallbackEnv(booster, params, it, 0,
+                                   num_boost_round, None))
+                finished = booster.update(fobj=fobj)
+                faults.maybe_kill(it + 1)
+                if snapshot_freq > 0 and (it + 1) % snapshot_freq == 0:
+                    booster.save_model(
+                        f"{snapshot_base}.snapshot_iter_{it + 1}")
+                if _health_check(it + 1):
+                    it = _do_rollback()
+                    continue
+                stopped = _fire_after(it)
+                it += 1
+                if stopped or finished:
+                    break
+                _maybe_checkpoint(it)
+    finally:
+        if sentinel is not None:
+            booster._health_report = sentinel.report()
     return booster
 
 

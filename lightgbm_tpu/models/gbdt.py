@@ -304,6 +304,21 @@ class GBDT:
             raise ValueError(
                 f"tpu_device_goss={cfg.tpu_device_goss!r}: expected auto, "
                 "on or off")
+        from ..resilience.health import POLICIES
+        if cfg.tpu_health_policy not in POLICIES:
+            raise ValueError(
+                f"tpu_health_policy={cfg.tpu_health_policy!r}: expected "
+                f"one of {', '.join(POLICIES)}")
+        # Training-health sentinel (resilience/health.py): with any policy
+        # but "off", the iteration/pack programs fold the isfinite/max-abs
+        # health vector into their dispatch and the quantized int16-wire
+        # overflow guard reports its escalations.  "off" compiles the
+        # EXACT pre-sentinel programs (bitwise-identity contract).
+        self._health_active = cfg.tpu_health_policy != "off"
+        self._health_pending = None
+        self._trailing_health = None
+        self._health_eval = None
+        self._pack_health_pending: List = []
         self.grower_cfg = GrowerConfig(
             num_leaves=cfg.num_leaves,
             max_depth=cfg.max_depth,
@@ -332,6 +347,7 @@ class GBDT:
             hist_comm=cfg.tpu_hist_comm,
             histogram_pool_size=cfg.histogram_pool_size,
             wave_kernel=wave_kernel,
+            health_signal=self._health_active,
         )
         from .grower import fp_capable_for, pool_active_for, rs_active_for
         if (cfg.tpu_hist_comm == "reduce_scatter"
@@ -559,6 +575,7 @@ class GBDT:
             goss_top_k, goss_other_k, goss_amp = strategy.goss_constants()
         cegb_lazy = self._cegb_lazy_dev if use_cegb else None
         cegb_coupled_raw = self._cegb_coupled_dev if use_cegb else None
+        health_active = self._health_active
         if (obj is not None and not obj.need_renew_tree_output
                 and not obj.stochastic_gradients):
             def fused(bins, scores, mask, fmask, shrink, quant_key=None,
@@ -599,6 +616,15 @@ class GBDT:
                         coupled, lazy, quant_key=quant_key,
                         split_key=split_key)
                     outs = [(arrays, row_leaf)]
+                hv = None
+                if health_active:
+                    # in-dispatch health vector (resilience/health.py):
+                    # folded into this same program, so the guard adds no
+                    # extra dispatch (profile-census invariant)
+                    from ..resilience.health import health_vector
+                    hv = health_vector(
+                        grad, hess,
+                        tuple(a.leaf_value for a, _rl in outs), new_scores)
                 if use_cegb:
                     new_used = cegb_used
                     if track_used:
@@ -606,7 +632,11 @@ class GBDT:
                             new_used = _mark_features_used_trace(
                                 new_used, arrays.split_feature,
                                 arrays.num_leaves)
+                    if health_active:
+                        return new_scores, outs, new_used, hv
                     return new_scores, outs, new_used
+                if health_active:
+                    return new_scores, outs, hv
                 return new_scores, outs
             self._fused_core = fused      # scanned by the pack path
             self._fused_iter = jax.jit(fused)
@@ -716,6 +746,12 @@ class GBDT:
                 "objective='custom' requires gradients: pass a callable "
                 "objective in params or call update(fobj=...) "
                 "(reference LGBM_BoosterUpdateOneIterCustom)")
+        from ..resilience import faults
+        if faults.nan_grads_due(self.iter_ + 1):
+            # fault seam (resilience/faults.py): one NaN score entering
+            # this round makes the in-trace gradients non-finite — the
+            # exact poison the health sentinel exists to catch
+            self._poison_scores()
         used_fused = grad is None and self.fused_path_active
         goss_in_fused = used_fused and self.sample_strategy.is_goss
         if goss_in_fused:
@@ -741,6 +777,8 @@ class GBDT:
             out = self._hist_fallback_call(
                 "_fused_iter", self.bins_dev, self.scores, mask_dev,
                 fmask, shrink, qkey, skey, it_arg, gkey, used0)
+            if self._health_active:
+                *out, self._health_pending = out
             if self._use_cegb:
                 self.scores, outs, self._cegb_used_dev = out
             else:
@@ -798,6 +836,19 @@ class GBDT:
                 else:
                     self.scores = new_sk
                 results.append((k, arrays, row_leaf))
+            if self._health_active:
+                # non-fused fallback (custom grads / renew objectives /
+                # linear trees): the same reductions, one small extra
+                # dispatch on a path that is already multi-dispatch.
+                # Linear trees attach leaf models host-side, so only the
+                # scores (which any NaN leaf poisons) are checked there.
+                if self._health_eval is None:
+                    from ..resilience.health import health_vector
+                    self._health_eval = jax.jit(health_vector)
+                self._health_pending = self._health_eval(
+                    g_dev, h_dev,
+                    tuple(a.leaf_value for _k, a, _rl in results),
+                    self.scores)
         for k, arrays, row_leaf in results:
             self._store_tree(k, arrays, row_leaf)
         self.iter_ += 1
@@ -945,6 +996,7 @@ class GBDT:
         use_split = self._split_key is not None
         use_goss = strategy.is_goss          # pack-capable => device GOSS
         use_cegb = self._use_cegb
+        health_active = self._health_active
         from ..sampling import bagging_mask_device, feature_mask_device
 
         def packed(bins, scores, iter0, shrink, row_mask, base_fmask,
@@ -967,22 +1019,41 @@ class GBDT:
                            it=it if use_goss else None,
                            goss_key=bag_key if use_goss else None,
                            cegb_used=used)
+                hv = None
+                if health_active:
+                    *out, hv = out
                 if use_cegb:
                     new_sc, outs, new_used = out
-                    return ((new_sc, new_used),
-                            (tuple(a for a, _rl in outs), new_used))
+                    ys = [tuple(a for a, _rl in outs), new_used]
+                    if health_active:
+                        ys.append(hv)
+                    return (new_sc, new_used), tuple(ys)
                 new_sc, outs = out
+                if health_active:
+                    # the per-round health vectors stack alongside the
+                    # trees; commit_round surfaces each at its commit
+                    # boundary (docs/ROBUSTNESS.md)
+                    return new_sc, (tuple(a for a, _rl in outs), hv)
                 return new_sc, tuple(a for a, _rl in outs)
 
             iters = iter0 + jnp.arange(k, dtype=jnp.int32)
+            health_stack = None
             if use_cegb:
-                (scores2, _used2), (stacked, used_stack) = jax.lax.scan(
+                (scores2, _used2), ys = jax.lax.scan(
                     body, (scores, cegb_used), iters)
+                if health_active:
+                    stacked, used_stack, health_stack = ys
+                else:
+                    stacked, used_stack = ys
             else:
-                scores2, stacked = jax.lax.scan(body, scores, iters)
+                scores2, ys = jax.lax.scan(body, scores, iters)
                 used_stack = None
+                if health_active:
+                    stacked, health_stack = ys
+                else:
+                    stacked = ys
             nls = jnp.stack([t.num_leaves for t in stacked], axis=1)
-            return scores2, stacked, nls, used_stack
+            return scores2, stacked, nls, used_stack, health_stack
 
         fn = jax.jit(packed)
         self._pack_fns[k] = fn
@@ -1000,12 +1071,21 @@ class GBDT:
         (and everything after) are trimmed — the exact stop that the
         deferred per-round check in train_one_iter approximates one
         iteration late."""
+        # a previous pack's trailing vector that nothing consumed (e.g. a
+        # callback early-stop at the last committed round) must not be
+        # misattributed to this pack's rounds
+        self._trailing_health = None
         if self._nls_pending is not None:   # drain a deferred legacy check
             pend = jax.device_get(self._nls_pending)
             self._nls_pending = None
             if all(int(x) <= 1 for x in pend):
                 return [], True
         cfg = self.cfg
+        from ..resilience import faults
+        if faults.nan_grads_due(self.iter_ + 1, self.iter_ + k):
+            # fault seam: scores are pack INPUTS, so a target round inside
+            # this pack poisons from the pack's first round (faults.py)
+            self._poison_scores()
         shrink = cfg.learning_rate if cfg.boosting != "rf" else 1.0
         base_fmask = (self._fmask_static if self._fmask_static is not None
                       else jnp.asarray(self.feature_sampler.used))
@@ -1014,13 +1094,22 @@ class GBDT:
                 self._quant_key, self._split_key,
                 self._cegb_used_dev if self._use_cegb else None)
         try:
-            scores2, stacked, nls, used_stack = self._pack_fn(k)(*args)
+            scores2, stacked, nls, used_stack, health_stack = \
+                self._pack_fn(k)(*args)
         except Exception as e:  # noqa: BLE001 — degrade-and-retry (Mosaic)
             if not self._degrade_histogram_impl(e):
                 raise
-            scores2, stacked, nls, used_stack = self._pack_fn(k)(*args)
+            scores2, stacked, nls, used_stack, health_stack = \
+                self._pack_fn(k)(*args)
         self.scores = scores2
-        nls_host = np.asarray(jax.device_get(nls))    # the ONE sync per pack
+        if health_stack is not None:
+            # rides the pack's one host sync below; per-round vectors are
+            # surfaced by commit_round at each commit boundary
+            nls_host, health_host = jax.device_get((nls, health_stack))
+            nls_host = np.asarray(nls_host)
+        else:
+            nls_host = np.asarray(jax.device_get(nls))  # the ONE sync/pack
+            health_host = None
         dead = np.all(nls_host <= 1, axis=1)
         j0 = int(np.argmax(dead)) if dead.any() else k
         finished = bool(dead.any())
@@ -1031,6 +1120,20 @@ class GBDT:
         # early stop) never leaks its first-use marks.
         self._pack_used_pending = (
             [used_stack[j] for j in range(j0)] if self._use_cegb else [])
+        self._pack_health_pending = (
+            [np.asarray(health_host[j], np.float64) for j in range(j0)]
+            if health_host is not None else [])
+        # Degenerate stop: the stopping round is trimmed (never
+        # committed), but its health vector is exactly the evidence a
+        # NaN-poisoned round leaves behind — a poisoned gradient grows no
+        # tree, so without this the sentinel would see a clean "finished"
+        # instead of the divergence.  Kept in its own slot (NOT
+        # _health_pending: the committed rounds' vectors pop over that
+        # slot first) and consumed by the engine's post-pack check after
+        # the last commit's own check has drained.
+        self._trailing_health = (
+            np.asarray(health_host[j0], np.float64)
+            if health_host is not None and j0 < k else None)
         # Rounds at/after the stop are dropped; any that still grew (a
         # later bagging epoch can revive growth after a degenerate round —
         # the reference stops at the FIRST degenerate round regardless)
@@ -1049,7 +1152,64 @@ class GBDT:
             self._store_tree(c, arrays, None)
         if self._pack_used_pending:
             self._cegb_used_dev = self._pack_used_pending.pop(0)
+        if self._pack_health_pending:
+            self._health_pending = self._pack_health_pending.pop(0)
         self.iter_ += 1
+
+    # ------------------------------------------------------- health sentinel
+    def consume_health(self):
+        """The last committed round's health vector as a host float64
+        array (resilience/health.py HEALTH_SLOTS layout), or None when no
+        round produced one since the last call.  Pack rounds surface
+        theirs at commit (already host-side, riding the pack's one sync);
+        per-round vectors cost one small device transfer here.  After the
+        committed vectors drain, the pack's TRAILING vector (the trimmed
+        degenerate-stop round, if any) surfaces exactly once."""
+        h, self._health_pending = self._health_pending, None
+        if h is None:
+            h, self._trailing_health = self._trailing_health, None
+        if h is None:
+            return None
+        return np.asarray(jax.device_get(h), np.float64)
+
+    def apply_health_recovery(self, salt: int) -> None:
+        """Re-fold every device sampling-key stream for recovery
+        generation ``salt`` (resilience/health.py apply_recovery): the
+        rolled-back run must not replay the exact random draws that
+        accompanied the divergence.  Deterministic in (config seeds,
+        salt) and derived from the INITIAL keys, so the Nth in-process
+        rollback and a fresh ``tpu_health_recovery_salt=N`` resume land
+        on identical streams (the bitwise-recovery contract)."""
+        salt = int(salt)
+        if salt <= 0:
+            return
+        cfg = self.cfg
+        fold = 0x48EA17 + salt          # disjoint from iteration folds
+        self._goss_key = jax.random.fold_in(
+            jax.random.PRNGKey(cfg.bagging_seed), fold)
+        self._ff_key = jax.random.fold_in(
+            jax.random.PRNGKey(cfg.feature_fraction_seed), fold)
+        if self._quant_key is not None:
+            self._quant_key = jax.random.fold_in(
+                jax.random.PRNGKey(cfg.seed), fold)
+        if self._split_key is not None:
+            self._split_key = jax.random.fold_in(
+                jax.random.PRNGKey(
+                    cfg.extra_seed * 92821 + cfg.feature_fraction_seed),
+                fold)
+        # pack programs close over nothing key-related (keys are args),
+        # but any deferred stop handle refers to pre-rollback trees
+        self._nls_pending = None
+
+    def _poison_scores(self) -> None:
+        """NaN-poison one train score (the ``nan_grads`` fault seam)."""
+        from ..utils.log import Log
+        Log.warning(f"fault injection: NaN-poisoning train scores before "
+                    f"iteration {self.iter_ + 1} (nan_grads)")
+        if self._shape_k:
+            self.scores = self.scores.at[0, 0].set(jnp.nan)
+        else:
+            self.scores = self.scores.at[0].set(jnp.nan)
 
     # ------------------------------------------------------------ checkpointing
     # DART (host drop/renorm bookkeeping) and RF (averaged scores) carry
@@ -1068,7 +1228,7 @@ class GBDT:
                 f"checkpoint/resume is not supported for "
                 f"boosting={self.cfg.boosting} (per-round host state is "
                 "not captured); train without checkpoint_interval")
-        if self._pack_used_pending:
+        if self._pack_used_pending or self._pack_health_pending:
             raise RuntimeError(
                 "capture_train_state called mid-pack (uncommitted rounds "
                 "pending); snapshots are only sound at iter-pack commit "
@@ -1135,6 +1295,9 @@ class GBDT:
         if self._use_cegb and state.get("cegb_used") is not None:
             self._cegb_used_dev = jnp.asarray(state["cegb_used"])
         self._pack_used_pending = []
+        self._pack_health_pending = []
+        self._health_pending = None
+        self._trailing_health = None
         self.iter_ = int(state["iter_"])
         self.sample_strategy.rng.set_state(state["sample_rng"])
         self.sample_strategy._cached = state["bag_cached"]
@@ -1153,6 +1316,8 @@ class GBDT:
         training had halted per-round.  Stumps carry zero leaf values, so
         subtracting every tree's prediction is exact."""
         self._pack_used_pending = []
+        self._pack_health_pending = []
+        self._trailing_health = None
         for rnd in rounds:
             for c, arrays in enumerate(rnd):
                 self._subtract_tree_scores(c, arrays)

@@ -203,6 +203,12 @@ class GrowerConfig:
     # (interpret-mode on CPU — how tier-1 exercises the kernel body);
     # "unfused" keeps the per-leaf path.  See wave_fused_for.
     wave_kernel: str = "auto"
+    # Training-health sentinel signals (resilience/health.py): True wires
+    # the quantized int16-wire overflow guard's escalation into a
+    # jax.debug.callback report instead of a silent int32 fallback.  False
+    # (the default, tpu_health_policy=off) traces the EXACT pre-sentinel
+    # program — no callbacks, no HLO change.
+    health_signal: bool = False
 
 
 class TreeArrays(NamedTuple):
@@ -1483,6 +1489,21 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
                 # ints < 2^24; anything larger fails the guard anyway.
                 bound = jax.lax.psum(
                     jnp.max(jnp.abs(h)).astype(jnp.float32), axis)
+                from ..resilience import faults
+                if faults.active("overflow_hist"):
+                    # fault seam (trace-time): classify every reduction as
+                    # overflowing so the exact int32 fallback + the health
+                    # report below run deterministically in tests
+                    bound = bound + jnp.float32(65536.0)
+                if cfg.health_signal:
+                    # Promoted health signal (resilience/health.py): the
+                    # silent int32 fallback now reports each escalation —
+                    # a wire overflow means the quantized gradient scale
+                    # no longer fits the shape and deserves triage, even
+                    # though the fallback keeps the sums exact.
+                    from ..resilience.health import record_hist_overflow
+                    jax.debug.callback(record_hist_overflow,
+                                       bound > 32767.0)
                 return jax.lax.cond(
                     bound <= 32767.0,
                     lambda x: histogram_reduce_scatter_local(

@@ -84,6 +84,21 @@ class ObjectiveFunction:
         return None if self.weight is None else np.asarray(self.weight)
 
 
+def _check_label_range(label, name: str, lo: float = 0.0,
+                       strict: bool = False) -> None:
+    """Reference per-objective ``CheckLabel`` (e.g.
+    ``regression_objective.hpp:RegressionPoissonLoss::Init``): a label the
+    loss is undefined for must fail loudly at init, not surface as a NaN
+    gradient mid-run."""
+    lab = np.asarray(label, np.float64)
+    bad = (lab <= lo) if strict else (lab < lo)
+    if lab.size and bad.any():
+        op = ">" if strict else ">="
+        raise ValueError(
+            f"objective={name} requires labels {op} {lo:g}; found "
+            f"minimum {lab.min():g}")
+
+
 def _weighted_percentile(values: np.ndarray, weight: Optional[np.ndarray],
                          alpha: float) -> float:
     """Reference ``PercentileFun``/``WeightedPercentileFun``
@@ -225,6 +240,10 @@ class Poisson(ObjectiveFunction):
     def __init__(self):
         super().__init__(name="poisson")
 
+    def init(self, label, weight, group, cfg, position=None):
+        super().init(label, weight, group, cfg, position)
+        _check_label_range(label, self.name, lo=0.0)
+
     def get_gradients(self, score):
         mu = jnp.exp(score)
         grad = mu - self.label
@@ -296,6 +315,10 @@ class Gamma(ObjectiveFunction):
     def __init__(self):
         super().__init__(name="gamma")
 
+    def init(self, label, weight, group, cfg, position=None):
+        super().init(label, weight, group, cfg, position)
+        _check_label_range(label, self.name, lo=0.0, strict=True)
+
     def get_gradients(self, score):
         e = jnp.exp(-score)
         grad = 1.0 - self.label * e
@@ -317,6 +340,10 @@ class Tweedie(ObjectiveFunction):
 
     def __init__(self):
         super().__init__(name="tweedie")
+
+    def init(self, label, weight, group, cfg, position=None):
+        super().init(label, weight, group, cfg, position)
+        _check_label_range(label, self.name, lo=0.0)
 
     def get_gradients(self, score):
         rho = self.cfg.tweedie_variance_power
@@ -347,6 +374,13 @@ class Binary(ObjectiveFunction):
     def init(self, label, weight, group, cfg, position=None):
         super().init(label, weight, group, cfg, position)
         label01 = np.asarray(label)
+        if label01.size and not np.isin(label01, (0.0, 1.0)).all():
+            # reference BinaryLogloss::CheckLabel: {0, 1} only — a stray
+            # -1/+1 encoding silently flips every "negative" to positive
+            raise ValueError(
+                "objective=binary requires labels in {0, 1}; found values "
+                f"outside (e.g. "
+                f"{label01[~np.isin(label01, (0.0, 1.0))][:4].tolist()})")
         npos = float((label01 > 0).sum())
         nneg = float(len(label01) - npos)
         if cfg.is_unbalance and npos > 0 and nneg > 0:

@@ -13,6 +13,9 @@ must not drag the package — and therefore jax — in):
 - :mod:`faults` — the deterministic fault-injection seam
   (``LIGHTGBM_TPU_FAULTS=wedge_dispatch:600,kill_after_iter:7,...``) the
   recovery-path tests drive.
+- :mod:`health` — the training-health sentinel (in-dispatch NaN/Inf/
+  overflow health vector, loss-divergence detection, checkpoint-backed
+  auto-recovery under ``tpu_health_policy=rollback``).
 - serve-side graceful degradation lives in :mod:`lightgbm_tpu.serve`
   (bounded queue, deadlines, one-shot host fallback) and only consumes
   the fault seam from here.

@@ -15,6 +15,20 @@ is a specific seam a production failure enters through:
 - ``serve_device_error:<n>`` — the ``n``-th serve device dispatch in this
   process raises (default the 1st); drives the one-shot host-predict
   fallback and its ServeMetrics counters.
+- ``nan_grads:<iter>`` — poison the train scores entering boosting round
+  ``iter`` (1-based) with one NaN, so that round's in-trace gradients go
+  non-finite; fires ONCE per :func:`install` so a rolled-back run can
+  recover instead of re-tripping forever.  With iteration packing the
+  poison lands at the pack whose window contains ``iter`` (the scores are
+  pack inputs), i.e. at the nearest pack boundary at/before it.
+- ``inf_loss:<iter>`` — the health sentinel sees an injected ``inf`` loss
+  row for round ``iter`` (1-based); drives the divergence detector and
+  its policies without numerically contaminating the model.  Once per
+  :func:`install`.
+- ``overflow_hist`` — force the quantized int16-wire histogram
+  reduce-scatter guard to classify every reduction as overflowing (the
+  exact int32 fallback engages and, with the sentinel armed, reports).
+  Read at trace time: arm it before the first training dispatch.
 
 Tests can also :func:`install` a spec in-process instead of mutating the
 environment.  Unknown fault names warn once and are ignored — a typo must
@@ -32,7 +46,8 @@ from typing import Dict, Optional
 ENV_VAR = "LIGHTGBM_TPU_FAULTS"
 
 KNOWN_FAULTS = ("wedge_dispatch", "kill_after_iter", "corrupt_ckpt",
-                "serve_device_error")
+                "serve_device_error", "nan_grads", "inf_loss",
+                "overflow_hist")
 
 _lock = threading.Lock()
 _override: Optional[str] = None
@@ -117,6 +132,40 @@ def serve_error_due() -> bool:
         _counters["serve_device_error"] = \
             _counters.get("serve_device_error", 0) + 1
         return _counters["serve_device_error"] == n
+
+
+def _once_at_iter(name: str, iteration: int,
+                  upto: Optional[int] = None) -> bool:
+    """True exactly once per :func:`install`, when the armed ``name:<n>``
+    target falls inside the closed round window ``[iteration, upto]``
+    (``upto`` defaults to ``iteration`` — an exact match on the 1-based
+    boosting round)."""
+    val = spec().get(name)
+    if val is None:
+        return False
+    n = int(val) if val else 1
+    hi = int(upto) if upto is not None else int(iteration)
+    if not int(iteration) <= n <= hi:
+        return False
+    with _lock:
+        if _consumed.get(name):
+            return False
+        _consumed[name] = True
+        return True
+
+
+def nan_grads_due(iteration: int, upto: Optional[int] = None) -> bool:
+    """True once when round ``iteration`` (1-based) should train on
+    NaN-poisoned scores.  ``upto`` widens the match to the closed pack
+    window ``[iteration, upto]`` — scores are pack INPUTS, so a target
+    anywhere inside the pack poisons from the pack's first round."""
+    return _once_at_iter("nan_grads", iteration, upto)
+
+
+def inf_loss_due(iteration: int) -> bool:
+    """True once when the sentinel should observe an injected infinite
+    loss for round ``iteration`` (1-based)."""
+    return _once_at_iter("inf_loss", iteration)
 
 
 def corrupt_latest_due() -> bool:

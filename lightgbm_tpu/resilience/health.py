@@ -1,0 +1,372 @@
+"""Training-health sentinel: in-trace NaN/Inf/overflow guards, divergence
+detection on the per-round loss history, and checkpoint-backed auto-recovery
+(docs/ROBUSTNESS.md).
+
+Three layers, cheapest first:
+
+1. **Health vector** — :func:`health_vector` folds ``isfinite``/max-abs
+   reductions over the gradients, hessians, leaf values and updated train
+   scores INTO the existing training dispatch (the fused one-dispatch
+   iteration and the iter-pack ``lax.scan`` body both emit it), so guarding
+   every round adds zero extra device programs.  The vector is surfaced at
+   iter-pack **commit boundaries** (mid-pack rounds are checked from the
+   scanned stack exactly when they commit), preserving packing semantics.
+2. **Divergence detector** — :class:`TrainingHealthSentinel` watches the
+   per-round eval/train-loss history for non-finite values, a configurable
+   spike over a trailing window, and bitwise stagnation (the flat-line that
+   precedes saturation-to-NaN), plus the promoted quantized int16-wire
+   histogram-overflow signal (:func:`record_hist_overflow` — the grower's
+   reduce-scatter guard reports its escalation instead of silently falling
+   back to the int32 wire).
+3. **Recovery** — under ``tpu_health_policy=rollback`` the engine restores
+   the last good PR-6 checkpoint in-process and calls
+   :func:`apply_recovery`: learning-rate backoff + a salt-folded device
+   sampling-key stream.  The same function runs when a FRESH run resumes
+   from that checkpoint with ``tpu_health_recovery_salt`` set, which is why
+   the recovered run's trees are bitwise-identical to the fresh run's
+   (pinned by tests/test_health.py).  ``tpu_health_max_rollbacks`` caps the
+   retries before :class:`HealthHaltError` escalates.
+
+Policy knob: ``tpu_health_policy=off|warn|halt|rollback``.  ``off`` (the
+default) compiles EXACTLY the pre-sentinel programs — no reductions, no
+signal callbacks — so default training stays bitwise-identical to a build
+without this module.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..utils.log import Log
+from . import faults
+
+POLICIES = ("off", "warn", "halt", "rollback")
+
+# Slot layout of the in-dispatch health vector (float32, len == len(SLOTS)).
+# The first four are non-finite COUNTS (0 == healthy); the last is the
+# max-abs train score (overflow saturation shows here before the NaN does).
+HEALTH_SLOTS = ("grad_nonfinite", "hess_nonfinite", "leaf_nonfinite",
+                "score_nonfinite", "score_max_abs")
+
+
+class HealthHaltError(RuntimeError):
+    """Training halted by the health sentinel (``tpu_health_policy=halt``,
+    or ``rollback`` after ``tpu_health_max_rollbacks`` failed recoveries /
+    with no checkpoint to roll back to).  The partially-trained booster is
+    attached as ``.booster`` for triage."""
+
+    def __init__(self, message: str, booster=None):
+        super().__init__(message)
+        self.booster = booster
+
+
+def health_vector(grad, hess, leaf_values: Sequence, scores):
+    """The fused health reductions — pure ``jnp``, traced INSIDE the
+    training dispatch (gbdt fused iteration / pack scan body) so the guard
+    adds no extra device program.  ``leaf_values`` is the per-class tuple
+    of this round's (shrunk) leaf-value arrays."""
+    import jax.numpy as jnp
+
+    leaf_bad = jnp.zeros((), jnp.float32)
+    for lv in leaf_values:
+        leaf_bad = leaf_bad + (~jnp.isfinite(lv)).sum().astype(jnp.float32)
+    return jnp.stack([
+        (~jnp.isfinite(grad)).sum().astype(jnp.float32),
+        (~jnp.isfinite(hess)).sum().astype(jnp.float32),
+        leaf_bad,
+        (~jnp.isfinite(scores)).sum().astype(jnp.float32),
+        jnp.max(jnp.abs(scores)).astype(jnp.float32),
+    ])
+
+
+# --------------------------------------------------------------- overflow
+# Promoted int16-wire histogram-overflow signal (models/grower.py _make_rs:
+# the quantized reduce-scatter wire falls back to int32 when the exact
+# psum-of-max-abs bound exceeds int16 range).  The guard itself is
+# in-trace; with the sentinel active it reports each escalation through a
+# jax.debug.callback into this process-level flag, which the sentinel
+# drains once per observed round (shard multiplicity therefore cannot
+# inflate the count — a round either escalated or it did not).
+_ovf_lock = threading.Lock()
+_ovf_flag = False
+_ovf_total = 0
+
+
+def record_hist_overflow(escalated) -> None:
+    """jax.debug.callback target: one call per reduce-scatter wire
+    decision; ``escalated`` True means the int16 wire overflowed and the
+    guard took the int32 fallback."""
+    global _ovf_flag, _ovf_total
+    if bool(escalated):
+        with _ovf_lock:
+            _ovf_flag = True
+            _ovf_total += 1
+
+
+def consume_overflow_flag() -> bool:
+    """Read-and-clear the per-round escalation flag (sentinel cadence)."""
+    global _ovf_flag
+    import jax
+    try:
+        jax.effects_barrier()   # flush pending debug callbacks
+    except Exception:  # noqa: BLE001 — barrier is best-effort on old jax
+        pass
+    with _ovf_lock:
+        flag, _f = _ovf_flag, None
+        _ovf_flag = False
+    return flag
+
+
+def overflow_total() -> int:
+    """Process-lifetime escalation callback count (bench reporting)."""
+    with _ovf_lock:
+        return _ovf_total
+
+
+def reset_overflow() -> None:
+    global _ovf_flag, _ovf_total
+    with _ovf_lock:
+        _ovf_flag = False
+        _ovf_total = 0
+
+
+# --------------------------------------------------------------- recovery
+def apply_recovery(booster, salt: int, base_lr: Optional[float] = None,
+                   backoff: Optional[float] = None) -> None:
+    """Apply recovery generation ``salt`` to a just-restored booster:
+    learning-rate backoff (``base_lr * backoff**salt``) plus the gbdt's
+    salt-folded device sampling-key streams.  Deterministic in ``salt``
+    and idempotent on a fresh restore — the in-process rollback and a
+    fresh ``train(resume_from=..., tpu_health_recovery_salt=salt)`` run
+    execute this exact function, which is what makes the two runs'
+    continuation trees bitwise-identical."""
+    salt = int(salt)
+    if salt <= 0:
+        return
+    cfg = booster.cfg
+    if backoff is None:
+        backoff = cfg.tpu_health_lr_backoff
+    # base_lr defaults to the restored config's rate: snapshots are taken
+    # BEFORE any rollback, so cfg.learning_rate right after restore() is
+    # the original schedule value in both the in-process and fresh paths.
+    if base_lr is None:
+        base_lr = cfg.learning_rate
+    lr = float(base_lr) * float(backoff) ** salt
+    if lr != cfg.learning_rate:
+        Log.warning(
+            f"health recovery #{salt}: learning_rate {base_lr:g} -> {lr:g} "
+            f"(backoff {backoff:g})")
+        booster.reset_parameter({"learning_rate": lr})
+    booster._gbdt.apply_health_recovery(salt)
+
+
+# --------------------------------------------------------------- sentinel
+class HealthTrip:
+    """One tripped sentinel check: ``reason`` is the short machine-ish tag
+    (the taxonomy in docs/ROBUSTNESS.md), ``detail`` the human line."""
+
+    def __init__(self, reason: str, detail: str, iteration: int):
+        self.reason = reason
+        self.detail = detail
+        self.iteration = int(iteration)
+
+    def __str__(self) -> str:
+        return f"[iter {self.iteration}] {self.reason}: {self.detail}"
+
+
+class TrainingHealthSentinel:
+    """Per-run health state machine the engine drives once per COMMITTED
+    round: consumes the in-dispatch health vector, the round's eval
+    results and the histogram-overflow flag, and answers with a
+    :class:`HealthTrip` when something is wrong.  Policy dispatch (warn /
+    halt / rollback) stays in the engine — this class only detects and
+    keeps the report."""
+
+    def __init__(self, cfg):
+        if cfg.tpu_health_policy not in POLICIES:
+            raise ValueError(
+                f"tpu_health_policy={cfg.tpu_health_policy!r}: expected "
+                f"one of {', '.join(POLICIES)}")
+        self.policy = cfg.tpu_health_policy
+        self.spike_factor = float(cfg.tpu_health_spike_factor)
+        self.window = int(cfg.tpu_health_window)
+        self.score_limit = float(cfg.tpu_health_score_limit)
+        self.max_rollbacks = int(cfg.tpu_health_max_rollbacks)
+        # trailing windows per (dataset, metric) for lower-is-better losses
+        self._hist: Dict[Tuple[str, str], List[float]] = {}
+        self.rounds_checked = 0
+        self.rollbacks = 0
+        self.overflow_rounds = 0
+        self.halted = False
+        self.trips: List[HealthTrip] = []
+        self.last_health: Optional[np.ndarray] = None
+
+    # ------------------------------------------------------------- detect
+    def observe_round(self, iteration: int, health: Optional[np.ndarray],
+                      evals: Optional[Sequence[Tuple[str, str, float, bool]]]
+                      ) -> Optional[HealthTrip]:
+        """Check one committed round.  ``health`` is the host copy of the
+        in-dispatch vector (None on paths that did not produce one),
+        ``evals`` the round's ``(dataset, metric, value, higher_better)``
+        rows (None when nothing was evaluated)."""
+        self.rounds_checked += 1
+        if consume_overflow_flag():
+            self.overflow_rounds += 1
+            Log.warning(
+                f"health: quantized histogram int16 wire overflowed at "
+                f"iteration {iteration} (exact int32 fallback taken); "
+                "gradient resolution may be mis-scaled for this shape")
+        trip = None
+        if health is not None:
+            self.last_health = np.asarray(health, np.float64)
+            trip = self._check_vector(iteration, self.last_health)
+        if trip is None:
+            trip = self._check_losses(iteration, evals)
+        if trip is not None:
+            self.trips.append(trip)
+        return trip
+
+    def _check_vector(self, iteration: int,
+                      hv: np.ndarray) -> Optional[HealthTrip]:
+        for slot, val in zip(HEALTH_SLOTS[:4], hv[:4]):
+            if not np.isfinite(val) or val > 0:
+                return HealthTrip(
+                    slot, f"{int(val) if np.isfinite(val) else val} "
+                    "non-finite elements in the training dispatch",
+                    iteration)
+        max_abs = float(hv[4])
+        if not np.isfinite(max_abs):
+            return HealthTrip("score_nonfinite",
+                              "max|score| is non-finite", iteration)
+        if 0.0 < self.score_limit < max_abs:
+            return HealthTrip(
+                "score_overflow",
+                f"max|score|={max_abs:.3e} exceeds tpu_health_score_limit="
+                f"{self.score_limit:g}", iteration)
+        return None
+
+    def _check_losses(self, iteration: int, evals) -> Optional[HealthTrip]:
+        if faults.inf_loss_due(iteration):
+            # fault seam: drive the divergence detector without having to
+            # actually diverge the model (resilience/faults.py)
+            evals = list(evals or []) + [
+                ("train", "injected_loss", float("inf"), False)]
+        if not evals:
+            return None
+        for name, metric, value, higher_better in evals:
+            value = float(value)
+            if not np.isfinite(value):
+                return HealthTrip(
+                    "nonfinite_loss",
+                    f"{name} {metric} = {value}", iteration)
+            if higher_better:
+                continue   # spike/stagnation reason about losses only
+            key = (name, metric)
+            hist = self._hist.setdefault(key, [])
+            if len(hist) >= self.window:
+                best = min(hist[-self.window:])
+                if best > 0 and value > self.spike_factor * best:
+                    return HealthTrip(
+                        "loss_spike",
+                        f"{name} {metric} = {value:.6g} > "
+                        f"{self.spike_factor:g} x trailing best "
+                        f"{best:.6g}", iteration)
+                tail = hist[-(self.window - 1):] + [value]
+                if len(set(tail)) == 1 and value != 0.0:
+                    # bitwise-flat loss for a whole window: boosting that
+                    # no longer moves ANY score bit usually means the
+                    # scores have saturated on their way to NaN
+                    return HealthTrip(
+                        "loss_stagnation",
+                        f"{name} {metric} bitwise-flat at {value:.6g} for "
+                        f"{self.window} rounds", iteration)
+            hist.append(value)
+            del hist[: -4 * self.window]
+        return None
+
+    # ----------------------------------------------------------- recovery
+    def note_rollback(self, restored_iter: int, salt: int) -> None:
+        """Record a performed rollback and reset the loss windows — the
+        restored history must not spike-compare against diverged values."""
+        self.rollbacks += 1
+        self._hist.clear()
+        Log.warning(
+            f"health: rolled back to iteration {restored_iter} "
+            f"(recovery #{salt}, {self.rollbacks}/{self.max_rollbacks} "
+            "rollbacks used)")
+
+    def note_halt(self) -> None:
+        """Record that the engine is escalating to HealthHaltError — the
+        terminal verdict must say "halted" even when earlier rollbacks
+        succeeded (a triage table reading "recovered" for a dead run
+        would page nobody)."""
+        self.halted = True
+
+    # ------------------------------------------------------------- report
+    def verdict(self) -> str:
+        if self.halted:
+            return "halted"
+        if self.trips and self.rollbacks == 0:
+            return "tripped"
+        if self.trips:
+            return "recovered"
+        return "healthy"
+
+    def report(self) -> dict:
+        """The ``detail.health`` block shape bench.py embeds in every BENCH
+        blob and tools/health_report.py summarizes."""
+        return {
+            "policy": self.policy,
+            "verdict": self.verdict(),
+            "rounds_checked": self.rounds_checked,
+            "trips": [str(t) for t in self.trips[-8:]],
+            "trip_count": len(self.trips),
+            "rollbacks": self.rollbacks,
+            "overflow_escalations": self.overflow_rounds,
+            "last_health": (None if self.last_health is None else
+                            {k: float(v) for k, v in
+                             zip(HEALTH_SLOTS, self.last_health)}),
+        }
+
+
+def off_report(policy: str = "off") -> dict:
+    """The health block for a run that never armed the sentinel — BENCH
+    blobs carry the block unconditionally so the triage table can tell
+    "checked and healthy" from "never checked"."""
+    return {"policy": policy, "verdict": "unchecked", "rounds_checked": 0,
+            "trips": [], "trip_count": 0, "rollbacks": 0,
+            "overflow_escalations": overflow_total(), "last_health": None}
+
+
+def bench_health_block(booster, rounds: int) -> dict:
+    """One post-hoc health audit for bench rungs that train through raw
+    ``Booster.update`` loops (no engine sentinel in the timed window): run
+    the SAME health reductions once over the final gradients/scores,
+    outside the timed region, and fold in the process-level overflow
+    tally.  Returns the ``detail.health`` schema."""
+    import jax
+
+    g = getattr(booster, "_gbdt", booster)
+    out = off_report(getattr(g.cfg, "tpu_health_policy", "off"))
+    out["rounds_checked"] = int(rounds)
+    try:
+        obj = g.objective
+        scores = g.scores
+        if obj is not None:
+            grad, hess = obj.get_gradients(scores)
+        else:
+            import jax.numpy as jnp
+            grad = hess = jnp.zeros((1,), jnp.float32)
+        hv = np.asarray(jax.device_get(
+            health_vector(grad, hess, (), scores)), np.float64)
+        out["last_health"] = {k: float(v)
+                              for k, v in zip(HEALTH_SLOTS, hv)}
+        bad = (hv[:4] > 0).any() or not np.isfinite(hv).all()
+        out["verdict"] = "tripped" if bad else "healthy"
+    except Exception as e:  # noqa: BLE001 — audit is garnish on the rate
+        out["verdict"] = "error"
+        out["error"] = f"{e!r}"[:160]
+    return out

@@ -36,6 +36,10 @@ class ServeMetrics:
         self.deadline_misses = 0
         self.device_faults = 0
         self.host_fallbacks = 0
+        # Health guard (docs/ROBUSTNESS.md): device dispatches whose
+        # scores came back non-finite — answered from the host mirror
+        # instead of shipping NaN to a caller.
+        self.nan_scores = 0
 
     # ------------------------------------------------------------- recording
     def observe_request(self, rows: int, seconds: float) -> None:
@@ -71,6 +75,10 @@ class ServeMetrics:
         with self._lock:
             self.host_fallbacks += 1
 
+    def observe_nan_scores(self) -> None:
+        with self._lock:
+            self.nan_scores += 1
+
     # ------------------------------------------------------------ reporting
     def latency_quantiles_ms(self) -> Dict[str, Optional[float]]:
         with self._lock:
@@ -100,6 +108,7 @@ class ServeMetrics:
                 "deadline_misses": self.deadline_misses,
                 "device_faults": self.device_faults,
                 "host_fallbacks": self.host_fallbacks,
+                "nan_scores": self.nan_scores,
             }
         out.update(self.latency_quantiles_ms())
         if plan is not None:

@@ -13,6 +13,15 @@ model, no device touch) instead of failing the request; the MicroBatcher
 adds admission control (``serve_max_queue`` -> :class:`ServeOverloadError`)
 and per-request deadlines (``serve_deadline_ms`` ->
 :class:`ServeDeadlineError`), all counted in :class:`ServeMetrics`.
+
+Health guards (docs/ROBUSTNESS.md health section): every device dispatch's
+scores are checked finite — non-finite output is answered from the same
+host mirror (f64 raw-threshold traversal, which heals device-side numeric
+faults) and counted in ``ServeMetrics.nan_scores``; raw DENSE inputs
+carrying ``inf`` are rejected up front with a ``ValueError`` (the binning
+contract reserves non-finite for NaN-as-missing — an Inf row would bin
+into the last value bin on the host path but has no defined device
+bit-key ordering).
 """
 
 from __future__ import annotations
@@ -72,6 +81,19 @@ def _host_convert_output(cfg, raw: np.ndarray) -> np.ndarray:
     return raw
 
 
+def _reject_inf_rows(X: np.ndarray) -> None:
+    """Raw-input sanitization (binning contract): NaN means missing and is
+    welcome; ``inf`` is not a value the bin mappers define an ordering
+    for, so Inf-laden rows are the CALLER's bug — rejected with a clear
+    error instead of silently binning into the last value bin."""
+    if np.isinf(X).any():
+        rows = np.unique(np.nonzero(np.isinf(X))[0])[:8]
+        raise ValueError(
+            f"input rows {rows.tolist()} contain inf values; the binning "
+            "contract accepts NaN (missing) but not inf — clean or clip "
+            "the feature pipeline upstream")
+
+
 class Predictor:
     """Long-lived compiled inference handle for one Booster slice
     (reference ``Predictor``, ``src/application/predictor.cpp``: extract
@@ -127,12 +149,15 @@ class Predictor:
     def num_features(self) -> int:
         return self.plan.num_features
 
-    def predict(self, X, _record: bool = True) -> np.ndarray:
+    def predict(self, X, _record: bool = True,
+                _validated: bool = False) -> np.ndarray:
         """Scores for a batch of rows — one compiled dispatch, recorded in
         the serving metrics.  Accepts dense arrays (device binning) or
         scipy sparse (host binning from CSC, device traversal).  A faulted
         device dispatch is answered once from the host mirror
-        (``host_fallback``) instead of failing the request."""
+        (``host_fallback``) instead of failing the request.
+        ``_validated`` skips the Inf-input scan for callers (the
+        MicroBatcher) that already door-step-checked every row."""
         t0 = time.perf_counter()
         sparse = _is_sparse(X)
         if sparse:
@@ -151,9 +176,23 @@ class Predictor:
                 raise ValueError(
                     f"plan expects (N, {self.plan.num_features}) rows, "
                     f"got {X.shape}")
+            if not _validated:
+                _reject_inf_rows(X)
             n = X.shape[0]
         try:
             out = self._predict_device(X, sparse)
+            if not np.isfinite(out).all():
+                # Health guard: never ship NaN/Inf scores.  The host
+                # mirror recomputes in f64 from the serialized model — a
+                # device-side numeric fault heals; a genuinely poisoned
+                # model still answers (counted either way, so the gauge
+                # pages before a customer does).
+                self.metrics.observe_nan_scores()
+                if self._host_fallback:
+                    out = self._predict_host(
+                        X, sparse,
+                        RuntimeError("non-finite scores from the device "
+                                     "dispatch"))
         except (ValueError, TypeError):
             # caller input errors are the caller's to see — only
             # infrastructure faults route to the host mirror
@@ -326,6 +365,8 @@ class MicroBatcher:
             raise ValueError(
                 f"expected rows with {self.predictor.num_features} "
                 f"features, got {X.shape}")
+        _reject_inf_rows(X)   # same door-step rule: one Inf-laden request
+        # must not poison (or fail) every co-batched caller
         fut: Future = Future()
         with self._submit_lock:
             if self._closed:
@@ -410,8 +451,10 @@ class MicroBatcher:
                 return
         xs = [x for x, _f, _t in batch]
         try:
+            # _validated: every request was Inf-scanned at submit(), so
+            # the coalesced batch skips the redundant second pass
             out = self.predictor.predict(np.concatenate(xs, axis=0),
-                                         _record=False)
+                                         _record=False, _validated=True)
         except Exception as e:  # noqa: BLE001 — fail every caller, not the loop
             for _x, fut, _t in batch:
                 self._settle(fut, exc=e)

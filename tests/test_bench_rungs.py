@@ -24,6 +24,12 @@ def _assert_hlo_cost(blob):
     cost = blob["hlo_cost"]
     assert cost.get("flops", 0) > 0, cost
     assert cost.get("bytes_accessed", 0) > 0, cost
+    # ISSUE-8 satellite: every rung blob also carries the post-hoc health
+    # audit — a rung that trained on NaN can't publish a clean rate.
+    h = blob["health"]
+    assert h["verdict"] == "healthy", h
+    assert h["rounds_checked"] == blob["iters"]
+    assert h["last_health"]["grad_nonfinite"] == 0.0
 
 
 def test_ltr_rung_blob():

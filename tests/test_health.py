@@ -1,0 +1,518 @@
+"""Training-health sentinel (ISSUE 8, resilience/health.py).
+
+Pins the subsystem's contract:
+- the in-dispatch health vector catches an injected NaN gradient at the
+  exact round, under the per-round path AND inside the iter-pack scan
+  (surfaced at commit boundaries, K=1 == K=4 trees with the sentinel on),
+- ``tpu_health_policy``: off is bitwise-inert, warn logs and continues,
+  halt raises :class:`HealthHaltError`, rollback restores the last good
+  checkpoint in-process and the recovered model is BITWISE identical to a
+  fresh run resumed from that checkpoint with the same recovery salt (the
+  acceptance criterion),
+- ``tpu_health_max_rollbacks`` caps recovery; rollback without a
+  checkpoint escalates instead of looping,
+- the divergence detector: non-finite loss (the ``inf_loss`` fault),
+  spike-over-trailing-window, bitwise stagnation,
+- the promoted quantized int16-wire overflow signal (``overflow_hist``
+  fault) reports escalations while the int32 fallback keeps trees exact,
+- serve guards: non-finite device scores answer from the host mirror
+  (counted in ``ServeMetrics.nan_scores``, incl. multiclass softmax) and
+  Inf-laden raw inputs are rejected at the door,
+- ingestion validation: non-finite labels/weights, binary/poisson label
+  domains, all-NaN / constant feature column warnings.
+
+Every injected failure goes through resilience/faults.py — deterministic,
+no real divergence required.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.resilience import checkpoint, faults, health
+from lightgbm_tpu.resilience.health import (HealthHaltError,
+                                            TrainingHealthSentinel)
+
+pytestmark = pytest.mark.health
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    """No test inherits another's armed faults or overflow tallies."""
+    faults.install(None)
+    health.reset_overflow()
+    yield
+    faults.install(None)
+    health.reset_overflow()
+
+
+def _data(n=400, f=8, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, f)
+    y = (X[:, 0] + X[:, 1] > 1.0).astype(np.float64)
+    return X, y
+
+
+BASE = {"objective": "binary", "num_leaves": 7, "verbosity": -1,
+        "min_data_in_leaf": 5, "seed": 3}
+
+
+def _train(params, X, y, rounds=8, **kw):
+    return lgb.train(dict(params), lgb.Dataset(X.copy(), label=y.copy()),
+                     num_boost_round=rounds, **kw)
+
+
+def _trees(model_str: str) -> str:
+    """The tree sections only — the trailing parameters block echoes
+    tpu_health_* knobs, which differ between compared configs by design."""
+    return model_str[model_str.index("Tree=0"): model_str.index("end of trees")]
+
+
+# ------------------------------------------------------------ inert default
+def test_policy_off_is_bitwise_inert():
+    """policy=off and an explicit warn run grow IDENTICAL trees — the
+    guards add no observable numeric change (acceptance criterion)."""
+    X, y = _data()
+    s_off = _trees(_train(BASE, X, y).model_to_string())
+    s_warn = _trees(_train(dict(BASE, tpu_health_policy="warn"), X, y)
+                    .model_to_string())
+    assert s_off == s_warn
+
+
+def test_bad_policy_rejected():
+    X, y = _data(100, 4)
+    with pytest.raises(ValueError, match="tpu_health_policy"):
+        _train(dict(BASE, tpu_health_policy="explode"), X, y, rounds=1)
+
+
+# ------------------------------------------------------- detection policies
+def test_nan_grads_halt_per_round():
+    X, y = _data()
+    faults.install("nan_grads:5")
+    with pytest.raises(HealthHaltError, match="grad_nonfinite"):
+        _train(dict(BASE, tpu_health_policy="halt", tpu_iter_pack=1), X, y)
+
+
+def test_nan_grads_halt_packed():
+    X, y = _data()
+    faults.install("nan_grads:5")
+    with pytest.raises(HealthHaltError, match="nonfinite"):
+        _train(dict(BASE, tpu_health_policy="halt", tpu_iter_pack=4), X, y)
+
+
+def test_nan_grads_warn_continues_and_reports():
+    X, y = _data()
+    faults.install("nan_grads:5")
+    bst = _train(dict(BASE, tpu_health_policy="warn", tpu_iter_pack=1), X, y)
+    rep = bst._health_report
+    assert rep["verdict"] == "tripped"
+    assert any("grad_nonfinite" in t for t in rep["trips"])
+    assert rep["rollbacks"] == 0
+
+
+def test_health_report_always_attached():
+    X, y = _data(100, 4)
+    bst = _train(BASE, X, y, rounds=2)
+    assert bst._health_report["policy"] == "off"
+    assert bst._health_report["verdict"] == "unchecked"
+
+
+def test_inf_loss_drives_divergence_detector():
+    """The detector path (not the health vector): the model never actually
+    diverges, the sentinel just observes an injected inf loss row."""
+    X, y = _data()
+    faults.install("inf_loss:4")
+    with pytest.raises(HealthHaltError, match="nonfinite_loss"):
+        _train(dict(BASE, tpu_health_policy="halt",
+                    metric="binary_logloss"), X, y,
+               valid_sets=[lgb.Dataset(X[:100].copy(),
+                                       label=y[:100].copy())])
+
+
+def test_pack_training_metric_no_false_stagnation():
+    """Mid-pack, train scores already hold the whole pack's update, so the
+    training metric is the same value at every commit — the sentinel must
+    not read that as loss_stagnation on a healthy run (training rows are
+    dropped from the detector under packing; valid rows advance per
+    commit and stay)."""
+    X, y = _data()
+    bst = _train(dict(BASE, tpu_health_policy="halt", tpu_iter_pack=8,
+                      is_provide_training_metric=True,
+                      metric="binary_logloss"), X, y, rounds=16)
+    assert bst._health_report["verdict"] == "healthy"
+    assert bst._gbdt.iter_ == 16
+
+
+def test_pack_parity_with_sentinel_active():
+    """K=1 == K=4 trees with the sentinel armed: the health carry in the
+    scan body is observation-only."""
+    X, y = _data()
+    p = dict(BASE, tpu_health_policy="warn")
+    s1 = _trees(_train(dict(p, tpu_iter_pack=1), X, y).model_to_string())
+    s4 = _trees(_train(dict(p, tpu_iter_pack=4), X, y).model_to_string())
+    assert s1 == s4
+
+
+# ------------------------------------------------------------- auto-recovery
+def _rollback_params(d, **extra):
+    return dict(BASE, tpu_iter_pack=4, checkpoint_interval=4,
+                checkpoint_keep=8, checkpoint_dir=d,
+                tpu_health_policy="rollback", **extra)
+
+
+def test_rollback_recovers_bitwise_vs_fresh_resume(tmp_path):
+    """THE acceptance criterion: NaN at round 10 of 16 under rollback ->
+    restore the iter-8 snapshot in-process, back off lr, re-fold keys,
+    finish — and the final model's trees are bitwise identical to a fresh
+    run resumed from the same snapshot with tpu_health_recovery_salt=1."""
+    d = str(tmp_path / "ck")
+    X, y = _data()
+    faults.install("nan_grads:10")
+    rec = _train(_rollback_params(d), X, y, rounds=16)
+    faults.install(None)
+    rep = rec._health_report
+    assert rep["verdict"] == "recovered"
+    assert rep["rollbacks"] == 1
+    assert rec._gbdt.iter_ == 16
+    assert rec.cfg.learning_rate == pytest.approx(0.05)  # 0.1 * 0.5**1
+
+    snap8 = [p for it, p in checkpoint.list_snapshots(d) if it == 8]
+    assert snap8, "iteration-8 snapshot missing"
+    fresh = _train(_rollback_params(d, tpu_health_recovery_salt=1), X, y,
+                   rounds=16, resume_from=snap8[0])
+    assert _trees(rec.model_to_string()) == _trees(fresh.model_to_string())
+
+
+def test_rollback_exhaustion_escalates(tmp_path):
+    """max_rollbacks=0: the first trip has no recovery budget and must
+    escalate to HealthHaltError instead of looping."""
+    d = str(tmp_path / "ck")
+    X, y = _data()
+    faults.install("nan_grads:6")
+    with pytest.raises(HealthHaltError, match="max_rollbacks"):
+        _train(_rollback_params(d, tpu_health_max_rollbacks=0), X, y,
+               rounds=8)
+
+
+def test_rollback_without_checkpoint_halts():
+    """rollback policy but checkpoint_interval=0: a trip cannot restore
+    anything — clear escalation, not an infinite loop."""
+    X, y = _data()
+    faults.install("nan_grads:3")
+    with pytest.raises(HealthHaltError, match="rollback impossible"):
+        _train(dict(BASE, tpu_health_policy="rollback", tpu_iter_pack=1),
+               X, y)
+
+
+def test_halt_error_carries_booster():
+    X, y = _data()
+    faults.install("nan_grads:3")
+    with pytest.raises(HealthHaltError) as ei:
+        _train(dict(BASE, tpu_health_policy="halt", tpu_iter_pack=1), X, y)
+    bst = ei.value.booster
+    assert bst is not None
+    # terminal verdict: a halted run must never read as tripped-but-alive
+    # (or "recovered", when earlier rollbacks happened) in triage
+    assert bst._health_report["verdict"] == "halted"
+    assert bst._gbdt.iter_ >= 2   # rounds before the poison committed
+
+
+# --------------------------------------------------- detector unit behavior
+def _sentinel(**over):
+    cfg = Config(dict({"tpu_health_policy": "halt", "tpu_health_window": 3,
+                       "tpu_health_spike_factor": 10.0}, **over))
+    return TrainingHealthSentinel(cfg)
+
+
+def test_detector_spike():
+    s = _sentinel()
+    for i, v in enumerate([1.0, 0.9, 0.8, 0.75]):
+        assert s.observe_round(i + 1, None,
+                               [("valid", "l2", v, False)]) is None
+    trip = s.observe_round(5, None, [("valid", "l2", 8.5, False)])
+    assert trip is not None and trip.reason == "loss_spike"
+    assert s.verdict() == "tripped"
+
+
+def test_detector_spike_ignores_higher_better():
+    s = _sentinel()
+    for i, v in enumerate([0.5, 0.6, 0.7, 0.99, 0.99, 0.99]):
+        assert s.observe_round(i + 1, None,
+                               [("valid", "auc", v, True)]) is None
+
+
+def test_detector_stagnation():
+    s = _sentinel()
+    vals = [0.5, 0.4, 0.31, 0.31, 0.31]
+    trips = [s.observe_round(i + 1, None, [("valid", "l2", v, False)])
+             for i, v in enumerate(vals)]
+    assert all(t is None for t in trips[:-1])
+    assert trips[-1] is not None and trips[-1].reason == "loss_stagnation"
+
+
+def test_detector_score_overflow():
+    s = _sentinel(tpu_health_score_limit=100.0)
+    hv = np.array([0.0, 0.0, 0.0, 0.0, 250.0])
+    trip = s.observe_round(1, hv, None)
+    assert trip is not None and trip.reason == "score_overflow"
+
+
+def test_halted_verdict_wins_over_recovered(tmp_path):
+    """Exhausted rollbacks: the report must say "halted", not "recovered",
+    even though a rollback succeeded earlier (the inf_loss detector keeps
+    the spike history clear, so only the once-per-install faults trip)."""
+    s = _sentinel()
+    s.observe_round(1, np.array([1.0, 0, 0, 0, 0]), None)  # trip
+    s.note_rollback(0, 1)
+    assert s.verdict() == "recovered"
+    s.note_halt()
+    assert s.verdict() == "halted"
+    assert s.report()["rollbacks"] == 1
+
+
+def test_pack_trailing_health_survives_commits():
+    """A mid-pack degenerate stop (j0 >= 1): the committed rounds' health
+    vectors pop first, and the TRIMMED stopping round's vector surfaces
+    after them instead of being clobbered by the first commit — the
+    plumbing that lets the engine catch a round that grew no tree."""
+    X, y = _data()
+    params = dict(BASE, tpu_health_policy="warn")
+    ds = lgb.Dataset(X.copy(), label=y.copy())
+    ds.construct(params)
+    bst = lgb.Booster(params=params, train_set=ds)
+    g = bst._gbdt
+    rounds, _fin = g.train_pack(2)
+    assert len(rounds) == 2
+    # fabricate the mid-pack-stop shape: one committed round pending plus
+    # a distinct trailing (trimmed-round) vector
+    g.commit_round(rounds[0])
+    committed_hv = np.array(g._pack_health_pending[0], np.float64) \
+        if g._pack_health_pending else None
+    g._trailing_health = np.array([7.0, 0, 0, 0, 0])
+    g.commit_round(rounds[1])
+    first = g.consume_health()
+    assert first is not None and first[0] == 0.0     # committed round's
+    if committed_hv is not None:
+        np.testing.assert_array_equal(first, committed_hv)
+    trailing = g.consume_health()                    # then the trimmed one
+    assert trailing is not None and trailing[0] == 7.0
+    assert g.consume_health() is None
+
+
+def test_detector_healthy_report_schema():
+    s = _sentinel()
+    s.observe_round(1, np.zeros(5), [("valid", "l2", 0.5, False)])
+    rep = s.report()
+    assert rep["verdict"] == "healthy"
+    assert set(rep) >= {"policy", "verdict", "rounds_checked", "trips",
+                        "rollbacks", "overflow_escalations", "last_health"}
+    assert rep["last_health"]["grad_nonfinite"] == 0.0
+
+
+# ----------------------------------------------------- quantized overflow
+@pytest.mark.slow
+def test_overflow_signal_reports_and_trees_exact():
+    """``overflow_hist`` forces every int16-wire decision to escalate: the
+    sentinel reports it, and the int32 fallback keeps the trees bitwise
+    identical to the unforced run (the guard is exact — the signal is
+    triage, not a numeric event)."""
+    rng = np.random.RandomState(0)
+    n = 8 * 2100                       # past the sharded-perm row floor
+    X = rng.rand(n, 6)
+    y = (X[:, 0] + X[:, 1] > 1.0).astype(np.float64)
+    params = dict(BASE, tree_learner="data",
+                  tpu_hist_comm="reduce_scatter", use_quantized_grad=True,
+                  tpu_health_policy="warn")
+    clean = _train(params, X, y, rounds=3)
+    assert clean._health_report["overflow_escalations"] == 0
+    health.reset_overflow()
+    faults.install("overflow_hist")
+    forced = _train(params, X, y, rounds=3)
+    assert forced._health_report["overflow_escalations"] >= 1
+    assert _trees(forced.model_to_string()) == \
+        _trees(clean.model_to_string())
+
+
+def test_overflow_flag_roundtrip():
+    health.reset_overflow()
+    health.record_hist_overflow(False)
+    assert not health.consume_overflow_flag()
+    health.record_hist_overflow(True)
+    health.record_hist_overflow(True)
+    assert health.overflow_total() == 2
+    assert health.consume_overflow_flag()
+    assert not health.consume_overflow_flag()   # read-and-clear
+
+
+# ------------------------------------------------------------- serve guards
+def _serve_nan_check(params, X, y, rounds=5):
+    bst = _train(params, X, y, rounds=rounds)
+    pred = bst.serving_predictor()
+    want = pred.predict(X[:6])
+    orig = pred._predict_device
+
+    def nan_device(Xq, sparse):
+        out = np.array(orig(Xq, sparse), np.float64, copy=True)
+        out[...] = np.nan
+        return out
+
+    pred._predict_device = nan_device
+    got = pred.predict(X[:6])
+    pred._predict_device = orig
+    assert np.isfinite(got).all()
+    assert pred.metrics.nan_scores == 1
+    assert pred.metrics.host_fallbacks == 1
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-9)
+    snap = pred.metrics_snapshot()
+    assert snap["nan_scores"] == 1
+
+
+def test_serve_nan_scores_host_fallback_binary():
+    X, y = _data()
+    _serve_nan_check(BASE, X, y)
+
+
+def test_serve_nan_scores_host_fallback_multiclass():
+    rng = np.random.RandomState(1)
+    X = rng.rand(300, 6)
+    y = (X[:, 0] * 3).astype(np.int64).clip(0, 2).astype(np.float64)
+    _serve_nan_check({"objective": "multiclass", "num_class": 3,
+                      "num_leaves": 7, "verbosity": -1,
+                      "min_data_in_leaf": 5}, X, y, rounds=3)
+
+
+def test_serve_nan_guard_respects_host_fallback_off():
+    X, y = _data()
+    bst = _train(BASE, X, y, rounds=3)
+    pred = bst.serving_predictor()
+    pred._host_fallback = False
+    pred._predict_device = lambda Xq, sparse: np.full(
+        (np.asarray(Xq).shape[0],), np.nan)
+    out = pred.predict(X[:4])
+    assert not np.isfinite(out).any()       # surfaced, not healed
+    assert pred.metrics.nan_scores == 1     # but still counted
+
+
+def test_serve_rejects_inf_rows():
+    X, y = _data()
+    bst = _train(BASE, X, y, rounds=3)
+    pred = bst.serving_predictor()
+    bad = X[:4].copy()
+    bad[2, 1] = np.inf
+    with pytest.raises(ValueError, match="inf"):
+        pred.predict(bad)
+    assert pred.metrics.host_fallbacks == 0   # caller error, no fallback
+    batcher = pred.batcher(max_batch=8, max_wait_ms=1.0)
+    try:
+        with pytest.raises(ValueError, match="inf"):
+            batcher.submit(bad)
+        ok = batcher.submit(X[:2])            # queue still alive
+        np.testing.assert_allclose(ok.result(timeout=30),
+                                   pred.predict(X[:2]))
+    finally:
+        batcher.close()
+
+
+# ------------------------------------------------------ ingestion validation
+def test_nonfinite_label_rejected():
+    X, y = _data(100, 4)
+    y = y.copy()
+    y[7] = np.nan
+    with pytest.raises(ValueError, match="non-finite label"):
+        _train(BASE, X, y, rounds=1)
+
+
+def test_nonfinite_weight_rejected():
+    X, y = _data(100, 4)
+    w = np.ones(100)
+    w[3] = np.inf
+    with pytest.raises(ValueError, match="non-finite sample weight"):
+        lgb.train(dict(BASE), lgb.Dataset(X, label=y, weight=w), 1)
+
+
+def test_binary_label_domain_rejected():
+    X, y = _data(100, 4)
+    with pytest.raises(ValueError, match="labels in \\{0, 1\\}"):
+        _train(BASE, X, y * 2.0, rounds=1)
+
+
+def test_poisson_label_domain_rejected():
+    X, _ = _data(100, 4)
+    y = np.linspace(-1, 5, 100)
+    with pytest.raises(ValueError, match="poisson requires labels >= 0"):
+        lgb.train({"objective": "poisson", "verbosity": -1,
+                   "min_data_in_leaf": 5}, lgb.Dataset(X, label=y), 1)
+
+
+def test_gamma_label_domain_rejected():
+    X, _ = _data(100, 4)
+    y = np.zeros(100)
+    with pytest.raises(ValueError, match="gamma requires labels > 0"):
+        lgb.train({"objective": "gamma", "verbosity": -1,
+                   "min_data_in_leaf": 5}, lgb.Dataset(X, label=y), 1)
+
+
+def test_degenerate_column_warnings(capsys):
+    rng = np.random.RandomState(0)
+    X = rng.rand(200, 4)
+    X[:, 1] = np.nan        # all-NaN column
+    X[:, 2] = 7.25          # constant column
+    y = (X[:, 0] > 0.5).astype(np.float64)
+    _train(BASE, X, y, rounds=1)
+    err = capsys.readouterr().err
+    assert "entirely NaN" in err
+    assert "constant" in err
+
+
+# ------------------------------------------------------------------ tooling
+def test_health_report_tool(tmp_path):
+    """tools/health_report.py folds a checkpoint dir + BENCH health blocks
+    into one triage table (subprocess — the CLI surface is the contract)."""
+    d = str(tmp_path / "ck")
+    X, y = _data()
+    _train(dict(BASE, tpu_iter_pack=4, checkpoint_interval=4,
+                checkpoint_dir=d, checkpoint_keep=3), X, y, rounds=8)
+    bench_json = tmp_path / "BENCH_fake.json"
+    bench_json.write_text(json.dumps({
+        "metric": "m", "value": 1.0,
+        "detail": {"health": {"policy": "warn", "verdict": "healthy",
+                              "rounds_checked": 8, "rollbacks": 0,
+                              "overflow_escalations": 0,
+                              "last_health": {"grad_nonfinite": 0.0}},
+                   "goss": {"health": {"verdict": "tripped",
+                                       "rounds_checked": 3,
+                                       "rollbacks": 1,
+                                       "overflow_escalations": 2,
+                                       "last_health": {
+                                           "grad_nonfinite": 4.0}}}},
+    }) + "\n")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "health_report.py"),
+         "--ckpt", d, str(bench_json)],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    assert "checkpoints under" in out.stdout
+    assert "valid" in out.stdout
+    assert "BENCH health blocks" in out.stdout
+    assert "tripped" in out.stdout and "healthy" in out.stdout
+    assert "4 nonfinite" in out.stdout
+
+
+def test_bench_health_block_schema():
+    """bench.py's post-hoc audit returns the detail.health schema with a
+    real verdict over the final gradients/scores."""
+    X, y = _data(200, 5)
+    bst = _train(BASE, X, y, rounds=3)
+    block = health.bench_health_block(bst, 3)
+    assert block["verdict"] == "healthy"
+    assert block["rounds_checked"] == 3
+    assert block["last_health"]["grad_nonfinite"] == 0.0
+    assert "overflow_escalations" in block

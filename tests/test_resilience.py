@@ -520,8 +520,8 @@ def test_serve_expired_only_batch_skips_dispatch(served):
     pred, X = served
     sizes = []
     orig = pred.predict
-    pred.predict = lambda Xb, _record=True: (
-        sizes.append(Xb.shape[0]) or orig(Xb, _record=_record))
+    pred.predict = lambda Xb, _record=True, **kw: (
+        sizes.append(Xb.shape[0]) or orig(Xb, _record=_record, **kw))
     try:
         faults.install("wedge_dispatch:0.3")
         mb = pred.batcher(max_batch=8, max_wait_ms=1.0, deadline_ms=40.0)

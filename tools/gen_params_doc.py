@@ -109,6 +109,41 @@ _DESCRIPTIONS = {
         "failed with ServeDeadlineError instead of dispatched late "
         "(counted in ServeMetrics.deadline_misses); an in-flight dispatch "
         "is never interrupted; 0 = none"),
+    "tpu_health_policy": (
+        "training-health sentinel (resilience/health.py, "
+        "docs/ROBUSTNESS.md): off = no guards (training is "
+        "bitwise-identical to a sentinel-less build), warn = fold "
+        "isfinite/max-abs health reductions into the training dispatch, "
+        "watch the per-round loss history and log trips, halt = raise "
+        "HealthHaltError on a trip, rollback = restore the last good "
+        "checkpoint in-process (needs checkpoint_interval > 0), back off "
+        "the learning rate, re-fold the device sampling keys and resume "
+        "— the recovered trees are bitwise-identical to a fresh run "
+        "resumed from that checkpoint with the same "
+        "tpu_health_recovery_salt"),
+    "tpu_health_spike_factor": (
+        "divergence detector: trip when a lower-is-better eval loss "
+        "exceeds this factor times the best value in the trailing "
+        "tpu_health_window rounds"),
+    "tpu_health_window": (
+        "trailing per-round loss window for the spike and "
+        "bitwise-stagnation checks"),
+    "tpu_health_score_limit": (
+        "max-abs train score above which the sentinel trips "
+        "score_overflow (pre-NaN saturation); 0 disables the magnitude "
+        "check"),
+    "tpu_health_max_rollbacks": (
+        "in-process recovery attempts allowed under "
+        "tpu_health_policy=rollback before escalating to HealthHaltError"),
+    "tpu_health_lr_backoff": (
+        "learning_rate multiplier applied per recovery generation: the "
+        "Nth rollback resumes at snapshot_lr * backoff**N"),
+    "tpu_health_recovery_salt": (
+        "recovery generation for a MANUAL resume: > 0 applies the same "
+        "lr backoff and device sampling-key re-fold the Nth in-process "
+        "rollback applies, so train(resume_from=ckpt, "
+        "tpu_health_recovery_salt=N) reproduces the recovered run's "
+        "trees bitwise (docs/ROBUSTNESS.md)"),
 }
 
 

@@ -1,0 +1,145 @@
+"""One triage table for training health: checkpoint generations + BENCH
+health blocks (ISSUE-8 CI/tooling satellite).
+
+Usage::
+
+    python tools/health_report.py [--ckpt CKPT_DIR] [BENCH_*.json ...]
+
+- ``--ckpt`` scans a resilience checkpoint directory: every generation's
+  iteration, validity (the same checksum validation the restore scan
+  runs), best score and payload size — so an on-call can see in one look
+  which generation a rollback would land on.
+- Each BENCH json argument contributes its ``detail.health`` block (and
+  every rung's nested ``health`` block: lambdarank/wide/goss/fused_wave),
+  i.e. the sentinel verdict, rounds checked, rollbacks and int16-wire
+  overflow escalations per measured rung.
+
+Plain stdlib + the repo; safe to run anywhere the repo checks out (the
+checkpoint scan imports lightgbm_tpu lazily and only for frame reading).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+RUNG_KEYS = ("lambdarank", "wide", "goss", "fused_wave")
+
+
+def _fmt_row(cols, widths):
+    return "  ".join(str(c).ljust(w) for c, w in zip(cols, widths))
+
+
+def _table(title, header, rows):
+    if not rows:
+        return
+    widths = [max(len(str(h)), *(len(str(r[i])) for r in rows))
+              for i, h in enumerate(header)]
+    print(f"\n== {title} ==")
+    print(_fmt_row(header, widths))
+    print(_fmt_row(["-" * w for w in widths], widths))
+    for r in rows:
+        print(_fmt_row(r, widths))
+
+
+def scan_checkpoints(ckpt_dir: str):
+    """(iteration, valid, best_iteration, size_bytes, note) per generation,
+    newest first — validated with the restore scan's own frame reader."""
+    import pickle
+
+    from lightgbm_tpu.resilience import checkpoint
+    from lightgbm_tpu.serialization import FrameCorruptError, read_frame
+
+    rows = []
+    for it, path in checkpoint.list_snapshots(ckpt_dir):
+        size = os.path.getsize(path)
+        try:
+            blob = pickle.loads(read_frame(path))
+            meta = blob.get("meta", {})
+            ok = meta.get("format") == checkpoint.FORMAT_VERSION
+            note = "" if ok else f"format={meta.get('format')!r}"
+            best = meta.get("best_iteration", -1)
+            lr = meta.get("compat", {}).get("learning_rate")
+            rows.append((it, "valid" if ok else "INVALID", best,
+                         f"{lr:g}" if lr is not None else "?", size, note))
+        except (FrameCorruptError, OSError, pickle.UnpicklingError,
+                EOFError) as e:
+            rows.append((it, "CORRUPT", "-", "-", size,
+                         f"{e}"[:60]))
+    return rows
+
+
+def bench_health_rows(paths):
+    """One row per (file, rung) health block found in BENCH jsons."""
+    rows = []
+    for path in paths:
+        try:
+            with open(path) as fh:
+                text = fh.read()
+        except OSError as e:
+            rows.append((os.path.basename(path), "-", "unreadable",
+                         "-", "-", "-", f"{e}"[:40]))
+            continue
+        # BENCH files may hold several json lines; take any object with a
+        # detail block
+        for line in text.splitlines():
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            detail = obj.get("detail")
+            if not isinstance(detail, dict):
+                continue
+            blocks = [("primary", detail.get("health"))]
+            blocks += [(k, (detail.get(k) or {}).get("health"))
+                       for k in RUNG_KEYS
+                       if isinstance(detail.get(k), dict)]
+            for rung, h in blocks:
+                if not isinstance(h, dict):
+                    continue
+                bad = ""
+                lh = h.get("last_health") or {}
+                nonfinite = sum(v for k, v in lh.items()
+                                if k.endswith("_nonfinite"))
+                if nonfinite:
+                    bad = f"{int(nonfinite)} nonfinite"
+                rows.append((os.path.basename(path), rung,
+                             h.get("verdict", "?"),
+                             h.get("rounds_checked", "-"),
+                             h.get("rollbacks", "-"),
+                             h.get("overflow_escalations", "-"), bad))
+    return rows
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--ckpt", help="resilience checkpoint directory")
+    ap.add_argument("bench", nargs="*", help="BENCH_*.json files")
+    args = ap.parse_args(argv)
+    if not args.ckpt and not args.bench:
+        ap.error("nothing to report: pass --ckpt and/or BENCH json files")
+    if args.ckpt:
+        rows = scan_checkpoints(args.ckpt)
+        _table(f"checkpoints under {args.ckpt}",
+               ("iter", "state", "best_iter", "lr", "bytes", "note"), rows)
+        if not rows:
+            print(f"\n== checkpoints under {args.ckpt} ==\n(none found)")
+    if args.bench:
+        rows = bench_health_rows(args.bench)
+        _table("BENCH health blocks",
+               ("file", "rung", "verdict", "rounds", "rollbacks",
+                "overflow", "flags"), rows)
+        if not rows:
+            print("\n== BENCH health blocks ==\n(no health blocks found)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
