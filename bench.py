@@ -192,6 +192,19 @@ def _health_block(bst, rounds):
         return {"error": f"{e!r}"[:160]}
 
 
+def _telemetry_block():
+    """The ``detail.telemetry`` block every BENCH/rung blob carries
+    (ISSUE-9): schema version, armed state, per-kind event counts, span
+    totals (where the wall clock went, by phase, at dispatch boundaries)
+    and the process registry snapshot — so every bench round lands with
+    its observability state attached."""
+    try:
+        from lightgbm_tpu import telemetry
+        return telemetry.telemetry_block()
+    except Exception as e:  # noqa: BLE001 — telemetry is garnish on the rate
+        return {"error": f"{e!r}"[:160]}
+
+
 def _hlo_cost_block(bst):
     """The per-rung HLO cost block (ROADMAP 3b, ISSUE-7 satellite): XLA's
     own cost model (FLOPs / bytes accessed) for the rung's compiled grower
@@ -254,6 +267,7 @@ def run_ltr_rung(rows, iters, platform, jax, features=None, group=None,
         "ndcg5_train_sample": None if ndcg is None else round(ndcg, 6),
         "hlo_cost": _hlo_cost_block(bst),
         "health": _health_block(bst, iters),
+        "telemetry": _telemetry_block(),
     }
 
 
@@ -295,6 +309,7 @@ def run_wide_rung(rows, iters, platform, jax, features=None,
             slots * features * bins * 3 * 4 / 2**20, 1),
         "hlo_cost": _hlo_cost_block(bst),
         "health": _health_block(bst, iters),
+        "telemetry": _telemetry_block(),
     }
 
 
@@ -334,6 +349,7 @@ def run_goss_rung(rows, iters, platform, jax, features=None,
         blob["dispatches_per_iter"] = f"failed: {e!r}"[:120]
     blob["hlo_cost"] = _hlo_cost_block(bst)
     blob["health"] = _health_block(bst, iters)
+    blob["telemetry"] = _telemetry_block()
     return blob
 
 
@@ -370,6 +386,7 @@ def run_fused_rung(rows, iters, platform, jax, features=None,
         "row_iters_per_sec": round(rows * iters / elapsed, 1),
         "hlo_cost": _hlo_cost_block(bst),
         "health": _health_block(bst, iters),
+        "telemetry": _telemetry_block(),
     }
 
 
@@ -589,6 +606,9 @@ def run_bench(rows, iters):
     # Post-hoc sentinel audit (ISSUE-8): the rate above is only publishable
     # when the final gradients/scores are finite — detail.health says so.
     health_block = _health_block(bst, iters)
+    # Unified telemetry (ISSUE-9): event counts, span totals and the
+    # process registry — rebuilt at every emit so late rungs' spans ride
+    # the cumulative re-emits too.
 
     def emit(quant_rate, predict_stats=None, ltr_stats=None,
              wide_stats=None, goss_stats=None, fused_stats=None):
@@ -620,6 +640,10 @@ def run_bench(rows, iters):
                 # verdict over the final gradients/scores, rounds checked,
                 # rollbacks and int16-wire overflow escalations.
                 "health": health_block,
+                # Unified telemetry block (ISSUE-9, telemetry/): schema,
+                # per-kind event counts, span totals at dispatch
+                # boundaries, registry snapshot.
+                "telemetry": _telemetry_block(),
                 # Iteration packing: training dispatches per boosting round
                 # (1.0 = per-round loop; 1/K with K-round packs — the
                 # host-sync elimination the pack path is for).

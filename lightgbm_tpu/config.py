@@ -290,6 +290,22 @@ _PARAMS: List[Tuple[str, Any, Any, Tuple[str, ...], Optional[Tuple[Any, Any]]]] 
     # a fresh run resumed from the same checkpoint with the same salt
     # reproduces the recovered run's trees bitwise (docs/ROBUSTNESS.md).
     ("tpu_health_recovery_salt", int, 0, (), (0, None)),
+    # ---- Telemetry / observability (telemetry/, docs/OBSERVABILITY.md) ----
+    # Unified telemetry: on = host-side spans at dispatch boundaries, the
+    # process metrics registry and JSONL events; off is bitwise-inert —
+    # compiled programs identical, dispatch census unchanged (telemetry is
+    # never traced into a device program either way).
+    ("tpu_telemetry", str, "on", (), None),  # on|off
+    # Structured JSONL event log path ("" = no event file; registry
+    # counters and spans still aggregate in-process).  Replay with
+    # tools/telemetry_report.py; also feeds tools/health_report.py and
+    # tools/profile_iter.py --from-log.
+    ("tpu_telemetry_log", str, "", ("telemetry_log",), None),
+    # Capture a jax.profiler trace directory for the FIRST N committed
+    # boosting rounds (0 = off).  Directory: tpu_profile_dir, else
+    # "<tpu_telemetry_log>.trace", else /tmp/lightgbm_tpu_profile.
+    ("tpu_profile_iters", int, 0, (), (0, None)),
+    ("tpu_profile_dir", str, "", (), None),
 ]
 
 _CANONICAL: Dict[str, Tuple[str, Any, Any, Optional[Tuple[Any, Any]]]] = {}
@@ -335,7 +351,8 @@ def _coerce(name: str, typ: Any, value: Any) -> Any:
                                                       "device_type", "monotone_constraints_method",
                                                       "data_sample_strategy", "tpu_histogram_impl",
                                                       "tpu_hist_comm", "tpu_wave_kernel",
-                                                      "tpu_health_policy") \
+                                                      "tpu_health_policy",
+                                                      "tpu_telemetry") \
             else str(value)
     if typ in ("list_int", "list_float", "list_str"):
         if value is None:

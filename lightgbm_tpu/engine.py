@@ -7,11 +7,13 @@ loop at ``engine.py:309-322``; ``cv:611`` with stratified/group folds).
 from __future__ import annotations
 
 import copy
+import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Union
 
 import numpy as np
 
 from . import callback as callback_mod
+from . import telemetry as telemetry_mod
 from .basic import Booster, Dataset
 from .callback import CallbackEnv, EarlyStopException
 from .resilience import faults
@@ -180,6 +182,14 @@ def train(
     # Booster.predict's num_iteration slicing keeps the full base ensemble.
     n_base = base.iter_ if base is not None else 0
 
+    # Telemetry session (telemetry/, docs/OBSERVABILITY.md): arms the
+    # process-wide span switch and the JSONL event sink from the config,
+    # owns the optional first-N-iterations jax.profiler capture, and
+    # closes what it opened when training ends.  Host-side only — with
+    # tpu_telemetry=off every emit below is a no-op and the compiled
+    # training programs are bitwise-identical either way.
+    tel = telemetry_mod.train_session(booster.cfg)
+
     # Checkpoint/resume (docs/ROBUSTNESS.md).  Snapshots are emitted only
     # at iter-pack commit boundaries — mid-pack, scores already include
     # uncommitted rounds — so with packing the interval is a floor: the
@@ -203,27 +213,33 @@ def train(
                               else sentinel.report())
     if resume_from is not None:
         from .resilience import checkpoint as checkpoint_mod
-        start_it = checkpoint_mod.restore(booster, resume_from)
-        # Recovery generation (tpu_health_recovery_salt > 0): the SAME
-        # lr-backoff + key-refold transformation the in-process rollback
-        # applies — which is what makes a fresh resume with the same salt
-        # reproduce the recovered run's trees bitwise.
-        health_mod.apply_recovery(booster,
-                                  booster.cfg.tpu_health_recovery_salt)
         try:
-            for it_h, evals_h in booster._ckpt_eval_history:
-                if it_h >= start_it:
-                    continue
-                for cb in cbs_after:
-                    cb(CallbackEnv(booster, params, it_h, 0,
-                                   num_boost_round, evals_h))
-        except EarlyStopException as e:
-            # cannot fire for rounds the original run trained past (a
-            # stop breaks the loop before the next snapshot), but handle
-            # it exactly as _fire_after would, defensively
-            booster.best_iteration = e.best_iteration + 1 + n_base
-            booster.best_score = e.best_score
-            return booster
+            start_it = checkpoint_mod.restore(booster, resume_from)
+            # Recovery generation (tpu_health_recovery_salt > 0): the SAME
+            # lr-backoff + key-refold transformation the in-process
+            # rollback applies — which is what makes a fresh resume with
+            # the same salt reproduce the recovered run's trees bitwise.
+            health_mod.apply_recovery(booster,
+                                      booster.cfg.tpu_health_recovery_salt)
+            try:
+                for it_h, evals_h in booster._ckpt_eval_history:
+                    if it_h >= start_it:
+                        continue
+                    for cb in cbs_after:
+                        cb(CallbackEnv(booster, params, it_h, 0,
+                                       num_boost_round, evals_h))
+            except EarlyStopException as e:
+                # cannot fire for rounds the original run trained past (a
+                # stop breaks the loop before the next snapshot), but
+                # handle it exactly as _fire_after would, defensively
+                booster.best_iteration = e.best_iteration + 1 + n_base
+                booster.best_score = e.best_score
+                tel.close()   # this session's sink must not outlive it
+                return booster
+        except BaseException:
+            # a failed restore/recovery/replay must not strand the sink
+            tel.close()
+            raise
     ckpt_interval = booster.cfg.checkpoint_interval
     if ckpt_interval > 0 and not booster._gbdt._supports_checkpoint:
         from .utils.log import Log
@@ -241,14 +257,22 @@ def train(
             "there will be no checkpoint to roll back to, so a tripped "
             "sentinel escalates straight to HealthHaltError")
 
-    def _maybe_checkpoint(done_it: int) -> None:
+    def _maybe_checkpoint(done_it: int) -> Optional[float]:
+        """Snapshot when the cadence is due; returns the write duration in
+        seconds (None when no snapshot was due) — the ``checkpoint_s``
+        field of the round's ``train.iter`` event."""
         if ckpt_interval <= 0 \
                 or done_it // ckpt_interval <= last_ckpt[0] // ckpt_interval:
-            return
+            return None
         from .resilience import checkpoint as checkpoint_mod
+        t0 = time.perf_counter()
         checkpoint_mod.save_snapshot(booster, ckpt_dir,
                                      keep=booster.cfg.checkpoint_keep)
+        dt = time.perf_counter() - t0
         last_ckpt[0] = done_it
+        tel.emit("train.checkpoint", iteration=done_it, dir=ckpt_dir,
+                 seconds=round(dt, 6))
+        return dt
 
     # evals the sentinel already computed for a round (keyed by 0-based
     # iteration), reused by _fire_after so arming the sentinel never
@@ -353,6 +377,9 @@ def train(
         salt = booster.cfg.tpu_health_recovery_salt + rollbacks_done[0]
         health_mod.apply_recovery(booster, salt)
         sentinel.note_rollback(start, salt)
+        tel.emit("train.rollback", restored_iteration=start, salt=salt,
+                 trip=str(trip),
+                 rollbacks=f"{rollbacks_done[0]}/{cap}")
         sentinel_evals.clear()   # cached evals refer to discarded rounds
         # checkpoint cadence and eval-history replay state rewind with the
         # restore; after-callbacks are NOT replayed here (they already saw
@@ -361,16 +388,53 @@ def train(
         return start
 
     it = start_it
+    t_train0 = time.perf_counter()
+    tel.emit(
+        "train.start", num_boost_round=num_boost_round, start_iteration=it,
+        objective=booster.cfg.objective, boosting=booster.cfg.boosting,
+        num_class=booster._gbdt.num_class,
+        rows=booster._gbdt.train_data.num_data,
+        features=booster._gbdt.train_data.num_features,
+        packed=use_pack, pack_size=pack_k if use_pack else 1,
+        pack_degrade_reason=booster._gbdt.iter_pack_degrade_reason(),
+        health_policy=booster.cfg.tpu_health_policy,
+        checkpoint_interval=ckpt_interval,
+        valid_sets=[nm for nm, _ in valid_pairs])
+    tel.maybe_start_profile()
+
+    def _emit_iter(done_it: int, dispatch_s: float, host_s: float,
+                   pack_size: int, ckpt_s: Optional[float]) -> None:
+        """One ``train.iter`` event per COMMITTED round: wall time split
+        into dispatch wait (time inside the device-facing call — amortized
+        per round on the pack path) vs host bookkeeping (commit, eval,
+        callbacks, checkpoint), plus the health verdict so far."""
+        host_s = max(host_s, 0.0)
+        tel.emit("train.iter", iteration=done_it,
+                 wall_s=round(dispatch_s + host_s, 6),
+                 dispatch_wait_s=round(dispatch_s, 6),
+                 host_s=round(host_s, 6), pack_size=pack_size,
+                 checkpoint_s=(None if ckpt_s is None
+                               else round(ckpt_s, 6)),
+                 health=(None if sentinel is None else sentinel.verdict()))
+        tel.maybe_stop_profile(done_it - start_it)
+
     try:
         while it < num_boost_round:
             if use_pack:
+                t_pack0 = time.perf_counter()
                 rounds, finished = booster._gbdt.train_pack(
                     min(pack_k, num_boost_round - it))
+                # amortized device share of each committed round's event
+                # (the pack is ONE dispatch — per-round attribution below
+                # it is not observable from the host)
+                disp_share = ((time.perf_counter() - t_pack0)
+                              / max(len(rounds), 1))
                 committed = 0
                 stopped = False
                 rollback_due = False
                 try:
                     for j, rnd in enumerate(rounds):
+                        t_round0 = time.perf_counter()
                         # Commit one round, then replay its callbacks/eval:
                         # valid scores update per committed tree, so
                         # callbacks observe the SAME per-iteration metric
@@ -381,11 +445,12 @@ def train(
                         # fault seam: a mid-training SIGKILL lands right
                         # after a commit, the worst legal place for a crash
                         faults.maybe_kill(it + j + 1)
-                        if _health_check(it + j + 1):
-                            rollback_due = True
-                            break
-                        if _fire_after(it + j):
-                            stopped = True
+                        rollback_due = _health_check(it + j + 1)
+                        stopped = (not rollback_due) and _fire_after(it + j)
+                        _emit_iter(it + j + 1, disp_share,
+                                   time.perf_counter() - t_round0,
+                                   len(rounds), None)
+                        if rollback_due or stopped:
                             break
                 finally:
                     # Uncommitted rounds were trained inside the same
@@ -410,25 +475,43 @@ def train(
                     break
                 _maybe_checkpoint(it)
             else:
+                t_round0 = time.perf_counter()
                 for cb in cbs_before:
                     cb(CallbackEnv(booster, params, it, 0,
                                    num_boost_round, None))
+                t_disp0 = time.perf_counter()
                 finished = booster.update(fobj=fobj)
+                disp_s = time.perf_counter() - t_disp0
                 faults.maybe_kill(it + 1)
                 if snapshot_freq > 0 and (it + 1) % snapshot_freq == 0:
                     booster.save_model(
                         f"{snapshot_base}.snapshot_iter_{it + 1}")
                 if _health_check(it + 1):
+                    _emit_iter(it + 1, disp_s,
+                               time.perf_counter() - t_round0 - disp_s,
+                               1, None)
                     it = _do_rollback()
                     continue
                 stopped = _fire_after(it)
                 it += 1
+                ckpt_s = None
+                if not (stopped or finished):
+                    ckpt_s = _maybe_checkpoint(it)
+                _emit_iter(it, disp_s,
+                           time.perf_counter() - t_round0 - disp_s,
+                           1, ckpt_s)
                 if stopped or finished:
                     break
-                _maybe_checkpoint(it)
     finally:
         if sentinel is not None:
             booster._health_report = sentinel.report()
+        tel.emit("train.end", iterations=int(booster._gbdt.iter_),
+                 elapsed_s=round(time.perf_counter() - t_train0, 6),
+                 best_iteration=int(booster.best_iteration),
+                 health=(None if sentinel is None
+                         else sentinel.verdict()),
+                 spans=tel.span_delta())
+        tel.close()
     return booster
 
 

@@ -23,6 +23,7 @@ import numpy as np
 from ..config import Config
 from ..dataset import TrainData
 from ..metrics import Metric
+from ..telemetry import span
 from ..objectives import ObjectiveFunction, create_objective
 from ..sampling import FeatureSampler, SampleStrategy
 from ..ops.split import SplitConfig
@@ -309,6 +310,20 @@ class GBDT:
             raise ValueError(
                 f"tpu_health_policy={cfg.tpu_health_policy!r}: expected "
                 f"one of {', '.join(POLICIES)}")
+        if cfg.tpu_telemetry not in ("on", "off"):
+            raise ValueError(
+                f"tpu_telemetry={cfg.tpu_telemetry!r}: expected on or off")
+        # Arm/disarm the process-wide telemetry switch — but only when the
+        # caller SAID something (tpu_telemetry in this booster's params):
+        # constructing a default-params booster (a serve mirror, a second
+        # model load, a callback building a helper) must not flip the
+        # switch under an in-flight training session.  engine.train arms
+        # unconditionally from its own run's config.  Spans/events are
+        # host-side only, so the knob never changes a compiled program —
+        # "off" just silences the host instrumentation (bitwise-inert).
+        if "tpu_telemetry" in cfg.raw_params:
+            from .. import telemetry
+            telemetry.arm_from_config(cfg)
         # Training-health sentinel (resilience/health.py): with any policy
         # but "off", the iteration/pack programs fold the isfinite/max-abs
         # health vector into their dispatch and the quantized int16-wire
@@ -714,13 +729,17 @@ class GBDT:
                     row_leaf: jnp.ndarray) -> None:
         self.dev_models[k].append(arrays)
         self._host_cache[k].append(None)
-        for i, vbins in enumerate(self.valid_bins):
-            pred = predict_tree_bins_device(
-                _tree_dict(arrays), vbins, self.meta_dev["nan_bins"])
-            if self._shape_k:
-                self.valid_scores[i] = self.valid_scores[i].at[:, k].add(pred)
-            else:
-                self.valid_scores[i] = self.valid_scores[i] + pred
+        if not self.valid_bins:
+            return
+        with span("train/valid_scores"):
+            for i, vbins in enumerate(self.valid_bins):
+                pred = predict_tree_bins_device(
+                    _tree_dict(arrays), vbins, self.meta_dev["nan_bins"])
+                if self._shape_k:
+                    self.valid_scores[i] = \
+                        self.valid_scores[i].at[:, k].add(pred)
+                else:
+                    self.valid_scores[i] = self.valid_scores[i] + pred
 
     @property
     def fused_path_active(self) -> bool:
@@ -1093,23 +1112,25 @@ class GBDT:
                 self._full_mask, base_fmask, self._goss_key, self._ff_key,
                 self._quant_key, self._split_key,
                 self._cegb_used_dev if self._use_cegb else None)
-        try:
-            scores2, stacked, nls, used_stack, health_stack = \
-                self._pack_fn(k)(*args)
-        except Exception as e:  # noqa: BLE001 — degrade-and-retry (Mosaic)
-            if not self._degrade_histogram_impl(e):
-                raise
-            scores2, stacked, nls, used_stack, health_stack = \
-                self._pack_fn(k)(*args)
+        with span("train/pack_dispatch"):
+            try:
+                scores2, stacked, nls, used_stack, health_stack = \
+                    self._pack_fn(k)(*args)
+            except Exception as e:  # noqa: BLE001 — degrade-and-retry
+                if not self._degrade_histogram_impl(e):
+                    raise
+                scores2, stacked, nls, used_stack, health_stack = \
+                    self._pack_fn(k)(*args)
         self.scores = scores2
-        if health_stack is not None:
-            # rides the pack's one host sync below; per-round vectors are
-            # surfaced by commit_round at each commit boundary
-            nls_host, health_host = jax.device_get((nls, health_stack))
-            nls_host = np.asarray(nls_host)
-        else:
-            nls_host = np.asarray(jax.device_get(nls))  # the ONE sync/pack
-            health_host = None
+        with span("train/pack_sync"):
+            if health_stack is not None:
+                # rides the pack's one host sync; per-round vectors are
+                # surfaced by commit_round at each commit boundary
+                nls_host, health_host = jax.device_get((nls, health_stack))
+                nls_host = np.asarray(nls_host)
+            else:
+                nls_host = np.asarray(jax.device_get(nls))  # ONE sync/pack
+                health_host = None
         dead = np.all(nls_host <= 1, axis=1)
         j0 = int(np.argmax(dead)) if dead.any() else k
         finished = bool(dead.any())
@@ -1170,7 +1191,8 @@ class GBDT:
             h, self._trailing_health = self._trailing_health, None
         if h is None:
             return None
-        return np.asarray(jax.device_get(h), np.float64)
+        with span("train/health_fetch"):
+            return np.asarray(jax.device_get(h), np.float64)
 
     def apply_health_recovery(self, salt: int) -> None:
         """Re-fold every device sampling-key stream for recovery
@@ -1401,13 +1423,16 @@ class GBDT:
     def _hist_fallback_call(self, name, *args, **kw):
         """Dispatch a compiled program by attribute name; on a Mosaic or
         Pallas compile failure degrade the histogram impl and retry once
-        (the rebuilt program lives under the same attribute)."""
-        try:
-            return getattr(self, name)(*args, **kw)
-        except Exception as e:  # noqa: BLE001 — inspect, re-raise if foreign
-            if not self._degrade_histogram_impl(e):
-                raise
-            return getattr(self, name)(*args, **kw)
+        (the rebuilt program lives under the same attribute).  Every launch
+        runs under a telemetry span named for the program — host-side
+        instrumentation at the dispatch boundary only."""
+        with span("train/" + name.lstrip("_")):
+            try:
+                return getattr(self, name)(*args, **kw)
+            except Exception as e:  # noqa: BLE001 — re-raise if foreign
+                if not self._degrade_histogram_impl(e):
+                    raise
+                return getattr(self, name)(*args, **kw)
 
     def _raw_grow(self, gk, hk, mask_dev, fmask, quant_key=None,
                   split_key=None):
@@ -1558,11 +1583,12 @@ class GBDT:
             if name == "training" and not self.cfg.is_provide_training_metric \
                     and feval is None and not self._force_train_metric():
                 continue
-            sc = np.asarray(jax.device_get(scores), np.float64)
-            for m in self.metrics:
-                out.append((name, m.name,
-                            m(data.label, sc, data.weight, data.group),
-                            m.higher_better))
+            with span("train/eval"):
+                sc = np.asarray(jax.device_get(scores), np.float64)
+                for m in self.metrics:
+                    out.append((name, m.name,
+                                m(data.label, sc, data.weight, data.group),
+                                m.higher_better))
         return out
 
     def _force_train_metric(self) -> bool:

@@ -2894,7 +2894,15 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
                 leaf_weight=jnp.where(active, h_leaf, 0.0))
         return tree, row_leaf
 
-    grow = jax.jit(_grow_impl, donate_argnums=())
+    # Telemetry span at the ONE dispatch boundary (telemetry/spans.py):
+    # the whole wave loop — histogram build, sibling subtract, split scan,
+    # partition — is a single compiled program, so the host-side span
+    # wraps its launch and the per-phase breakdown inside it comes from
+    # the jax.profiler trace (tpu_profile_iters), not extra dispatches.
+    # Host-only instrumentation: the compiled program is bitwise-identical
+    # with telemetry on, off, or absent (tests/test_telemetry.py).
+    from ..telemetry import instrument
+    grow = instrument(jax.jit(_grow_impl, donate_argnums=()), "grower/grow")
     # static dispatch facts, inspectable by tests/tools
     grow.fp_capable = fp_capable
     grow.rs_active = rs_on

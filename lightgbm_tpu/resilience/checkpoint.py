@@ -61,6 +61,10 @@ def list_snapshots(ckpt_dir: str) -> List[Tuple[int, str]]:
 def save_snapshot(booster, ckpt_dir: str, keep: int = 2) -> str:
     """Capture and atomically publish one snapshot; prunes generations
     beyond ``keep`` (oldest first, AFTER the new one is durable)."""
+    import time as _time
+
+    from .. import telemetry
+    t0 = _time.perf_counter()
     state = booster._gbdt.capture_train_state()
     meta = {
         "format": FORMAT_VERSION,
@@ -82,6 +86,13 @@ def save_snapshot(booster, ckpt_dir: str, keep: int = 2) -> str:
             os.unlink(old)
         except OSError:
             pass
+    # ONE measurement for the whole snapshot (capture + pickle + write +
+    # prune) — the same scope the engine's train.checkpoint event times
+    # around this call, so the two surfaces agree.
+    reg = telemetry.registry()
+    reg.counter("checkpoint.saves").inc()
+    reg.histogram("checkpoint.save_s").observe(_time.perf_counter() - t0)
+    reg.gauge("checkpoint.bytes").set(len(payload))
     return path
 
 
@@ -155,5 +166,8 @@ def restore(booster, ckpt: str) -> int:
     booster.best_score = meta.get("best_score", {})
     booster._ckpt_eval_history = list(meta.get("eval_history", []))
     it = int(meta["iteration"])
+    from .. import telemetry
+    telemetry.registry().counter("checkpoint.restores").inc()
+    telemetry.emit("checkpoint.restore", path=path, iteration=it)
     Log.info(f"resumed from {path} at iteration {it}")
     return it

@@ -212,9 +212,12 @@ class TrainingHealthSentinel:
         in-dispatch vector (None on paths that did not produce one),
         ``evals`` the round's ``(dataset, metric, value, higher_better)``
         rows (None when nothing was evaluated)."""
+        from .. import telemetry
         self.rounds_checked += 1
         if consume_overflow_flag():
             self.overflow_rounds += 1
+            telemetry.registry().counter("health.overflow_rounds").inc()
+            telemetry.emit("health.overflow", iteration=int(iteration))
             Log.warning(
                 f"health: quantized histogram int16 wire overflowed at "
                 f"iteration {iteration} (exact int32 fallback taken); "
@@ -227,6 +230,12 @@ class TrainingHealthSentinel:
             trip = self._check_losses(iteration, evals)
         if trip is not None:
             self.trips.append(trip)
+            # unified telemetry (docs/OBSERVABILITY.md): every trip counts
+            # in the process registry and lands in the JSONL event log
+            telemetry.registry().counter("health.trips").inc()
+            telemetry.emit("health.trip", reason=trip.reason,
+                           detail=trip.detail, iteration=trip.iteration,
+                           policy=self.policy)
         return trip
 
     def _check_vector(self, iteration: int,
@@ -292,6 +301,8 @@ class TrainingHealthSentinel:
         """Record a performed rollback and reset the loss windows — the
         restored history must not spike-compare against diverged values."""
         self.rollbacks += 1
+        from .. import telemetry
+        telemetry.registry().counter("health.rollbacks").inc()
         self._hist.clear()
         Log.warning(
             f"health: rolled back to iteration {restored_iter} "

@@ -159,6 +159,17 @@ def preflight(params: Optional[Dict] = None) -> Optional[ProbeResult]:
     budget = float(params.get("tpu_probe_timeout", default_timeout()) or
                    default_timeout())
     res = probe_backend(timeout=budget)
+    try:
+        # unified telemetry (docs/OBSERVABILITY.md): the probe verdict is
+        # a registry gauge + a JSONL event.  Lazy and optional — this
+        # module stays importable standalone (no package parent).
+        from .. import telemetry
+        telemetry.registry().counter(f"watchdog.{res.verdict}").inc()
+        telemetry.registry().gauge("watchdog.probe_latency_s").set(
+            res.latency_s)
+        telemetry.emit("watchdog.probe", **res.as_dict())
+    except ImportError:
+        pass
     if res.verdict == "wedged":
         raise BackendWedgedError(
             f"backend watchdog: probe exceeded its {budget:g}s budget — the "

@@ -4,6 +4,12 @@ cache hits and compile counts — the gauges a serving process exports.
 Pure host-side bookkeeping (a lock, two bounded reservoirs, a handful of
 counters); nothing here touches the device, so observing a request costs
 nanoseconds next to the dispatch it measures.
+
+Unified telemetry (docs/OBSERVABILITY.md): every observation ALSO mirrors
+into the process-wide registry (``lightgbm_tpu.telemetry.registry()``)
+under ``serve.*`` names, so one scrape of the registry sees training,
+resilience and serving together; :meth:`ServeMetrics.render_prometheus`
+answers a Prometheus scrape from one call.
 """
 
 from __future__ import annotations
@@ -13,6 +19,8 @@ from collections import deque
 from typing import Dict, Optional
 
 import numpy as np
+
+from ..telemetry import registry, render_prometheus
 
 
 class ServeMetrics:
@@ -40,6 +48,22 @@ class ServeMetrics:
         # scores came back non-finite — answered from the host mirror
         # instead of shipping NaN to a caller.
         self.nan_scores = 0
+        # Registry mirrors resolved ONCE (get-or-create instruments are
+        # stable objects with their own locks): the serve hot path pays no
+        # table lookup under the registry lock per observation.  Caveat:
+        # MetricsRegistry.reset() (tests only) detaches these mirrors for
+        # the life of this ServeMetrics — see the reset() docstring.
+        reg = registry()
+        self._c_requests = reg.counter("serve.requests")
+        self._c_rows = reg.counter("serve.rows")
+        self._h_latency = reg.histogram("serve.latency_s")
+        self._c_batches = reg.counter("serve.batches")
+        self._g_queue = reg.gauge("serve.queue_depth")
+        self._c_shed = reg.counter("serve.shed")
+        self._c_deadline = reg.counter("serve.deadline_misses")
+        self._c_faults = reg.counter("serve.device_faults")
+        self._c_fallbacks = reg.counter("serve.host_fallbacks")
+        self._c_nan = reg.counter("serve.nan_scores")
 
     # ------------------------------------------------------------- recording
     def observe_request(self, rows: int, seconds: float) -> None:
@@ -47,37 +71,47 @@ class ServeMetrics:
             self.requests += 1
             self.rows += int(rows)
             self._latencies.append(float(seconds))
+        self._c_requests.inc()
+        self._c_rows.inc(int(rows))
+        self._h_latency.observe(float(seconds))
 
     def observe_batch(self, rows: int, padded_to: int) -> None:
         with self._lock:
             self.batches += 1
             self._batch_sizes.append(int(rows))
             self.padded_rows += max(int(padded_to) - int(rows), 0)
+        self._c_batches.inc()
 
     def observe_queue_depth(self, depth: int) -> None:
         with self._lock:
             self.queue_depth = int(depth)
             self.max_queue_depth = max(self.max_queue_depth, int(depth))
+        self._g_queue.set(int(depth))
 
     def observe_shed(self, requests: int = 1) -> None:
         with self._lock:
             self.shed += int(requests)
+        self._c_shed.inc(int(requests))
 
     def observe_deadline_miss(self, requests: int = 1) -> None:
         with self._lock:
             self.deadline_misses += int(requests)
+        self._c_deadline.inc(int(requests))
 
     def observe_device_fault(self) -> None:
         with self._lock:
             self.device_faults += 1
+        self._c_faults.inc()
 
     def observe_host_fallback(self) -> None:
         with self._lock:
             self.host_fallbacks += 1
+        self._c_fallbacks.inc()
 
     def observe_nan_scores(self) -> None:
         with self._lock:
             self.nan_scores += 1
+        self._c_nan.inc()
 
     # ------------------------------------------------------------ reporting
     def latency_quantiles_ms(self) -> Dict[str, Optional[float]]:
@@ -93,7 +127,14 @@ class ServeMetrics:
 
     def snapshot(self, plan=None) -> Dict:
         """One flat dict of every gauge; ``plan`` adds its cache/compile
-        counters (the fields docs/SERVING.md documents)."""
+        counters (the fields docs/SERVING.md documents).
+
+        STABLE SCHEMA: the plan-derived keys (``compiles``,
+        ``plan_cache``) are always present — ``None`` when no plan was
+        passed — so scrapers and the Prometheus renderer see the same
+        metric set every call.  Note ``plan_cache`` counts are
+        PROCESS-GLOBAL: the plan cache is shared by every Predictor and
+        routed ``Booster.predict`` in this process, never per-predictor."""
         with self._lock:
             bs = np.asarray(self._batch_sizes, np.float64)
             out = {
@@ -111,13 +152,24 @@ class ServeMetrics:
                 "nan_scores": self.nan_scores,
             }
         out.update(self.latency_quantiles_ms())
-        if plan is not None:
-            out["compiles"] = plan.compile_count()
-            # PROCESS-GLOBAL cache counters (docs/SERVING.md): the plan
-            # cache is shared by every Predictor and routed Booster.predict
-            # in this process, so hits/misses here are not per-predictor.
-            out["plan_cache"] = dict(plan_cache_stats())
+        out["compiles"] = None if plan is None else plan.compile_count()
+        out["plan_cache"] = (None if plan is None
+                             else dict(plan_cache_stats()))
         return out
+
+    def render_prometheus(self, plan=None,
+                          prefix: str = "lgbm_tpu_serve") -> str:
+        """Prometheus text exposition of :meth:`snapshot` — a serving
+        process answers a scrape from this one call
+        (docs/OBSERVABILITY.md scrape example)."""
+        snap = self.snapshot(plan=plan)
+        if snap["plan_cache"] is None:
+            # stable exposition even plan-less: the cache counters render
+            # as NaN instead of vanishing between scrapes
+            snap["plan_cache"] = {k: None for k in
+                                  ("hits", "misses", "builds", "evictions",
+                                   "size")}
+        return render_prometheus(snap, prefix=prefix)
 
 
 def plan_cache_stats() -> Dict[str, int]:

@@ -36,6 +36,7 @@ import numpy as np
 
 from ..binning import _is_sparse
 from ..resilience import faults
+from ..telemetry import span
 from ..utils.log import Log
 from .bucketing import BucketLadder
 from .metrics import ServeMetrics
@@ -180,7 +181,8 @@ class Predictor:
                 _reject_inf_rows(X)
             n = X.shape[0]
         try:
-            out = self._predict_device(X, sparse)
+            with span("serve/predict"):
+                out = self._predict_device(X, sparse)
             if not np.isfinite(out).all():
                 # Health guard: never ship NaN/Inf scores.  The host
                 # mirror recomputes in f64 from the serialized model — a

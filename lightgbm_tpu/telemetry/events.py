@@ -1,0 +1,117 @@
+"""Structured JSONL event log (``tpu_telemetry_log=<path>``).
+
+One line per event, append-only, schema-versioned::
+
+    {"schema": 1, "kind": "train.iter", "ts": <monotonic_s>,
+     "wall": <unix_s>, "pid": <pid>, ...event fields...}
+
+``ts`` is ``time.monotonic()`` — the ordering/duration clock (immune to
+wall-clock steps); ``wall`` is ``time.time()`` for humans correlating with
+external logs.  Event kinds and their fields are the taxonomy table in
+docs/OBSERVABILITY.md; ``tools/telemetry_report.py`` replays a log into a
+per-iteration/per-phase triage table, and the same file feeds
+``tools/health_report.py`` and ``tools/profile_iter.py --from-log``.
+
+The sink is process-global (one training run configures it at start and
+closes it at end — ``engine.train`` does both).  ``emit`` with no sink
+still counts the event in the registry (``event.<kind>`` counters), so
+``detail.telemetry`` blocks carry event counts even when nothing is being
+written to disk.  Writes are lock-serialized; a full disk or revoked path
+warns once and drops subsequent lines rather than failing training.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+from ..utils.log import Log
+from . import spans
+from .registry import registry
+
+SCHEMA_VERSION = 1
+
+
+class JsonlSink:
+    """Append-only JSONL writer for one telemetry log path."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)
+        self._fh = open(path, "a", encoding="utf-8")
+        self._write_failed = False
+
+    def write(self, event: dict) -> None:
+        line = json.dumps(event, default=str)
+        with self._lock:
+            if self._fh is None:
+                return
+            try:
+                self._fh.write(line + "\n")
+                self._fh.flush()
+            except OSError as e:
+                if not self._write_failed:
+                    self._write_failed = True
+                    Log.warning(f"telemetry: dropping events — write to "
+                                f"{self.path} failed ({e})")
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                try:
+                    self._fh.close()
+                except OSError:
+                    pass
+                self._fh = None
+
+
+_sink_lock = threading.Lock()
+_sink: Optional[JsonlSink] = None
+
+
+def configure_log(path: Optional[str]) -> Optional[JsonlSink]:
+    """Open (or switch) the process sink; ``None``/"" closes it.  Returns
+    the active sink — or ``None`` with a warning when the path cannot be
+    opened (a pure observability knob must never abort training)."""
+    global _sink
+    with _sink_lock:
+        if _sink is not None and (not path or _sink.path != path):
+            _sink.close()
+            _sink = None
+        if path and _sink is None:
+            try:
+                _sink = JsonlSink(path)
+            except OSError as e:
+                Log.warning(f"telemetry: cannot open event log {path!r} "
+                            f"({e}); events will not be recorded")
+        return _sink
+
+
+def active_sink() -> Optional[JsonlSink]:
+    with _sink_lock:
+        return _sink
+
+
+def close_log() -> None:
+    configure_log(None)
+
+
+def emit(kind: str, **fields) -> None:
+    """Emit one event: counted in the registry always (when telemetry is
+    enabled), written to the JSONL sink when one is configured."""
+    if not spans.enabled():
+        return
+    registry().counter(f"event.{kind}").inc()
+    sink = active_sink()
+    if sink is None:
+        return
+    event = {"schema": SCHEMA_VERSION, "kind": kind,
+             "ts": round(time.monotonic(), 6),
+             "wall": round(time.time(), 6), "pid": os.getpid()}
+    event.update(fields)
+    sink.write(event)

@@ -1,0 +1,65 @@
+"""Prometheus text-exposition rendering (docs/OBSERVABILITY.md scrape
+section): flatten a metrics dict (``ServeMetrics.snapshot()`` or the
+process registry snapshot) into ``text/plain; version=0.0.4`` lines a
+scraper ingests directly — a serving process answers a scrape from ONE
+call (``ServeMetrics.render_prometheus``).
+
+Schema stability: every key renders every scrape.  ``None`` values (no
+observations yet, or ``snapshot(plan=None)``'s plan-less counters) render
+as ``NaN`` — a gauge that vanishes between scrapes breaks rate() queries,
+a NaN one does not.  Nested dicts flatten with ``_`` (``plan_cache.hits``
+-> ``<prefix>_plan_cache_hits``).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict
+
+# snapshot keys that are monotonic counts (everything else is a gauge)
+_COUNTER_KEYS = frozenset({
+    "requests", "rows", "batches", "padded_rows", "shed", "deadline_misses",
+    "device_faults", "host_fallbacks", "nan_scores", "compiles", "hits",
+    "misses", "builds", "evictions",
+})
+
+_NAME_OK = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def _metric_name(prefix: str, *parts: str) -> str:
+    name = "_".join([prefix] + [p for p in parts if p])
+    return _NAME_OK.sub("_", name)
+
+
+def _flatten(d: Dict, path=()) -> list:
+    out = []
+    for key, val in d.items():
+        if isinstance(val, dict):
+            out.extend(_flatten(val, path + (str(key),)))
+        else:
+            out.append((path + (str(key),), val))
+    return out
+
+
+def render_prometheus(snapshot: Dict, prefix: str = "lgbm_tpu_serve") -> str:
+    """One exposition document from a flat-or-nested snapshot dict.
+    Non-numeric values (strings, lists) are skipped; ``None`` renders as
+    ``NaN`` so the metric set is identical every scrape."""
+    lines = []
+    for path, val in _flatten(snapshot):
+        if isinstance(val, bool):
+            val = int(val)
+        if val is not None and not isinstance(val, (int, float)):
+            continue
+        name = _metric_name(prefix, *path)
+        # A registry snapshot declares its sections ("counters" holds only
+        # monotonic counts); flat snapshots (ServeMetrics) type by leaf key.
+        if path[0] == "counters":
+            mtype = "counter"
+        elif path[0] in ("gauges", "histograms"):
+            mtype = "gauge"
+        else:
+            mtype = "counter" if path[-1] in _COUNTER_KEYS else "gauge"
+        lines.append(f"# TYPE {name} {mtype}")
+        lines.append(f"{name} {'NaN' if val is None else repr(float(val))}")
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,161 @@
+"""Process-wide metrics registry: counters, gauges and histograms with
+bounded reservoirs (docs/OBSERVABILITY.md).
+
+One :class:`MetricsRegistry` instance per process (:func:`registry`), the
+single sink every subsystem publishes into — training spans
+(telemetry/spans.py), the resilience layer (health sentinel trips,
+checkpoint save/restore durations, watchdog verdicts) and serving
+(serve/metrics.py mirrors its per-predictor gauges here).  All host-side:
+observing a metric is a lock + a dict write, never a device touch.
+
+Thread-safety: the registry lock guards only the instrument tables
+(two racing ``counter(name)`` calls share one instrument); each
+instrument carries its OWN lock, so high-QPS serve observations never
+serialize against training spans or health counters.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Dict, Optional
+
+import numpy as np
+
+
+class Counter:
+    """Monotonic count (requests served, events emitted, sentinel trips)."""
+
+    def __init__(self, name: str, lock: threading.Lock):
+        self.name = name
+        self._lock = lock
+        self._value = 0
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> int:
+        with self._lock:
+            return self._value
+
+
+class Gauge:
+    """Last-write-wins scalar (queue depth, watchdog latency, pack size)."""
+
+    def __init__(self, name: str, lock: threading.Lock):
+        self.name = name
+        self._lock = lock
+        self._value: Optional[float] = None
+
+    def set(self, v) -> None:
+        with self._lock:
+            self._value = None if v is None else float(v)
+
+    @property
+    def value(self) -> Optional[float]:
+        with self._lock:
+            return self._value
+
+
+class Histogram:
+    """Duration/size distribution: exact count and sum plus a bounded
+    reservoir (newest ``reservoir`` observations) for the quantiles — the
+    same deque scheme ServeMetrics uses, so a long-lived process never
+    grows its telemetry footprint."""
+
+    def __init__(self, name: str, lock: threading.Lock,
+                 reservoir: int = 1024):
+        self.name = name
+        self._lock = lock
+        self.count = 0
+        self.sum = 0.0
+        self._values = deque(maxlen=reservoir)
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        with self._lock:
+            self.count += 1
+            self.sum += v
+            self._values.append(v)
+
+    def summary(self) -> Dict[str, Optional[float]]:
+        with self._lock:
+            vals = np.asarray(self._values, np.float64)
+            count, total = self.count, self.sum
+        out = {"count": count, "sum": total, "p50": None, "p99": None,
+               "max": None}
+        if vals.size:
+            out["p50"] = float(np.percentile(vals, 50))
+            out["p99"] = float(np.percentile(vals, 99))
+            out["max"] = float(vals.max())
+        return out
+
+
+class MetricsRegistry:
+    """Named instrument table.  ``counter``/``gauge``/``histogram`` are
+    get-or-create (idempotent, shared instance per name)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, Counter] = {}
+        self._gauges: Dict[str, Gauge] = {}
+        self._histograms: Dict[str, Histogram] = {}
+
+    def counter(self, name: str) -> Counter:
+        with self._lock:
+            c = self._counters.get(name)
+            if c is None:
+                c = self._counters[name] = Counter(name, threading.Lock())
+            return c
+
+    def gauge(self, name: str) -> Gauge:
+        with self._lock:
+            g = self._gauges.get(name)
+            if g is None:
+                g = self._gauges[name] = Gauge(name, threading.Lock())
+            return g
+
+    def histogram(self, name: str, reservoir: int = 1024) -> Histogram:
+        with self._lock:
+            h = self._histograms.get(name)
+            if h is None:
+                h = self._histograms[name] = Histogram(
+                    name, threading.Lock(), reservoir)
+            return h
+
+    def snapshot(self) -> Dict[str, Dict]:
+        """One nested dict of every instrument's current value — the
+        ``registry`` section of ``detail.telemetry`` in BENCH blobs."""
+        with self._lock:
+            counters = list(self._counters.items())
+            gauges = list(self._gauges.items())
+            hists = list(self._histograms.items())
+        # instrument reads take each instrument's own lock, outside the
+        # registry lock (no lock-order coupling)
+        return {
+            "counters": {n: c.value for n, c in counters},
+            "gauges": {n: g.value for n, g in gauges},
+            "histograms": {n: h.summary() for n, h in hists},
+        }
+
+    def reset(self) -> None:
+        """Drop every instrument — TESTS ONLY.  A long-lived process keeps
+        its counters for the life of the process (like any Prometheus
+        target): holders of cached instrument objects (ServeMetrics
+        mirrors) would keep publishing into detached instruments after a
+        reset, invisible to later snapshots."""
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._histograms.clear()
+
+
+_REGISTRY = MetricsRegistry()
+
+
+def registry() -> MetricsRegistry:
+    """THE process-wide registry (training, resilience and serving all
+    publish here; scrapes and bench blobs read it)."""
+    return _REGISTRY

@@ -1,0 +1,131 @@
+"""Trace spans: ONE context manager that opens a ``jax.profiler.
+TraceAnnotation`` region (so the span shows up in device profiler traces)
+AND aggregates host wall time into the hierarchical timer + the process
+registry (docs/OBSERVABILITY.md).
+
+Span names are ``area/phase`` (``train/iter_dispatch``, ``grower/grow``,
+``serve/predict``); nested spans join with ``/`` through a thread-local
+stack, so a ``grow`` span opened inside ``train/iter_dispatch`` aggregates
+as ``train/iter_dispatch/grow``.
+
+HOST-SIDE ONLY, at dispatch boundaries: a span wraps the *launch* of a
+compiled program (and any blocking fetch), never code inside a trace —
+``tpu_telemetry=off`` therefore compiles bitwise-identical programs and
+the dispatch census stays pinned (tests/test_telemetry.py).  Disabled
+spans cost one flag read.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict
+
+from ..utils.timer import Timer
+from .registry import registry
+
+# Process-wide arm switch (tpu_telemetry).  Set per-run by the engine /
+# GBDT constructor from the config; raw Booster.update loops (bench rungs)
+# keep whatever the last constructed booster asked for (default: on).
+_enabled = True
+
+# Dedicated span timer (not utils.timer.global_timer: the LGBM_TPU_TIMETAG
+# summary stays the legacy FunctionTimer surface; span totals are read
+# programmatically via span_totals / the bench telemetry block).
+_span_timer = Timer()
+
+_local = threading.local()
+
+
+def set_enabled(on: bool) -> None:
+    global _enabled
+    _enabled = bool(on)
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def _stack():
+    st = getattr(_local, "stack", None)
+    if st is None:
+        st = _local.stack = []
+    return st
+
+
+class span:
+    """``with span("train/grow"): ...`` — host timer + profiler
+    annotation + registry histogram, one context manager.  Re-entrant and
+    thread-safe (per-thread name stacks; the timer is lock-guarded)."""
+
+    __slots__ = ("name", "_path", "_t0", "_trace")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._path = None
+        self._t0 = 0.0
+        self._trace = None
+
+    def __enter__(self):
+        if not _enabled:
+            return self
+        stack = _stack()
+        self._path = (f"{stack[-1]}/{self.name}" if stack else self.name)
+        stack.append(self._path)
+        try:
+            import jax.profiler
+            self._trace = jax.profiler.TraceAnnotation(self._path)
+            self._trace.__enter__()
+        except Exception:  # noqa: BLE001 — profiler is garnish on the timer
+            self._trace = None
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        if self._path is None:   # entered disabled
+            return False
+        dt = time.perf_counter() - self._t0
+        if self._trace is not None:
+            try:
+                self._trace.__exit__(*exc)
+            except Exception:  # noqa: BLE001 — a torn-down profiler must
+                pass           # not break training or strand the stack
+        stack = _stack()
+        if stack and stack[-1] == self._path:
+            stack.pop()
+        _span_timer.add(self._path, dt)
+        registry().histogram(f"span.{self._path}").observe(dt)
+        self._path = None
+        return False
+
+
+def instrument(fn, name: str):
+    """Wrap a compiled callable so every launch runs under ``span(name)``,
+    delegating attribute access (``.lower``, ``.raw``, the grower's static
+    capability facts) to the wrapped function — callers and the dispatch
+    census see the same surface."""
+    return _Instrumented(fn, name)
+
+
+class _Instrumented:
+    def __init__(self, fn, name: str):
+        self._fn = fn
+        self._span_name = name
+
+    def __call__(self, *args, **kwargs):
+        with span(self._span_name):
+            return self._fn(*args, **kwargs)
+
+    def __getattr__(self, item):
+        return getattr(self._fn, item)
+
+
+def span_totals() -> Dict[str, Dict[str, float]]:
+    """``{span_path: {"seconds": s, "count": n}}`` aggregated since process
+    start (or the last :func:`reset_spans`)."""
+    return {name: {"seconds": secs, "count": cnt}
+            for name, secs, cnt in _span_timer.snapshot()}
+
+
+def reset_spans() -> None:
+    _span_timer.reset()

@@ -6,40 +6,84 @@ instance ``src/boosting/gbdt.cpp:22``).
 
 TPU addition: named spans also open ``jax.profiler.TraceAnnotation`` regions so
 the same span set shows up in TPU profiler traces (the reference's hand
-instrumentation of hot paths, e.g. ``serial_tree_learner.cpp:180``)."""
+instrumentation of hot paths, e.g. ``serial_tree_learner.cpp:180``).
+
+Thread-safety: concurrent serve threads (MicroBatcher worker + caller
+threads) time spans on the SAME instance, so every mutation is
+lock-guarded and in-flight starts are tracked per ``(thread, name)`` as a
+STACK — nested same-name spans on one thread are re-entrancy-safe (each
+``stop`` closes the innermost matching ``start``)."""
 
 from __future__ import annotations
 
 import atexit
 import collections
 import os
+import threading
 import time
-from typing import Dict, Optional
+from typing import Dict, List, Optional, Tuple
 
 
 class Timer:
     def __init__(self):
+        self._lock = threading.Lock()
         self.durations: Dict[str, float] = collections.defaultdict(float)
         self.counts: Dict[str, int] = collections.defaultdict(int)
-        self._starts: Dict[str, float] = {}
+        # (thread ident, name) -> stack of perf_counter starts
+        self._starts: Dict[Tuple[int, str], List[float]] = {}
 
     def start(self, name: str) -> None:
-        self._starts[name] = time.perf_counter()
+        t = time.perf_counter()
+        key = (threading.get_ident(), name)
+        with self._lock:
+            self._starts.setdefault(key, []).append(t)
 
     def stop(self, name: str) -> None:
-        if name in self._starts:
-            self.durations[name] += time.perf_counter() - self._starts.pop(name)
+        t = time.perf_counter()
+        key = (threading.get_ident(), name)
+        with self._lock:
+            stack = self._starts.get(key)
+            if not stack:
+                return   # unmatched stop (or a different thread's start)
+            t0 = stack.pop()
+            if not stack:
+                del self._starts[key]
+            self.durations[name] += t - t0
             self.counts[name] += 1
+
+    def add(self, name: str, seconds: float) -> None:
+        """Aggregate an externally-measured duration (telemetry spans)."""
+        with self._lock:
+            self.durations[name] += float(seconds)
+            self.counts[name] += 1
+
+    def snapshot(self) -> List[Tuple[str, float, int]]:
+        """``(name, total_seconds, count)`` rows, longest first."""
+        with self._lock:
+            return sorted(((n, self.durations[n], self.counts[n])
+                           for n in self.durations),
+                          key=lambda row: -row[1])
+
+    def reset(self) -> None:
+        with self._lock:
+            self.durations.clear()
+            self.counts.clear()
+            self._starts.clear()
 
     def summary(self) -> str:
         lines = ["LightGBM-TPU timer summary:"]
-        for name in sorted(self.durations, key=lambda n: -self.durations[n]):
-            lines.append(f"  {name}: {self.durations[name]:.3f}s "
-                         f"(x{self.counts[name]})")
+        for name, secs, cnt in self.snapshot():
+            lines.append(f"  {name}: {secs:.3f}s (x{cnt})")
         return "\n".join(lines)
 
     def print_at_exit(self) -> None:
-        atexit.register(lambda: print(self.summary()))
+        # Through Log (stderr / the registered callback), never raw
+        # stdout: the atexit summary must not corrupt parseable CLI or
+        # bench JSON output.
+        def _emit():
+            from .log import Log
+            Log.info(self.summary())
+        atexit.register(_emit)
 
 
 global_timer = Timer()

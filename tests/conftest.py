@@ -35,6 +35,25 @@ def rng():
     return np.random.RandomState(42)
 
 
+@pytest.fixture(autouse=True)
+def _telemetry_sink_leak_guard(request):
+    """Leak guard (ISSUE-9 satellite): a test that configures a telemetry
+    JSONL sink and forgets to close it would stream every LATER test's
+    events into its file.  Warn with the offender's nodeid and close the
+    sink so the leak never crosses test boundaries.  Zero-cost when the
+    telemetry module was never imported."""
+    yield
+    tel = sys.modules.get("lightgbm_tpu.telemetry")
+    if tel is None:
+        return
+    sink = tel.active_sink()
+    if sink is not None:
+        sys.stderr.write(
+            f"[telemetry leak] {request.node.nodeid} left JSONL sink "
+            f"{sink.path!r} registered; closing it\n")
+        tel.close_log()
+
+
 def pytest_terminal_summary(terminalreporter, exitstatus, config):
     """One end-of-run line making the differential-coverage gap visible
     (VERDICT weak #3): without ``LGBM_REFERENCE_BIN`` every

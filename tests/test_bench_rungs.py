@@ -30,6 +30,17 @@ def _assert_hlo_cost(blob):
     assert h["verdict"] == "healthy", h
     assert h["rounds_checked"] == blob["iters"]
     assert h["last_health"]["grad_nonfinite"] == 0.0
+    # ISSUE-9 satellite: and the schema-valid unified-telemetry block —
+    # span totals at dispatch boundaries, per-kind event counts, the
+    # process registry snapshot (docs/OBSERVABILITY.md BENCH section).
+    t = blob["telemetry"]
+    assert t.get("schema") == 1 and t["enabled"] is True, t
+    assert isinstance(t["events"], dict)
+    assert isinstance(t["registry"], dict) and "counters" in t["registry"]
+    assert t["spans"], t
+    assert all(d["seconds"] >= 0.0 and d["count"] >= 1
+               for d in t["spans"].values()), t["spans"]
+    json.dumps(t)   # JSON-serializable end to end (it rides the blob)
 
 
 def test_ltr_rung_blob():

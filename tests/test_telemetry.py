@@ -1,0 +1,362 @@
+"""Unified telemetry invariants (ISSUE-9, docs/OBSERVABILITY.md):
+
+- registry thread-safety under hammering threads (serve threads + the
+  training loop publish concurrently);
+- JSONL schema round-trip: every event a train run emits re-parses and
+  carries the schema/ts/kind envelope, ``train.iter`` events split wall
+  time into dispatch wait vs host bookkeeping, and the report/census/
+  health tools all read the same artifact;
+- the inertness contract: ``tpu_telemetry=off`` compiles bitwise-identical
+  training programs (equal lowered-HLO text) and the fused dispatch
+  census stays 1.0 dispatches/iter WITH telemetry armed;
+- the Prometheus exposition renders every ServeMetrics gauge, including
+  the degradation and nan_scores counters, with a stable plan-less schema;
+- tools/telemetry_report.py CLI smoke (subprocess);
+- utils/timer.py thread-safety and nested same-name re-entrancy.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import telemetry
+from lightgbm_tpu.serve.metrics import ServeMetrics
+from lightgbm_tpu.utils.timer import Timer
+
+pytestmark = pytest.mark.telemetry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _data(n=1200, f=6, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    y = (X[:, 0] + X[:, 1] * X[:, 2] > 0).astype(np.float64)
+    return X, y
+
+
+@pytest.fixture(autouse=True)
+def _rearm():
+    """Every test starts armed (the process default) and leaves no sink."""
+    telemetry.set_enabled(True)
+    yield
+    telemetry.close_log()
+    telemetry.set_enabled(True)
+
+
+# ------------------------------------------------------------------ registry
+def test_registry_thread_safety_under_hammering():
+    reg = telemetry.MetricsRegistry()
+    threads, per_thread = 8, 2000
+
+    def hammer(i):
+        c = reg.counter("hammer.count")
+        h = reg.histogram("hammer.lat")
+        g = reg.gauge("hammer.depth")
+        for j in range(per_thread):
+            c.inc()
+            h.observe(0.001 * (j % 7))
+            g.set(j)
+
+    ts = [threading.Thread(target=hammer, args=(i,)) for i in range(threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    snap = reg.snapshot()
+    assert snap["counters"]["hammer.count"] == threads * per_thread
+    hist = snap["histograms"]["hammer.lat"]
+    assert hist["count"] == threads * per_thread
+    assert hist["p50"] is not None and hist["max"] is not None
+    assert snap["gauges"]["hammer.depth"] == per_thread - 1
+
+
+def test_registry_instruments_are_shared_per_name():
+    reg = telemetry.MetricsRegistry()
+    assert reg.counter("x") is reg.counter("x")
+    assert reg.histogram("h") is reg.histogram("h")
+    reg.counter("x").inc(3)
+    assert reg.counter("x").value == 3
+
+
+# ------------------------------------------------------------------- timer
+def test_timer_nested_same_name_reentrant():
+    t = Timer()
+    t.start("a")
+    t.start("a")      # nested same-name span must not lose the outer start
+    t.stop("a")
+    t.stop("a")
+    t.stop("a")       # unmatched stop is a no-op, not corruption
+    assert t.counts["a"] == 2
+    assert t.durations["a"] >= 0.0
+
+
+def test_timer_thread_safety():
+    t = Timer()
+
+    def work():
+        for _ in range(500):
+            t.start("w")
+            t.stop("w")
+
+    ts = [threading.Thread(target=work) for _ in range(8)]
+    for th in ts:
+        th.start()
+    for th in ts:
+        th.join()
+    assert t.counts["w"] == 8 * 500
+    assert not t._starts     # no stranded in-flight starts
+
+
+# -------------------------------------------------------------------- spans
+def test_span_hierarchy_and_disable():
+    telemetry.reset_spans()
+    with telemetry.span("outer"):
+        with telemetry.span("inner"):
+            pass
+    totals = telemetry.span_totals()
+    assert totals["outer"]["count"] == 1
+    assert totals["outer/inner"]["count"] == 1
+    telemetry.set_enabled(False)
+    with telemetry.span("outer"):
+        pass
+    assert telemetry.span_totals()["outer"]["count"] == 1   # unchanged
+
+
+# -------------------------------------------------------- JSONL round-trip
+def test_jsonl_schema_roundtrip_and_tools(tmp_path):
+    log = str(tmp_path / "run.jsonl")
+    X, y = _data()
+    Xv, yv = _data(400, seed=1)
+    ds = lgb.Dataset(X, label=y)
+    dv = lgb.Dataset(Xv, label=yv, reference=ds)
+    history = {}
+    bst = lgb.train(
+        {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+         "metric": "binary_logloss", "tpu_telemetry_log": log,
+         "checkpoint_interval": 2,
+         "checkpoint_dir": str(tmp_path / "ckpt")},
+        ds, 5, valid_sets=[dv], valid_names=["valid"],
+        callbacks=[lgb.record_evaluation(history)])
+    assert bst.num_trees() == 5
+    # the sink the engine opened is closed again (leak contract)
+    assert telemetry.active_sink() is None
+
+    events = [json.loads(line) for line in open(log)]
+    kinds = [e["kind"] for e in events]
+    assert kinds[0] == "train.start" and kinds[-1] == "train.end"
+    assert kinds.count("train.iter") == 5
+    assert "train.checkpoint" in kinds
+    ts = [e["ts"] for e in events]
+    assert ts == sorted(ts)      # monotonic clock, in-order writes
+    for e in events:             # envelope on every single line
+        assert e["schema"] == telemetry.SCHEMA_VERSION
+        assert isinstance(e["ts"], float) and isinstance(e["kind"], str)
+        assert "wall" in e and "pid" in e
+    iters = [e for e in events if e["kind"] == "train.iter"]
+    for e in iters:
+        assert e["wall_s"] >= e["dispatch_wait_s"] >= 0.0
+        assert e["host_s"] >= 0.0 and e["pack_size"] >= 1
+    # the record_evaluation callback pins the per-round path; checkpoint
+    # write durations land on their rounds
+    assert any(e["checkpoint_s"] is not None for e in iters)
+    end = events[-1]
+    assert end["iterations"] == 5 and end["spans"], end
+
+    # one artifact, three readers (ISSUE-9 satellite)
+    from tools.profile_iter import census_from_log
+    census = census_from_log(log)
+    assert census["iters"] == 5 and census["mean_wall_s"] > 0
+    from tools.health_report import bench_health_rows, is_telemetry_log
+    assert is_telemetry_log(log)
+    rows = bench_health_rows([log])
+    assert rows and rows[0][1] == "log" and rows[0][3] == 5
+    from tools.telemetry_report import load_events
+    loaded, problems = load_events(log)
+    assert len(loaded) == len(events) and not problems
+
+
+def test_telemetry_report_cli_smoke(tmp_path):
+    log = str(tmp_path / "run.jsonl")
+    X, y = _data(800)
+    ds = lgb.Dataset(X, label=y)
+    lgb.train({"objective": "binary", "num_leaves": 7, "verbosity": -1,
+               "metric": "none", "tpu_telemetry_log": log}, ds, 3)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "telemetry_report.py"),
+         log], capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    assert "iterations" in proc.stdout and "phases" in proc.stdout
+    assert "train.iter" in proc.stdout
+
+
+def test_report_tolerates_torn_and_unknown_lines(tmp_path):
+    log = tmp_path / "torn.jsonl"
+    log.write_text(
+        json.dumps({"schema": 1, "kind": "train.iter", "ts": 1.0,
+                    "wall": 0.0, "pid": 1, "iteration": 1, "wall_s": 0.5,
+                    "dispatch_wait_s": 0.4, "host_s": 0.1,
+                    "pack_size": 1}) + "\n"
+        + json.dumps({"schema": 99, "kind": "future.kind", "ts": 2.0}) + "\n"
+        + '{"torn": \n')
+    from tools.telemetry_report import load_events
+    events, problems = load_events(str(log))
+    assert len(events) == 1 and len(problems) == 2
+
+
+# ------------------------------------------------------- inertness contract
+def _fused_lowered_text(tpu_telemetry):
+    X, y = _data(600)
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.Booster(params={"objective": "binary", "num_leaves": 7,
+                              "verbosity": -1, "metric": "none",
+                              "tpu_telemetry": tpu_telemetry},
+                      train_set=ds)
+    g = bst._gbdt
+    assert g._fused_iter is not None
+    lowered = g._fused_iter.lower(g.bins_dev, g.scores, g._full_mask,
+                                  g._fmask_static, 0.1, None, None, None,
+                                  None, None)
+    return lowered.as_text()
+
+
+def test_off_mode_bitwise_program_identity():
+    """tpu_telemetry=off vs on: the lowered fused-iteration HLO is equal
+    TEXT — telemetry never enters a traced program."""
+    on = _fused_lowered_text("on")
+    off = _fused_lowered_text("off")
+    assert on == off
+    telemetry.set_enabled(True)
+
+
+def test_census_one_dispatch_with_telemetry_armed(tmp_path):
+    """The fused census stays 1.0 dispatches/iter WITH telemetry armed
+    (spans + a live JSONL sink): instrumentation adds zero launches."""
+    from tools.profile_iter import nonfused_dispatch_census
+    telemetry.configure_log(str(tmp_path / "census.jsonl"))
+    try:
+        blobs = nonfused_dispatch_census(rows=2048, iters=2, num_leaves=7,
+                                         paths=("fused",))
+    finally:
+        telemetry.close_log()
+    assert blobs[0]["used_fused"] is True
+    assert blobs[0]["dispatches_per_iter"] == 1.0, blobs[0]
+
+
+def test_telemetry_knob_validated():
+    X, y = _data(300)
+    ds = lgb.Dataset(X, label=y)
+    with pytest.raises(ValueError, match="tpu_telemetry"):
+        lgb.Booster(params={"objective": "binary", "verbosity": -1,
+                            "tpu_telemetry": "maybe"}, train_set=ds)
+
+
+# ------------------------------------------------------------- prometheus
+def test_prometheus_renders_every_serve_gauge():
+    m = ServeMetrics()
+    m.observe_request(8, 0.002)
+    m.observe_batch(8, 16)
+    m.observe_queue_depth(3)
+    m.observe_shed()
+    m.observe_deadline_miss()
+    m.observe_device_fault()
+    m.observe_host_fallback()
+    m.observe_nan_scores()
+    snap = m.snapshot()
+    text = m.render_prometheus()
+    for key, val in snap.items():
+        if isinstance(val, dict) or val is None:
+            continue
+        assert f"lgbm_tpu_serve_{key} " in text, key
+    # the degradation + nan_scores counters, with their values
+    assert "lgbm_tpu_serve_shed 1.0" in text
+    assert "lgbm_tpu_serve_deadline_misses 1.0" in text
+    assert "lgbm_tpu_serve_nan_scores 1.0" in text
+    assert "# TYPE lgbm_tpu_serve_requests counter" in text
+    assert "# TYPE lgbm_tpu_serve_queue_depth gauge" in text
+
+
+def test_snapshot_stable_schema_without_plan():
+    """plan=None keeps the plan-derived keys (as None) so scrapers see one
+    schema; the exposition renders them as NaN instead of dropping them."""
+    m = ServeMetrics()
+    snap = m.snapshot()
+    assert "compiles" in snap and snap["compiles"] is None
+    assert "plan_cache" in snap and snap["plan_cache"] is None
+    text = m.render_prometheus()
+    assert "lgbm_tpu_serve_compiles NaN" in text
+    assert "lgbm_tpu_serve_plan_cache_hits NaN" in text
+
+
+def test_prometheus_registry_snapshot_typing():
+    """The whole-registry exposition types by SECTION: everything under
+    `counters` is a counter, gauges/histograms are gauges — regardless of
+    leaf-name collisions with the serve key list."""
+    reg = telemetry.MetricsRegistry()
+    reg.counter("health.trips").inc(2)
+    reg.counter("custom.rows").inc(5)          # leaf collides with a gauge-y name
+    reg.gauge("watchdog.probe_latency_s").set(1.5)
+    reg.histogram("checkpoint.save_s").observe(0.01)
+    text = telemetry.render_prometheus(reg.snapshot(), prefix="lgbm_tpu")
+    assert "# TYPE lgbm_tpu_counters_health_trips counter" in text
+    assert "# TYPE lgbm_tpu_counters_custom_rows counter" in text
+    assert "# TYPE lgbm_tpu_gauges_watchdog_probe_latency_s gauge" in text
+    assert "# TYPE lgbm_tpu_histograms_checkpoint_save_s_count gauge" in text
+
+
+def test_pack_path_checkpoints_counted_from_log(tmp_path):
+    """Packed runs snapshot at pack boundaries (no train.iter carries the
+    duration), so the census counts checkpoint writes from the
+    train.checkpoint events both paths emit."""
+    log = str(tmp_path / "pack.jsonl")
+    X, y = _data(1500)
+    ds = lgb.Dataset(X, label=y)
+    lgb.train({"objective": "binary", "num_leaves": 7, "verbosity": -1,
+               "metric": "none", "tpu_telemetry_log": log,
+               "tpu_iter_pack": 3, "checkpoint_interval": 3,
+               "checkpoint_dir": str(tmp_path / "ckpt")}, ds, 6)
+    events = [json.loads(line) for line in open(log)]
+    kinds = [e["kind"] for e in events]
+    assert kinds.count("train.iter") == 6
+    assert all(e["pack_size"] == 3 for e in events
+               if e["kind"] == "train.iter")
+    n_ckpt = kinds.count("train.checkpoint")
+    assert n_ckpt >= 1
+    from tools.profile_iter import census_from_log
+    assert census_from_log(log)["checkpoint_writes"] == n_ckpt
+
+
+def test_serve_metrics_mirror_into_process_registry():
+    before = telemetry.registry().counter("serve.nan_scores").value
+    m = ServeMetrics()
+    m.observe_nan_scores()
+    assert telemetry.registry().counter("serve.nan_scores").value \
+        == before + 1
+
+
+# ------------------------------------------------------------ bench block
+def test_bench_telemetry_block_schema():
+    import bench
+    telemetry.reset_spans()
+    X, y = _data(600)
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.Booster(params={"objective": "binary", "num_leaves": 7,
+                              "verbosity": -1, "metric": "none"},
+                      train_set=ds)
+    bst.update()
+    blk = bench._telemetry_block()
+    assert blk["schema"] == telemetry.SCHEMA_VERSION
+    assert blk["enabled"] is True
+    assert isinstance(blk["events"], dict)
+    spans = blk["spans"]
+    assert any(name.startswith("train/") for name in spans), spans
+    for d in spans.values():
+        assert d["seconds"] >= 0.0 and d["count"] >= 1
+    assert "counters" in blk["registry"]
+    json.dumps(blk)     # JSON-safe end to end

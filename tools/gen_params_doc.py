@@ -144,6 +144,32 @@ _DESCRIPTIONS = {
         "rollback applies, so train(resume_from=ckpt, "
         "tpu_health_recovery_salt=N) reproduces the recovered run's "
         "trees bitwise (docs/ROBUSTNESS.md)"),
+    "tpu_telemetry": (
+        "unified telemetry (telemetry/, docs/OBSERVABILITY.md): on = "
+        "host-side spans at dispatch boundaries (jax.profiler."
+        "TraceAnnotation + the lock-guarded hierarchical timer), the "
+        "process-wide metrics registry and JSONL events; off is "
+        "bitwise-inert — telemetry never enters a traced program, so the "
+        "compiled training programs are identical and the dispatch "
+        "census stays pinned either way (tests/test_telemetry.py)"),
+    "tpu_telemetry_log": (
+        "structured JSONL event log path (docs/OBSERVABILITY.md event "
+        "taxonomy): schema-versioned, monotonic-clocked train.start/"
+        "train.iter (dispatch-wait vs host-bookkeeping wall split, pack "
+        "size, checkpoint write duration, health verdict)/train.end "
+        "events plus health/checkpoint/watchdog incidents; replay with "
+        "tools/telemetry_report.py — the same file feeds tools/"
+        "health_report.py and tools/profile_iter.py --from-log; '' = no "
+        "event file (registry counters and spans still aggregate)"),
+    "tpu_profile_iters": (
+        "capture a jax.profiler trace directory covering the FIRST N "
+        "committed boosting rounds (Mosaic/XLA kernel timelines for "
+        "tensorboard/xprof; ROADMAP 3's live-TPU rounds land with traces "
+        "in hand); 0 = off"),
+    "tpu_profile_dir": (
+        "destination for the tpu_profile_iters trace; '' derives "
+        "\"<tpu_telemetry_log>.trace\" when a telemetry log is set, else "
+        "/tmp/lightgbm_tpu_profile"),
 }
 
 

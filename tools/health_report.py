@@ -1,9 +1,10 @@
 """One triage table for training health: checkpoint generations + BENCH
-health blocks (ISSUE-8 CI/tooling satellite).
+health blocks (ISSUE-8 CI/tooling satellite) + telemetry JSONL logs.
 
 Usage::
 
-    python tools/health_report.py [--ckpt CKPT_DIR] [BENCH_*.json ...]
+    python tools/health_report.py [--ckpt CKPT_DIR] \
+        [BENCH_*.json | telemetry.jsonl ...]
 
 - ``--ckpt`` scans a resilience checkpoint directory: every generation's
   iteration, validity (the same checksum validation the restore scan
@@ -13,6 +14,11 @@ Usage::
   every rung's nested ``health`` block: lambdarank/wide/goss/fused_wave),
   i.e. the sentinel verdict, rounds checked, rollbacks and int16-wire
   overflow escalations per measured rung.
+- A ``tpu_telemetry_log`` JSONL file (sniffed by its event lines) is
+  summarized from its ``train.iter``/``health.*``/``train.rollback``
+  events into the same table — ONE training artifact feeds health triage,
+  the dispatch census (``tools/profile_iter.py --from-log``) and
+  ``tools/telemetry_report.py`` without re-running training.
 
 Plain stdlib + the repo; safe to run anywhere the repo checks out (the
 checkpoint scan imports lightgbm_tpu lazily and only for frame reading).
@@ -73,10 +79,56 @@ def scan_checkpoints(ckpt_dir: str):
     return rows
 
 
+def is_telemetry_log(path: str) -> bool:
+    """Sniff a telemetry JSONL log: the first parseable line is a
+    schema-carrying event, not a BENCH metric blob."""
+    try:
+        with open(path) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line.startswith("{"):
+                    continue
+                try:
+                    obj = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                return isinstance(obj, dict) and "kind" in obj \
+                    and "schema" in obj
+    except OSError:
+        pass
+    return False
+
+
+def telemetry_health_rows(path):
+    """Health rows distilled from a telemetry JSONL log's events — same
+    columns as the BENCH table, ``rung`` = "log"."""
+    from tools.telemetry_report import load_events
+
+    events, _problems = load_events(path)
+    iters = [e for e in events if e["kind"] == "train.iter"]
+    trips = [e for e in events if e["kind"] == "health.trip"]
+    rollbacks = sum(1 for e in events if e["kind"] == "train.rollback")
+    overflow = sum(1 for e in events if e["kind"] == "health.overflow")
+    verdict = "unchecked"
+    for e in reversed(events):
+        if e["kind"] in ("train.iter", "train.end") and e.get("health"):
+            verdict = e["health"]
+            break
+    flags = ", ".join(sorted({t.get("reason", "?") for t in trips}))[:60]
+    if not events:
+        return [(os.path.basename(path), "log", "empty", "-", "-", "-", "")]
+    return [(os.path.basename(path), "log", verdict, len(iters), rollbacks,
+             overflow, flags)]
+
+
 def bench_health_rows(paths):
-    """One row per (file, rung) health block found in BENCH jsons."""
+    """One row per (file, rung) health block found in BENCH jsons; rows
+    from telemetry JSONL logs (sniffed per file) ride the same table."""
     rows = []
     for path in paths:
+        if is_telemetry_log(path):
+            rows.extend(telemetry_health_rows(path))
+            continue
         try:
             with open(path) as fh:
                 text = fh.read()

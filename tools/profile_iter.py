@@ -209,6 +209,39 @@ def fused_wave_census(rows=4096, features=12, num_leaves=15, leaf_batch=4):
     return out
 
 
+def census_from_log(path):
+    """Dispatch-wait / host-bookkeeping census replayed from a telemetry
+    JSONL log's ``train.iter`` events (``tpu_telemetry_log``), so the one
+    training artifact answers the census question without re-running
+    training.  Returns the summary blob (``iters`` == 0 when the log holds
+    no iteration events)."""
+    from tools.telemetry_report import load_events
+
+    events, problems = load_events(path)
+    iters = [e for e in events if e["kind"] == "train.iter"]
+    if not iters:
+        return {"path": path, "iters": 0, "skipped_lines": len(problems)}
+    disp = sum(float(e.get("dispatch_wait_s") or 0.0) for e in iters)
+    host = sum(float(e.get("host_s") or 0.0) for e in iters)
+    n = len(iters)
+    return {
+        "path": path,
+        "iters": n,
+        "pack_sizes": sorted({int(e.get("pack_size", 1)) for e in iters}),
+        "mean_wall_s": round((disp + host) / n, 6),
+        "mean_dispatch_wait_s": round(disp / n, 6),
+        "mean_host_s": round(host / n, 6),
+        "dispatch_share": round(disp / (disp + host), 4)
+        if disp + host > 0 else None,
+        # count from train.checkpoint events, the single source both the
+        # per-round AND the pack path emit (pack-path snapshots land at
+        # pack boundaries, after the rounds' train.iter events)
+        "checkpoint_writes": sum(
+            1 for e in events if e["kind"] == "train.checkpoint"),
+        "skipped_lines": len(problems),
+    }
+
+
 def _count_host_syncs(run, warmup):
     """Run ``warmup()`` then ``run()`` with jax.device_get instrumented;
     returns the number of device_get calls ``run`` performed.  Every
@@ -235,6 +268,15 @@ def _count_host_syncs(run, warmup):
 
 
 def main():
+    if len(sys.argv) > 1 and sys.argv[1] == "--from-log":
+        # Census replay from a telemetry JSONL log — no training, no jax.
+        import json as _json
+        for path in sys.argv[2:] or [()]:
+            if not path:
+                print("usage: profile_iter.py --from-log LOG.jsonl ...")
+                return
+            print(_json.dumps(census_from_log(path)))
+        return
     rows = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
     iters = int(sys.argv[2]) if len(sys.argv) > 2 else 3
 

@@ -1,0 +1,175 @@
+"""Replay a telemetry JSONL log (``tpu_telemetry_log=<path>``) into
+per-iteration and per-phase triage tables (docs/OBSERVABILITY.md).
+
+Usage::
+
+    python tools/telemetry_report.py LOG.jsonl [more logs ...]
+
+Three tables per log:
+
+- **iterations** — one row per ``train.iter`` event: wall seconds split
+  into dispatch wait vs host bookkeeping, pack size, checkpoint write
+  duration and the health verdict at that round;
+- **phases** — the span totals the run's ``train.end`` event carries
+  (``train/pack_dispatch``, ``grower/grow``, ``train/eval``, ...), i.e.
+  where the wall clock went by phase;
+- **events** — per-kind counts plus any health trips / rollbacks /
+  checkpoint restores, verbatim.
+
+Unknown schema versions and unparseable lines are reported, not fatal —
+a triage tool must read partial/torn logs.  Plain stdlib; safe anywhere
+the repo checks out.
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import json
+import os
+import sys
+from typing import Dict, List, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+KNOWN_SCHEMAS = (1,)
+
+
+def _fmt_row(cols, widths):
+    return "  ".join(str(c).ljust(w) for c, w in zip(cols, widths))
+
+
+def _table(title, header, rows):
+    print(f"\n== {title} ==")
+    if not rows:
+        print("(none)")
+        return
+    widths = [max(len(str(h)), *(len(str(r[i])) for r in rows))
+              for i, h in enumerate(header)]
+    print(_fmt_row(header, widths))
+    print(_fmt_row(["-" * w for w in widths], widths))
+    for r in rows:
+        print(_fmt_row(r, widths))
+
+
+def load_events(path: str) -> Tuple[List[dict], List[str]]:
+    """``(events, problems)``: every parseable schema-known event line, in
+    file order, plus human-readable notes for anything skipped."""
+    events, problems = [], []
+    with open(path) as fh:
+        for lineno, line in enumerate(fh, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError as e:
+                problems.append(f"line {lineno}: unparseable ({e})")
+                continue
+            if not isinstance(obj, dict) or "kind" not in obj:
+                problems.append(f"line {lineno}: not a telemetry event")
+                continue
+            if obj.get("schema") not in KNOWN_SCHEMAS:
+                problems.append(
+                    f"line {lineno}: unknown schema {obj.get('schema')!r} "
+                    f"(kind={obj.get('kind')!r}; this tool knows "
+                    f"{list(KNOWN_SCHEMAS)})")
+                continue
+            events.append(obj)
+    return events, problems
+
+
+def _f(v, digits=4):
+    return "-" if v is None else f"{float(v):.{digits}f}"
+
+
+def iteration_rows(events: List[dict]) -> List[tuple]:
+    rows = []
+    for e in events:
+        if e["kind"] != "train.iter":
+            continue
+        rows.append((e.get("iteration", "?"), _f(e.get("wall_s")),
+                     _f(e.get("dispatch_wait_s")), _f(e.get("host_s")),
+                     e.get("pack_size", 1), _f(e.get("checkpoint_s")),
+                     e.get("health") or "-"))
+    return rows
+
+
+def phase_rows(events: List[dict]) -> List[tuple]:
+    """Span totals, summed over every ``train.end`` in the log (a file can
+    hold several runs — cv folds, retries), longest first."""
+    totals: Dict[str, float] = collections.defaultdict(float)
+    for e in events:
+        if e["kind"] == "train.end":
+            for name, secs in (e.get("spans") or {}).items():
+                totals[name] += float(secs)
+    return sorted(((n, f"{s:.4f}") for n, s in totals.items()),
+                  key=lambda r: -float(r[1]))
+
+
+def incident_rows(events: List[dict]) -> List[tuple]:
+    rows = []
+    for e in events:
+        if e["kind"] in ("health.trip", "health.overflow", "train.rollback",
+                         "checkpoint.restore", "watchdog.probe"):
+            detail = {k: v for k, v in e.items()
+                      if k not in ("schema", "kind", "ts", "wall", "pid")}
+            rows.append((e["kind"], e.get("iteration", "-"),
+                         json.dumps(detail, default=str)[:100]))
+    return rows
+
+
+def report(path: str) -> int:
+    """Print the triage tables for one log; returns 0 when the log held at
+    least one valid event."""
+    events, problems = load_events(path)
+    print(f"\n#### {path}: {len(events)} events"
+          + (f", {len(problems)} skipped lines" if problems else ""))
+    for p in problems[:8]:
+        print(f"  ! {p}")
+    if not events:
+        return 1
+    counts = collections.Counter(e["kind"] for e in events)
+    starts = [e for e in events if e["kind"] == "train.start"]
+    for s in starts:
+        print(f"  run: {s.get('objective')}/{s.get('boosting')} "
+              f"rows={s.get('rows')} features={s.get('features')} "
+              f"rounds={s.get('num_boost_round')} "
+              f"pack={s.get('pack_size')} (packed={s.get('packed')}"
+              + (f", degrade: {s['pack_degrade_reason']}"
+                 if s.get("pack_degrade_reason") else "") + ")")
+    _table("iterations",
+           ("iter", "wall_s", "dispatch_s", "host_s", "pack", "ckpt_s",
+            "health"), iteration_rows(events))
+    _table("phases (span totals, seconds)", ("span", "seconds"),
+           phase_rows(events))
+    _table("event counts", ("kind", "count"),
+           sorted(counts.items()))
+    inc = incident_rows(events)
+    if inc:
+        _table("incidents", ("kind", "iter", "detail"), inc)
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("logs", nargs="+", help="telemetry JSONL log file(s)")
+    args = ap.parse_args(argv)
+    rc = 0
+    for path in args.logs:
+        if not os.path.exists(path):
+            print(f"{path}: no such file", file=sys.stderr)
+            rc = 1
+            continue
+        rc = max(rc, report(path))
+    return rc
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:
+        # piped into head/less and the reader closed — normal for a
+        # triage tool, not an error
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        sys.exit(0)
