@@ -205,6 +205,28 @@ def _telemetry_block():
         return {"error": f"{e!r}"[:160]}
 
 
+def _memory_block(bst):
+    """The ``detail.memory`` block every BENCH/rung blob carries
+    (ISSUE-10): device HBM watermark (graceful null on CPU fallbacks),
+    the live-buffer census grouped by shape/dtype, the process compile
+    count/seconds, host peak RSS, and XLA's compiled memory plan
+    (temp/generated-code/argument/output bytes) for the rung's grower
+    program — the byte-side twin of ``hlo_cost``, sharing its one AOT
+    compile.  Re-built at every cumulative emit, so the primary blob's
+    census reflects the END of the attempt ladder."""
+    try:
+        from lightgbm_tpu.telemetry.memory import memory_block
+        blk = memory_block()
+    except Exception as e:  # noqa: BLE001 — accounting is garnish on the rate
+        return {"error": f"{e!r}"[:160]}
+    try:
+        from tools.profile_iter import train_step_memory_analysis
+        blk["memory_analysis"] = train_step_memory_analysis(bst)
+    except Exception as e:  # noqa: BLE001
+        blk["memory_analysis"] = {"error": f"{e!r}"[:160]}
+    return blk
+
+
 def _hlo_cost_block(bst):
     """The per-rung HLO cost block (ROADMAP 3b, ISSUE-7 satellite): XLA's
     own cost model (FLOPs / bytes accessed) for the rung's compiled grower
@@ -268,6 +290,7 @@ def run_ltr_rung(rows, iters, platform, jax, features=None, group=None,
         "hlo_cost": _hlo_cost_block(bst),
         "health": _health_block(bst, iters),
         "telemetry": _telemetry_block(),
+        "memory": _memory_block(bst),
     }
 
 
@@ -310,6 +333,7 @@ def run_wide_rung(rows, iters, platform, jax, features=None,
         "hlo_cost": _hlo_cost_block(bst),
         "health": _health_block(bst, iters),
         "telemetry": _telemetry_block(),
+        "memory": _memory_block(bst),
     }
 
 
@@ -350,6 +374,7 @@ def run_goss_rung(rows, iters, platform, jax, features=None,
     blob["hlo_cost"] = _hlo_cost_block(bst)
     blob["health"] = _health_block(bst, iters)
     blob["telemetry"] = _telemetry_block()
+    blob["memory"] = _memory_block(bst)
     return blob
 
 
@@ -387,6 +412,7 @@ def run_fused_rung(rows, iters, platform, jax, features=None,
         "hlo_cost": _hlo_cost_block(bst),
         "health": _health_block(bst, iters),
         "telemetry": _telemetry_block(),
+        "memory": _memory_block(bst),
     }
 
 
@@ -644,6 +670,12 @@ def run_bench(rows, iters):
                 # per-kind event counts, span totals at dispatch
                 # boundaries, registry snapshot.
                 "telemetry": _telemetry_block(),
+                # Memory block (ISSUE-10, telemetry/memory.py): peak HBM
+                # (null on CPU), live-buffer census at this emit (the
+                # last emit = end of the ladder), compile count/seconds,
+                # host peak RSS, and the grower program's compiled
+                # memory plan beside hlo_cost.
+                "memory": _memory_block(bst),
                 # Iteration packing: training dispatches per boosting round
                 # (1.0 = per-round loop; 1/K with K-round packs — the
                 # host-sync elimination the pack path is for).

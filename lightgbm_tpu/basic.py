@@ -307,16 +307,30 @@ class Dataset:
                 cats = [int(c) for c in cfg.categorical_feature.split(",")]
             ref_td = (self.reference.construct(params)
                       if self.reference is not None else None)
-            self._train_data = TrainData.build(
-                self.data, self.label if self.label is not None
-                else np.zeros(self.data.shape[0]), cfg,
-                weight=self.weight, group=self.group,
-                position=self.position,
-                init_score=self.init_score,
-                categorical_features=cats,
-                feature_names=self._feature_names(),
-                reference=ref_td,
-            )
+            # Tracked telemetry span (telemetry/memory.py): dataset
+            # construction is where the binned matrix — usually the
+            # largest single resident buffer — lands on the device, so a
+            # memory.watermark event brackets it when accounting is armed.
+            # Arm from THIS construct's own params first (explicit-params
+            # rule): construction runs before the GBDT constructor or
+            # engine session ever sees the config, so without this the
+            # run's own training set would always bin under mode "off".
+            from .telemetry import span
+            from .telemetry.memory import set_memory_mode
+            if "tpu_telemetry_memory" in cfg.raw_params \
+                    or "telemetry_memory" in cfg.raw_params:
+                set_memory_mode(cfg.tpu_telemetry_memory)
+            with span("data/construct", track_memory=True):
+                self._train_data = TrainData.build(
+                    self.data, self.label if self.label is not None
+                    else np.zeros(self.data.shape[0]), cfg,
+                    weight=self.weight, group=self.group,
+                    position=self.position,
+                    init_score=self.init_score,
+                    categorical_features=cats,
+                    feature_names=self._feature_names(),
+                    reference=ref_td,
+                )
         return self._train_data
 
     def _feature_names(self) -> List[str]:

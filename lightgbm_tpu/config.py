@@ -306,6 +306,14 @@ _PARAMS: List[Tuple[str, Any, Any, Tuple[str, ...], Optional[Tuple[Any, Any]]]] 
     # "<tpu_telemetry_log>.trace", else /tmp/lightgbm_tpu_profile.
     ("tpu_profile_iters", int, 0, (), (0, None)),
     ("tpu_profile_dir", str, "", (), None),
+    # Device-memory accounting (telemetry/memory.py): off (default,
+    # bitwise-inert — pure host-side observation, the lowered-HLO
+    # equality pin covers this knob too) | watermark (tracked spans
+    # snapshot device.memory_stats() bytes-in-use/peak and emit
+    # memory.watermark events + memory.* gauges) | census (watermark
+    # plus a jax.live_arrays() shape/dtype census per tracked span —
+    # O(live buffers) host work per dispatch boundary).
+    ("tpu_telemetry_memory", str, "off", ("telemetry_memory",), None),
 ]
 
 _CANONICAL: Dict[str, Tuple[str, Any, Any, Optional[Tuple[Any, Any]]]] = {}
@@ -352,7 +360,8 @@ def _coerce(name: str, typ: Any, value: Any) -> Any:
                                                       "data_sample_strategy", "tpu_histogram_impl",
                                                       "tpu_hist_comm", "tpu_wave_kernel",
                                                       "tpu_health_policy",
-                                                      "tpu_telemetry") \
+                                                      "tpu_telemetry",
+                                                      "tpu_telemetry_memory") \
             else str(value)
     if typ in ("list_int", "list_float", "list_str"):
         if value is None:

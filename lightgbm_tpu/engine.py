@@ -510,6 +510,10 @@ def train(
                  best_iteration=int(booster.best_iteration),
                  health=(None if sentinel is None
                          else sentinel.verdict()),
+                 # host-side peak RSS (telemetry/memory.py) — also
+                 # published as the memory.host_peak_rss_mb gauge, the
+                 # host half of the run's memory accounting
+                 host_peak_rss_mb=round(telemetry_mod.host_peak_rss_mb(), 1),
                  spans=tel.span_delta())
         tel.close()
     return booster

@@ -23,7 +23,7 @@ import numpy as np
 from ..config import Config
 from ..dataset import TrainData
 from ..metrics import Metric
-from ..telemetry import span
+from ..telemetry import span, watch_compiles
 from ..objectives import ObjectiveFunction, create_objective
 from ..sampling import FeatureSampler, SampleStrategy
 from ..ops.split import SplitConfig
@@ -324,6 +324,15 @@ class GBDT:
         if "tpu_telemetry" in cfg.raw_params:
             from .. import telemetry
             telemetry.arm_from_config(cfg)
+        # Device-memory accounting mode (telemetry/memory.py) — same
+        # explicit-params rule as the master switch above; engine.train
+        # arms unconditionally from its own run's config.  An invalid
+        # value can only arrive explicitly (the default "off" is valid),
+        # so set_memory_mode is the single validator.
+        if "tpu_telemetry_memory" in cfg.raw_params \
+                or "telemetry_memory" in cfg.raw_params:
+            from ..telemetry.memory import set_memory_mode
+            set_memory_mode(cfg.tpu_telemetry_memory)
         # Training-health sentinel (resilience/health.py): with any policy
         # but "off", the iteration/pack programs fold the isfinite/max-abs
         # health vector into their dispatch and the quantized int16-wire
@@ -654,7 +663,11 @@ class GBDT:
                     return new_scores, outs, hv
                 return new_scores, outs
             self._fused_core = fused      # scanned by the pack path
-            self._fused_iter = jax.jit(fused)
+            # watch_compiles (telemetry/spans.py): launches already run
+            # under the train/fused_iter span; the wrapper only notices
+            # executable-cache growth and emits compile.end events.
+            self._fused_iter = watch_compiles(jax.jit(fused),
+                                              "train/fused_iter")
 
     # ------------------------------------------------------------------ helpers
     def _init_scores_array(self, data: TrainData) -> jnp.ndarray:
@@ -731,7 +744,7 @@ class GBDT:
         self._host_cache[k].append(None)
         if not self.valid_bins:
             return
-        with span("train/valid_scores"):
+        with span("train/valid_scores", track_memory=True):
             for i, vbins in enumerate(self.valid_bins):
                 pred = predict_tree_bins_device(
                     _tree_dict(arrays), vbins, self.meta_dev["nan_bins"])
@@ -1074,7 +1087,7 @@ class GBDT:
             nls = jnp.stack([t.num_leaves for t in stacked], axis=1)
             return scores2, stacked, nls, used_stack, health_stack
 
-        fn = jax.jit(packed)
+        fn = watch_compiles(jax.jit(packed), f"train/pack_k{k}")
         self._pack_fns[k] = fn
         return fn
 
@@ -1112,7 +1125,7 @@ class GBDT:
                 self._full_mask, base_fmask, self._goss_key, self._ff_key,
                 self._quant_key, self._split_key,
                 self._cegb_used_dev if self._use_cegb else None)
-        with span("train/pack_dispatch"):
+        with span("train/pack_dispatch", track_memory=True):
             try:
                 scores2, stacked, nls, used_stack, health_stack = \
                     self._pack_fn(k)(*args)
@@ -1426,7 +1439,7 @@ class GBDT:
         (the rebuilt program lives under the same attribute).  Every launch
         runs under a telemetry span named for the program — host-side
         instrumentation at the dispatch boundary only."""
-        with span("train/" + name.lstrip("_")):
+        with span("train/" + name.lstrip("_"), track_memory=True):
             try:
                 return getattr(self, name)(*args, **kw)
             except Exception as e:  # noqa: BLE001 — re-raise if foreign

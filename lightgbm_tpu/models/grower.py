@@ -2902,7 +2902,8 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
     # Host-only instrumentation: the compiled program is bitwise-identical
     # with telemetry on, off, or absent (tests/test_telemetry.py).
     from ..telemetry import instrument
-    grow = instrument(jax.jit(_grow_impl, donate_argnums=()), "grower/grow")
+    grow = instrument(jax.jit(_grow_impl, donate_argnums=()), "grower/grow",
+                      track_memory=True)
     # static dispatch facts, inspectable by tests/tools
     grow.fp_capable = fp_capable
     grow.rs_active = rs_on

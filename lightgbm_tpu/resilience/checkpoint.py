@@ -65,7 +65,11 @@ def save_snapshot(booster, ckpt_dir: str, keep: int = 2) -> str:
 
     from .. import telemetry
     t0 = _time.perf_counter()
-    state = booster._gbdt.capture_train_state()
+    # Tracked span (telemetry/memory.py): the capture's ONE batched
+    # device_get materializes the whole mutated training set on the host
+    # — the memory.watermark event brackets that transfer when armed.
+    with telemetry.span("checkpoint/capture", track_memory=True):
+        state = booster._gbdt.capture_train_state()
     meta = {
         "format": FORMAT_VERSION,
         "iteration": state["iter_"],

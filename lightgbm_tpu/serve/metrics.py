@@ -130,11 +130,17 @@ class ServeMetrics:
         counters (the fields docs/SERVING.md documents).
 
         STABLE SCHEMA: the plan-derived keys (``compiles``,
-        ``plan_cache``) are always present — ``None`` when no plan was
-        passed — so scrapers and the Prometheus renderer see the same
-        metric set every call.  Note ``plan_cache`` counts are
-        PROCESS-GLOBAL: the plan cache is shared by every Predictor and
-        routed ``Booster.predict`` in this process, never per-predictor."""
+        ``plan_bytes``, ``plan_cache``) are always present — ``None``
+        when no plan was passed — so scrapers and the Prometheus renderer
+        see the same metric set every call.  ``plan_bytes`` is THIS
+        plan's resident device bytes (tree pack + bin tables);
+        ``plan_cache`` carries the process-global hit/miss counters plus
+        ``size`` (entries) and ``bytes`` (resident bytes across every
+        cached plan — the byte totals, not just entry counts, are the
+        admission-control input ROADMAP item 1 consumes,
+        docs/SERVING.md).  Note ``plan_cache`` is PROCESS-GLOBAL: the
+        plan cache is shared by every Predictor and routed
+        ``Booster.predict`` in this process, never per-predictor."""
         with self._lock:
             bs = np.asarray(self._batch_sizes, np.float64)
             out = {
@@ -153,6 +159,8 @@ class ServeMetrics:
             }
         out.update(self.latency_quantiles_ms())
         out["compiles"] = None if plan is None else plan.compile_count()
+        out["plan_bytes"] = (None if plan is None
+                             else int(getattr(plan, "plan_bytes", 0)))
         out["plan_cache"] = (None if plan is None
                              else dict(plan_cache_stats()))
         return out
@@ -168,7 +176,7 @@ class ServeMetrics:
             # as NaN instead of vanishing between scrapes
             snap["plan_cache"] = {k: None for k in
                                   ("hits", "misses", "builds", "evictions",
-                                   "size")}
+                                   "size", "bytes")}
         return render_prometheus(snap, prefix=prefix)
 
 

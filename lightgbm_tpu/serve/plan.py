@@ -69,6 +69,12 @@ class PredictPlan:
             for trees in trees_by_class]
         self._nan_bins = jnp.asarray(binned.nan_bins, jnp.int32)
         self.stack_count = 1          # re-stacks would increment (never do)
+        # Resident bytes for this plan (stacked tree pack + bin tables +
+        # NaN routing) — the per-plan half of the serve byte accounting
+        # (docs/SERVING.md): plan-cache admission/eviction by bytes
+        # (ROADMAP item 1) consumes exactly this number.
+        self.plan_bytes = _pytree_bytes(
+            (self._stacked, self._tables, self._nan_bins))
 
         def _from_bits(hi, lo):
             bins = bin_rows_device(self._tables, hi, lo)
@@ -77,8 +83,14 @@ class PredictPlan:
         def _from_bins(bins):
             return forest_scores(self._stacked, bins, self._nan_bins)
 
-        self._predict_bits = jax.jit(_from_bits)
-        self._predict_binned = jax.jit(_from_bins)
+        # watch_compiles (telemetry/spans.py): each new ladder rung's XLA
+        # compile lands as a compile.end event; launches already run
+        # under the predictor's serve/predict span.
+        from ..telemetry import watch_compiles
+        self._predict_bits = watch_compiles(jax.jit(_from_bits),
+                                            "serve/predict_bits")
+        self._predict_binned = watch_compiles(jax.jit(_from_bins),
+                                              "serve/predict_binned")
         self._shapes = set()          # padded (kind, rows) this plan compiled
         self._lock = threading.Lock()
 
@@ -159,6 +171,14 @@ class PredictPlan:
         return len(rungs)
 
 
+def _pytree_bytes(tree) -> int:
+    """Total array bytes across a pytree (stacked packs, table dicts)."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        total += int(getattr(leaf, "nbytes", 0) or 0)
+    return total
+
+
 # ---------------------------------------------------------------- plan cache
 _CACHE: "OrderedDict[tuple, PredictPlan]" = OrderedDict()
 _CACHE_LOCK = threading.Lock()
@@ -181,11 +201,15 @@ def _stale_locked(key, plan) -> bool:
             int(getattr(model, "_pred_version", 0))) != key[3:6]
 
 
-def _sweep_dead_locked() -> None:
-    """Drop stale entries (caller holds _CACHE_LOCK)."""
-    for k in [k for k, p in _CACHE.items() if _stale_locked(k, p)]:
+def _sweep_dead_locked() -> int:
+    """Drop stale entries (caller holds _CACHE_LOCK); returns how many
+    were removed, so hit-path callers republish the byte gauges only
+    when something actually changed."""
+    stale = [k for k, p in _CACHE.items() if _stale_locked(k, p)]
+    for k in stale:
         del _CACHE[k]
         _STATS["evictions"] += 1
+    return len(stale)
 
 
 def _resolve_slice(model, num_iteration: Optional[int],
@@ -228,8 +252,13 @@ def plan_for_model(model, num_iteration: Optional[int] = None,
                 _STATS["hits"] += 1
                 _CACHE.move_to_end(key)
                 # sweep on hits too: a steady stream of cache hits must
-                # not pin dead models' tree packs until the next build
-                _sweep_dead_locked()
+                # not pin dead models' tree packs until the next build —
+                # and the byte gauges must follow an actual eviction, or
+                # a scraper sees evicted packs' bytes forever.  A clean
+                # hit (the common case) publishes nothing: the serve hot
+                # path pays no registry work and no O(cache) byte sum.
+                if _sweep_dead_locked():
+                    _publish_bytes_locked()
                 return plan
             ev = _INFLIGHT.get(key)
             if ev is None:
@@ -255,13 +284,37 @@ def plan_for_model(model, num_iteration: Optional[int] = None,
                 while len(_CACHE) > _CACHE_CAP:
                     _CACHE.popitem(last=False)
                     _STATS["evictions"] += 1
+            _publish_bytes_locked()
             _INFLIGHT.pop(key).set()
     return plan
 
 
+def _cache_bytes_locked() -> int:
+    return sum(p.plan_bytes for p in _CACHE.values())
+
+
+def _publish_bytes_locked() -> None:
+    """Byte gauges (docs/OBSERVABILITY.md serve section): the
+    most-recently-used cached plan's resident bytes
+    (``serve.plan_bytes``, 0 when the cache is empty — an evicted pack's
+    bytes never linger in the gauge) and the cache-wide total
+    (``serve.plan_cache_bytes``) — the admission-control input ROADMAP
+    item 1's eviction-by-bytes will consume."""
+    from ..telemetry import registry
+    reg = registry()
+    mru = next(reversed(_CACHE)) if _CACHE else None
+    reg.gauge("serve.plan_bytes").set(
+        _CACHE[mru].plan_bytes if mru is not None else 0)
+    reg.gauge("serve.plan_cache_bytes").set(_cache_bytes_locked())
+
+
 def cache_stats() -> Dict[str, int]:
+    """Hit/miss/build/eviction counters plus the live cache footprint:
+    ``size`` (entries) AND ``bytes`` (resident device bytes across every
+    cached plan — entry counts alone cannot drive byte-budget admission
+    control, docs/SERVING.md)."""
     with _CACHE_LOCK:
-        return dict(_STATS, size=len(_CACHE))
+        return dict(_STATS, size=len(_CACHE), bytes=_cache_bytes_locked())
 
 
 def clear_plan_cache() -> None:
@@ -269,3 +322,4 @@ def clear_plan_cache() -> None:
         _CACHE.clear()
         for k in ("hits", "misses", "builds", "evictions"):
             _STATS[k] = 0
+        _publish_bytes_locked()

@@ -28,17 +28,26 @@ from typing import Dict
 
 from .events import (SCHEMA_VERSION, JsonlSink, active_sink, close_log,
                      configure_log, emit)
+from .memory import (MEMORY_MODES, MemoryTracker, arm_memory_from_config,
+                     device_memory_stats, host_peak_rss_mb,
+                     live_buffer_census, memory_analysis_summary,
+                     memory_block, memory_mode, note_compile,
+                     set_memory_mode)
 from .prometheus import render_prometheus
 from .registry import (Counter, Gauge, Histogram, MetricsRegistry, registry)
 from .spans import (enabled, instrument, reset_spans, set_enabled, span,
-                    span_totals)
+                    span_totals, watch_compiles)
 
 __all__ = [
-    "SCHEMA_VERSION", "Counter", "Gauge", "Histogram", "JsonlSink",
-    "MetricsRegistry", "TrainTelemetry", "active_sink", "arm_from_config",
-    "close_log", "configure_log", "emit", "enabled", "instrument",
-    "registry", "render_prometheus", "reset_spans", "set_enabled", "span",
-    "span_totals", "telemetry_block", "train_session",
+    "MEMORY_MODES", "SCHEMA_VERSION", "Counter", "Gauge", "Histogram",
+    "JsonlSink", "MemoryTracker", "MetricsRegistry", "TrainTelemetry",
+    "active_sink", "arm_from_config", "arm_memory_from_config",
+    "close_log", "configure_log", "device_memory_stats", "emit", "enabled",
+    "host_peak_rss_mb", "instrument", "live_buffer_census",
+    "memory_analysis_summary", "memory_block", "memory_mode",
+    "note_compile", "registry", "render_prometheus", "reset_spans",
+    "set_enabled", "set_memory_mode", "span", "span_totals",
+    "telemetry_block", "train_session", "watch_compiles",
 ]
 
 
@@ -76,6 +85,9 @@ class TrainTelemetry:
 
     def __init__(self, cfg):
         self.enabled = arm_from_config(cfg)
+        # Device-memory accounting mode (telemetry/memory.py): armed per
+        # run from tpu_telemetry_memory, exactly like the master switch.
+        self.memory_mode = arm_memory_from_config(cfg)
         self.log_path = getattr(cfg, "tpu_telemetry_log", "") or None
         self.profile_iters = int(getattr(cfg, "tpu_profile_iters", 0) or 0)
         self.profile_dir = getattr(cfg, "tpu_profile_dir", "") or (

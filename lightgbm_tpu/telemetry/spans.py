@@ -56,15 +56,23 @@ def _stack():
 class span:
     """``with span("train/grow"): ...`` — host timer + profiler
     annotation + registry histogram, one context manager.  Re-entrant and
-    thread-safe (per-thread name stacks; the timer is lock-guarded)."""
+    thread-safe (per-thread name stacks; the timer is lock-guarded).
 
-    __slots__ = ("name", "_path", "_t0", "_trace")
+    ``track_memory=True`` additionally records the span's device-memory
+    delta + watermark (telemetry/memory.py) when
+    ``tpu_telemetry_memory`` is armed — a no-op (one mode check) when it
+    is ``off``, host-side observation either way."""
 
-    def __init__(self, name: str):
+    __slots__ = ("name", "_path", "_t0", "_trace", "_track_memory",
+                 "_mem_token")
+
+    def __init__(self, name: str, track_memory: bool = False):
         self.name = name
         self._path = None
         self._t0 = 0.0
         self._trace = None
+        self._track_memory = track_memory
+        self._mem_token = None
 
     def __enter__(self):
         if not _enabled:
@@ -78,6 +86,9 @@ class span:
             self._trace.__enter__()
         except Exception:  # noqa: BLE001 — profiler is garnish on the timer
             self._trace = None
+        if self._track_memory:
+            from . import memory
+            self._mem_token = memory.span_begin()
         self._t0 = time.perf_counter()
         return self
 
@@ -93,28 +104,70 @@ class span:
         stack = _stack()
         if stack and stack[-1] == self._path:
             stack.pop()
+        if self._mem_token is not None:
+            from . import memory
+            try:
+                memory.span_end(self._path, self._mem_token)
+            except Exception:  # noqa: BLE001 — accounting must never
+                pass           # break training or mask the real exception
+            self._mem_token = None
         _span_timer.add(self._path, dt)
         registry().histogram(f"span.{self._path}").observe(dt)
         self._path = None
         return False
 
 
-def instrument(fn, name: str):
+def instrument(fn, name: str, track_memory: bool = False):
     """Wrap a compiled callable so every launch runs under ``span(name)``,
     delegating attribute access (``.lower``, ``.raw``, the grower's static
     capability facts) to the wrapped function — callers and the dispatch
-    census see the same surface."""
-    return _Instrumented(fn, name)
+    census see the same surface.  The wrapper is ALSO the compile seam:
+    a call that grows the jit executable cache emits a ``compile.end``
+    event (telemetry/memory.py note_compile) with the call's wall seconds
+    — a first call to a new shape is dominated by the XLA compile."""
+    return _Instrumented(fn, name, track_memory=track_memory)
+
+
+def watch_compiles(fn, name: str):
+    """Compile telemetry WITHOUT a span: for jitted programs whose
+    launches already run under a caller-side span (the fused iteration
+    under ``train/fused_iter``, the pack program under
+    ``train/pack_dispatch``) — wrapping them in ``instrument`` would
+    double-count the span."""
+    return _Instrumented(fn, name, use_span=False)
+
+
+def _compile_cache_size(fn):
+    """jit executable-cache size, or None where jax doesn't expose it."""
+    try:
+        return int(fn._cache_size())
+    except Exception:  # noqa: BLE001 — older jax / non-jit callables
+        return None
 
 
 class _Instrumented:
-    def __init__(self, fn, name: str):
+    def __init__(self, fn, name: str, track_memory: bool = False,
+                 use_span: bool = True):
         self._fn = fn
         self._span_name = name
+        self._track_memory = track_memory
+        self._use_span = use_span
 
     def __call__(self, *args, **kwargs):
-        with span(self._span_name):
+        if not _enabled:
             return self._fn(*args, **kwargs)
+        n0 = _compile_cache_size(self._fn)
+        t0 = time.perf_counter()
+        if self._use_span:
+            with span(self._span_name, track_memory=self._track_memory):
+                out = self._fn(*args, **kwargs)
+        else:
+            out = self._fn(*args, **kwargs)
+        if n0 is not None and _compile_cache_size(self._fn) > n0:
+            from . import memory
+            memory.note_compile(self._span_name,
+                                time.perf_counter() - t0)
+        return out
 
     def __getattr__(self, item):
         return getattr(self._fn, item)

@@ -41,6 +41,22 @@ def _assert_hlo_cost(blob):
     assert all(d["seconds"] >= 0.0 and d["count"] >= 1
                for d in t["spans"].values()), t["spans"]
     json.dumps(t)   # JSON-serializable end to end (it rides the blob)
+    # ISSUE-10: every rung blob also carries the schema-valid
+    # detail.memory block — device watermark (None on CPU), live-buffer
+    # census, compile count/seconds, host RSS, and the grower program's
+    # compiled memory plan beside hlo_cost.
+    m = blob["memory"]
+    assert "error" not in m, m
+    assert set(m) >= {"mode", "device", "live_buffers", "compile",
+                      "host_peak_rss_mb", "memory_analysis"}, sorted(m)
+    lb = m["live_buffers"]
+    assert lb["total_bytes"] > 0 and lb["total_arrays"] > 0, lb
+    assert lb["groups"] and lb["groups"][0]["bytes"] >= lb["groups"][-1]["bytes"]
+    assert m["compile"]["count"] >= 0 and m["compile"]["seconds"] >= 0.0
+    assert m["host_peak_rss_mb"] > 0
+    ma = m["memory_analysis"]
+    assert "error" not in ma, ma
+    json.dumps(m)   # rides the blob too
 
 
 def test_ltr_rung_blob():

@@ -91,38 +91,21 @@ def test_sparse_ingestion_memory_bounded():
     construct call against a same-process baseline taken right before it —
     an absolute bound flaked under concurrent test processes (allocator /
     import-baseline noise moved the ambient floor); the delta is invariant
-    to whatever the baseline happens to be (ISSUE-5 satellite)."""
+    to whatever the baseline happens to be (ISSUE-5 satellite).  The
+    watermark plumbing is MemoryTracker's (telemetry/memory.py, ISSUE-10)
+    — this test asserts on the tracker's host-RSS watermark instead of
+    re-implementing the clear_refs bookkeeping it used to duplicate."""
     pytest.importorskip("scipy.sparse")
     import os
     import subprocess
     import sys
 
     code = r"""
-import resource, sys
+import sys
 import numpy as np
 import scipy.sparse as sp
 import lightgbm_tpu as lgb
-
-# Reset the kernel's peak-RSS watermark (clear_refs "5") so VmHWM tracks
-# only what happens AFTER the baseline point; where clear_refs is
-# unavailable fall back to ru_maxrss, whose pre/post difference still
-# catches any allocation pushing past the prior lifetime peak (the 1.6 GB
-# dense copy always does).
-def _reset_peak():
-    try:
-        with open("/proc/self/clear_refs", "w") as fh:
-            fh.write("5")
-        return True
-    except OSError:
-        return False
-
-def _peak_mb(use_hwm):
-    if use_hwm:
-        with open("/proc/self/status") as fh:
-            for line in fh:
-                if line.startswith("VmHWM:"):
-                    return int(line.split()[1]) / 1024
-    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024
+from lightgbm_tpu.telemetry.memory import MemoryTracker
 
 n, f, nnz_per_col = 100_000, 2000, 1000
 rng = np.random.RandomState(0)
@@ -137,12 +120,17 @@ y = (np.asarray(X[:, 0].todense()).ravel() > 0).astype(float)
 ds = lgb.Dataset(X, label=y)
 
 # Same-process baseline: imports done, data built, nothing constructed.
-_hwm_ok = _reset_peak()
-base_mb = _peak_mb(_hwm_ok)
+# reset_host_peak resets the kernel VmHWM watermark (clear_refs "5") so
+# the post-construct read covers only the construct; where /proc is
+# unavailable the ru_maxrss fallback's pre/post difference still catches
+# any allocation pushing past the prior lifetime peak (the 1.6 GB dense
+# copy always does).
+_hwm_ok = MemoryTracker.reset_host_peak()
+base_mb = MemoryTracker.host_peak_rss_mb(use_hwm=_hwm_ok)
 
 ds.construct({"objective": "binary", "verbosity": -1,
               "enable_bundle": False})
-delta_mb = _peak_mb(_hwm_ok) - base_mb
+delta_mb = MemoryTracker.host_peak_rss_mb(use_hwm=_hwm_ok) - base_mb
 print("BASE_MB", base_mb, "DELTA_MB", delta_mb,
       "(VmHWM)" if _hwm_ok else "(ru_maxrss)")
 # Legit construct cost: bins (100k x 2000 uint8) = 200 MB plus per-column

@@ -170,6 +170,23 @@ _DESCRIPTIONS = {
         "destination for the tpu_profile_iters trace; '' derives "
         "\"<tpu_telemetry_log>.trace\" when a telemetry log is set, else "
         "/tmp/lightgbm_tpu_profile"),
+    "tpu_telemetry_memory": (
+        "device-memory accounting (telemetry/memory.py, "
+        "docs/OBSERVABILITY.md memory section): off (default) is "
+        "bitwise-inert — accounting is host-side observation at span "
+        "boundaries, never traced into a device program, and the "
+        "lowered-HLO equality pin covers this knob "
+        "(tests/test_memory_telemetry.py); watermark makes every "
+        "tracked span (fused_iter / pack_dispatch / valid_scores / "
+        "grower grow / dataset construct / checkpoint capture) snapshot "
+        "device.memory_stats() — bytes_in_use / peak_bytes_in_use, "
+        "gracefully null on CPU backends — emitting memory.watermark "
+        "events and memory.* gauges; census additionally walks "
+        "jax.live_arrays() grouped by shape/dtype with byte totals "
+        "(O(live buffers) host work per tracked span — triage runs, "
+        "not steady-state serving).  Replay with "
+        "tools/telemetry_report.py --memory; every BENCH blob carries "
+        "the detail.memory block tools/bench_compare.py gates on"),
 }
 
 

@@ -133,17 +133,18 @@ def nonfused_dispatch_census(rows=8192, iters=4, num_leaves=31,
     return out
 
 
-def train_step_hlo_cost(bst):
-    """XLA's own cost model for the booster's compiled grower program (the
-    train step's dominant dispatch): ``compiled.cost_analysis()`` FLOPs /
-    bytes-accessed, AOT-lowered on whatever backend is live — the
-    platform-independent compile-time cost number every kernel PR lands
-    with even when the TPU probe verdict is not live (ROADMAP 3b; the
-    ``detail.hlo_cost`` block in every BENCH json)."""
+def _train_step_compiled(bst):
+    """AOT-compile the booster's grower program (the train step's dominant
+    dispatch) and return the compiled object — memoized per GBDT so the
+    cost-analysis and memory-analysis blocks in one bench blob share ONE
+    compile instead of paying it twice."""
     import jax  # noqa: F401 — backend must be up for lower()
     import jax.numpy as jnp
 
     g = bst._gbdt
+    cached = getattr(g, "_profile_train_step_compiled", None)
+    if cached is not None:
+        return cached
     n = g.train_data.num_data
     f = g.train_data.num_features
     meta = g.meta_dev
@@ -154,7 +155,26 @@ def train_step_hlo_cost(bst):
     if g._fg_dev is not None:
         # EFB: the grower needs the bundle maps (positional tail)
         args += [None, None, None, None, g._fg_dev, g._fo_dev]
-    cost = g.grow.lower(*args).compile().cost_analysis()
+    t0 = time.perf_counter()
+    compiled = g.grow.lower(*args).compile()
+    # This AOT path is the one caller holding the compiled object, so its
+    # compile.end event carries the memory_analysis byte summary the jit
+    # seam cannot produce (telemetry/memory.py note_compile).
+    from lightgbm_tpu.telemetry.memory import note_compile
+    note_compile("profile/train_step", time.perf_counter() - t0,
+                 compiled=compiled)
+    g._profile_train_step_compiled = compiled
+    return compiled
+
+
+def train_step_hlo_cost(bst):
+    """XLA's own cost model for the booster's compiled grower program (the
+    train step's dominant dispatch): ``compiled.cost_analysis()`` FLOPs /
+    bytes-accessed, AOT-lowered on whatever backend is live — the
+    platform-independent compile-time cost number every kernel PR lands
+    with even when the TPU probe verdict is not live (ROADMAP 3b; the
+    ``detail.hlo_cost`` block in every BENCH json)."""
+    cost = _train_step_compiled(bst).cost_analysis()
     if isinstance(cost, list):
         cost = cost[0]
     out = {}
@@ -164,6 +184,19 @@ def train_step_hlo_cost(bst):
         v = cost.get(k_in)
         if v is not None:
             out[k_out] = float(v)
+    return out
+
+
+def train_step_memory_analysis(bst):
+    """XLA's compiled memory plan for the same grower program
+    (``compiled.memory_analysis()``): temp / generated-code / argument /
+    output / donated-alias bytes — the compile-time half of the
+    ``detail.memory`` block (ISSUE-10), sharing :func:`_train_step_compiled`'s
+    one AOT compile with the cost block above."""
+    from lightgbm_tpu.telemetry.memory import memory_analysis_summary
+    out = memory_analysis_summary(_train_step_compiled(bst))
+    if out is None:
+        return {"unavailable": True}
     return out
 
 

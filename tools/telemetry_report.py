@@ -16,6 +16,15 @@ Three tables per log:
 - **events** — per-kind counts plus any health trips / rollbacks /
   checkpoint restores, verbatim.
 
+``--memory`` adds two more tables replayed from the same artifact
+(ISSUE-10, ``tpu_telemetry_memory``):
+
+- **memory watermarks** — ``memory.watermark`` events aggregated per
+  span: peak HBM / live-buffer bytes high-water marks and the largest
+  single-span delta, so "where did the bytes go" reads per phase;
+- **compiles** — ``compile.end`` events per program label: count, total
+  and max compile seconds.
+
 Unknown schema versions and unparseable lines are reported, not fatal —
 a triage tool must read partial/torn logs.  Plain stdlib; safe anywhere
 the repo checks out.
@@ -119,7 +128,48 @@ def incident_rows(events: List[dict]) -> List[tuple]:
     return rows
 
 
-def report(path: str) -> int:
+def _mb(v) -> str:
+    return "-" if v is None else f"{float(v) / 2**20:.2f}"
+
+
+def memory_rows(events: List[dict]) -> List[tuple]:
+    """Per-span aggregation of ``memory.watermark`` events: event count,
+    max device peak / bytes-in-use, max live-buffer bytes, and the
+    largest single-span HBM delta (all MB; '-' where the backend reported
+    no stats — the CPU graceful-None path)."""
+    per: Dict[str, Dict[str, object]] = {}
+    for e in events:
+        if e["kind"] != "memory.watermark":
+            continue
+        agg = per.setdefault(e.get("span", "?"),
+                             {"n": 0, "peak": None, "in_use": None,
+                              "live": None, "delta": None})
+        agg["n"] += 1
+        for field, key in (("peak_bytes", "peak"),
+                           ("bytes_in_use", "in_use"),
+                           ("live_bytes", "live"),
+                           ("delta_bytes", "delta")):
+            v = e.get(field)
+            if v is None:
+                continue
+            cur = agg[key]
+            agg[key] = v if cur is None else max(cur, v)
+    return [(span, a["n"], _mb(a["peak"]), _mb(a["in_use"]),
+             _mb(a["live"]), _mb(a["delta"]))
+            for span, a in sorted(per.items())]
+
+
+def compile_rows(events: List[dict]) -> List[tuple]:
+    """Per-label aggregation of ``compile.end`` events."""
+    per: Dict[str, List[float]] = collections.defaultdict(list)
+    for e in events:
+        if e["kind"] == "compile.end":
+            per[e.get("label", "?")].append(float(e.get("seconds", 0.0)))
+    return [(label, len(secs), f"{sum(secs):.4f}", f"{max(secs):.4f}")
+            for label, secs in sorted(per.items())]
+
+
+def report(path: str, memory: bool = False) -> int:
     """Print the triage tables for one log; returns 0 when the log held at
     least one valid event."""
     events, problems = load_events(path)
@@ -148,12 +198,21 @@ def report(path: str) -> int:
     inc = incident_rows(events)
     if inc:
         _table("incidents", ("kind", "iter", "detail"), inc)
+    if memory:
+        _table("memory watermarks (MB, per span)",
+               ("span", "events", "peak_hbm", "hbm_in_use", "live_bufs",
+                "max_delta"), memory_rows(events))
+        _table("compiles", ("label", "count", "total_s", "max_s"),
+               compile_rows(events))
     return 0
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("logs", nargs="+", help="telemetry JSONL log file(s)")
+    ap.add_argument("--memory", action="store_true",
+                    help="add the per-span memory-watermark and "
+                         "per-label compile tables (ISSUE-10)")
     args = ap.parse_args(argv)
     rc = 0
     for path in args.logs:
@@ -161,7 +220,7 @@ def main(argv=None) -> int:
             print(f"{path}: no such file", file=sys.stderr)
             rc = 1
             continue
-        rc = max(rc, report(path))
+        rc = max(rc, report(path, memory=args.memory))
     return rc
 
 
