@@ -81,6 +81,15 @@ GOSS_ITERS = int(os.environ.get("BENCH_GOSS_ITERS", 15))
 # (a correctness vehicle, not a speed number; the blob says so).
 FUSED_CHECK = os.environ.get("BENCH_FUSED", "1") == "1"
 FUSED_ITERS = int(os.environ.get("BENCH_FUSED_ITERS", 12))
+# Quantized-traversal serving rung (ISSUE-12): the int8 serving pack +
+# fused Pallas traversal + AOT restart simulation, emitting
+# detail.serve_fused beside the training rungs — warm QPS, pack shrink
+# ratio, fp32-parity gap vs its bound, and the zero-cold-start restart
+# compile count.  Interpret-mode kernel on non-TPU platforms (the blob
+# says so).
+SERVE_FUSED_CHECK = os.environ.get("BENCH_SERVE_FUSED", "1") == "1"
+SERVE_FUSED_ITERS = int(os.environ.get("BENCH_SERVE_FUSED_ITERS", 12))
+SERVE_FUSED_CALLS = int(os.environ.get("BENCH_SERVE_FUSED_CALLS", 20))
 
 
 def _pack_eff(iters, pack):
@@ -416,6 +425,88 @@ def run_fused_rung(rows, iters, platform, jax, features=None,
     }
 
 
+def run_serve_fused_rung(rows, iters, platform, jax, features=None,
+                         num_leaves=31, calls=None, max_batch=1024):
+    """Quantized-traversal serving rung (ISSUE-12): trains a small model,
+    serves it through the int8 quantized pack with the fused Pallas
+    traversal (interpret mode off-TPU — correctness vehicle, the blob
+    says so), and reports warm QPS / p99 / pack shrink / fp32 parity /
+    the zero-cold-start restart compile count.  The fused-vs-unfused
+    integer identity is asserted IN the rung — a blob that publishes a
+    QPS from a kernel that diverged would be worse than no blob."""
+    import tempfile
+
+    import numpy as np
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu import serve
+    from tools.serve_bench import restart_sim, run_request_stream
+
+    features = features or FEATURES
+    calls = calls or SERVE_FUSED_CALLS
+    X, y = make_higgs_like(rows, features)
+    bst = lgb.train({"objective": "binary", "num_leaves": num_leaves,
+                     "learning_rate": 0.1, "max_bin": 255,
+                     "metric": "none", "verbosity": -1},
+                    lgb.Dataset(X, label=y), iters)
+    pred_fp = serve.Predictor(bst, raw_score=True, quantize="off")
+    pred_q = serve.Predictor(bst, raw_score=True, quantize="int8",
+                             traverse="fused")
+    sample = X[:min(rows, 4096)]
+    ref = pred_fp.predict(sample)
+    got = pred_q.predict(sample)
+    unfused = serve.Predictor(bst, raw_score=True, quantize="int8",
+                              traverse="unfused").predict(sample)
+    if not np.array_equal(got, unfused):
+        raise RuntimeError("fused traversal diverged from unfused "
+                           "(integer identity broken)")
+    bound = pred_q.plan.quantize_error_bound()
+    parity_err = float(np.abs(got - ref).max())
+    pred_q.warmup(max_batch)
+    elapsed, served = run_request_stream(pred_q, X, calls, max_batch)
+    cache_dir = tempfile.mkdtemp(prefix="lgbm_bench_serve_aot_")
+    try:
+        restart = restart_sim(bst, serve, cache_dir, max_batch, "int8")
+    except Exception as e:  # noqa: BLE001 — restart sim is garnish
+        restart = {"error": f"{e!r}"[:200]}
+    finally:
+        import shutil
+        shutil.rmtree(cache_dir, ignore_errors=True)
+    snap = pred_q.metrics_snapshot()
+    fp_plan_bytes = int(pred_fp.plan.plan_bytes)
+    fp_pack_bytes = int(pred_fp.plan.pack_bytes)
+    q_pack_bytes = int(pred_q.plan.pack_bytes)
+    # The rung's plans (device-resident packs) must not stay live past
+    # it: later rungs/tests census the process-wide buffer set.  A
+    # PredictPlan is a reference CYCLE (its jitted closures capture the
+    # plan), so clearing the cache alone leaves the packs to linger as
+    # uncollected garbage until a gen-2 GC — collect deterministically.
+    import gc
+    pred_fp = pred_q = unfused = None
+    serve.clear_plan_cache()
+    gc.collect()
+    return {
+        "rows": rows, "features": features, "iters": iters,
+        "num_leaves": num_leaves, "platform": platform,
+        "quantize": snap["quantize"], "traverse": snap["traverse"],
+        "interpret_mode": platform != "tpu",
+        "warm_qps": round(calls / elapsed, 2),
+        "warm_rows_per_sec": round(served / elapsed, 1),
+        "p50_ms": snap["p50_ms"], "p99_ms": snap["p99_ms"],
+        "compiles": snap["compiles"],
+        "plan_bytes": snap["plan_bytes"],
+        "plan_bytes_fp32": fp_plan_bytes,
+        "plan_shrink": round(fp_plan_bytes
+                             / max(snap["plan_bytes"], 1), 3),
+        "pack_shrink": round(fp_pack_bytes / max(q_pack_bytes, 1), 3),
+        "fused_bitwise_unfused": True,
+        "parity_err": parity_err,
+        "parity_bound": bound,
+        "parity_ok": parity_err <= bound + 1e-12,
+        "restart": restart,
+    }
+
+
 def _cache_path(name):
     """Retry attempts (the wedge ladder) re-run the whole measurement in
     fresh child processes; caching the synthetic data and the binned
@@ -637,7 +728,8 @@ def run_bench(rows, iters):
     # the cumulative re-emits too.
 
     def emit(quant_rate, predict_stats=None, ltr_stats=None,
-             wide_stats=None, goss_stats=None, fused_stats=None):
+             wide_stats=None, goss_stats=None, fused_stats=None,
+             serve_fused_stats=None):
         print(json.dumps({
             "metric": "binary_255leaves_row_iters_per_sec",
             "value": round(row_iters_per_sec, 1),
@@ -699,6 +791,9 @@ def run_bench(rows, iters):
                 # Quantized-fused rung (ISSUE-7): tpu_wave_kernel=fused on
                 # the int8 wire — one pallas dispatch per wave.
                 "fused_wave": fused_stats,
+                # Quantized-traversal serving rung (ISSUE-12): int8 pack +
+                # fused Pallas traversal + AOT restart — the serving twin.
+                "serve_fused": serve_fused_stats,
                 "reference": "LightGBM CPU 16t Higgs 10.5Mx28 500it in "
                              "130.094s (docs/Experiments.rst:113)",
             },
@@ -723,6 +818,7 @@ def run_bench(rows, iters):
     # salvages the LAST metric line).  Row/iter budgets derive from the
     # primary budget, so the CPU fallback shrinks them automatically.
     ltr_stats = wide_stats = goss_stats = fused_stats = None
+    serve_fused_stats = None
     if LTR_CHECK:
         try:
             ltr_stats = run_ltr_rung(
@@ -759,6 +855,15 @@ def run_bench(rows, iters):
             fused_stats = {"error": f"{e!r}"[:200]}
         emit(None, predict_stats, ltr_stats, wide_stats, goss_stats,
              fused_stats)
+    if SERVE_FUSED_CHECK:
+        try:
+            serve_fused_stats = run_serve_fused_rung(
+                max(min(rows // 16, 65536), 4096),
+                max(min(SERVE_FUSED_ITERS, iters), 2), platform, jax)
+        except Exception as e:  # noqa: BLE001
+            serve_fused_stats = {"error": f"{e!r}"[:200]}
+        emit(None, predict_stats, ltr_stats, wide_stats, goss_stats,
+             fused_stats, serve_fused_stats)
 
     quant_rate = None
     if QUANT_CHECK and not QUANTIZED:
@@ -772,7 +877,7 @@ def run_bench(rows, iters):
             quant_rate = f"failed: {e!r}"[:200]
     if quant_rate is not None:
         emit(quant_rate, predict_stats, ltr_stats, wide_stats, goss_stats,
-             fused_stats)
+             fused_stats, serve_fused_stats)
 
 
 def _scan_json(stdout):

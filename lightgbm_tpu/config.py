@@ -242,6 +242,39 @@ _PARAMS: List[Tuple[str, Any, Any, Tuple[str, ...], Optional[Tuple[Any, Any]]]] 
     # device.  The LIGHTGBM_TPU_NATIVE_PREDICT_MAX_ROWS env var, where
     # set, overrides this knob.
     ("tpu_native_predict_max_rows", int, 262144, (), (0, None)),
+    # Quantized serving packs (serve/plan.py + models/tree.py, ISSUE-12):
+    # int16/int8 leaf-value quanta + narrow node arrays + bit-packed
+    # categorical masks — ~4x smaller device-resident packs (more tenants
+    # per chip; serve.plan_bytes shrinks accordingly).  Traversal decisions
+    # stay EXACT (bins and thresholds remain integers through the bit-key
+    # transform); only the leaf values quantize, with per-class scale, so
+    # raw scores differ from fp32 by at most num_trees * scale / 2
+    # (PredictPlan.quantize_error_bound; parity pinned in
+    # tests/test_serve_quantize.py).  off = fp32 packs (the bitwise-vs-
+    # Booster.predict default); models whose shape exceeds the narrow
+    # encodings (num_leaves/bins/features > 32767) degrade to off with a
+    # warning.
+    ("tpu_serve_quantize", str, "off", (), None),  # off|int16|int8
+    # Serving traversal kernel (ops/pallas_traverse.py): fused keeps the
+    # whole quantized tree pack VMEM-resident and pipelines row blocks
+    # through the pallas grid — one streamed pass over binned rows instead
+    # of per-depth XLA gathers.  Integer accumulation makes fused
+    # bitwise-identical to unfused unconditionally (the quantized-pack
+    # twin of tpu_wave_kernel's identity story).  auto = fused on TPU
+    # when a quantized pack is active and the VMEM fit gate passes;
+    # fused = force (interpret mode on CPU — the tier-1 coverage vehicle,
+    # slow; requires tpu_serve_quantize != off, else degrades with a
+    # warning); unfused = always the XLA while-loop walk.
+    ("tpu_traverse_kernel", str, "auto", (), None),  # auto|fused|unfused
+    # Persistent AOT compile cache for serving programs
+    # (serve/compile_cache.py): directory holding serialized compiled
+    # executables keyed by plan identity + padded batch shape + jax/jaxlib
+    # version + backend, so a process restart or hot model swap never
+    # re-pays the predict compiles (zero cold-start).  "" disables; the
+    # LIGHTGBM_TPU_SERVE_CACHE_DIR env var, where set, overrides.
+    # Corrupt or version-stale entries are detected (checksummed frames),
+    # warned about and rebuilt.
+    ("tpu_serve_compile_cache", str, "", ("serve_compile_cache",), None),
     # ---- Resilience / fault tolerance (docs/ROBUSTNESS.md) ----
     # Atomic training snapshots (resilience/checkpoint.py) every N
     # committed boosting rounds, emitted at iter-pack commit boundaries;
@@ -359,6 +392,8 @@ def _coerce(name: str, typ: Any, value: Any) -> Any:
                                                       "device_type", "monotone_constraints_method",
                                                       "data_sample_strategy", "tpu_histogram_impl",
                                                       "tpu_hist_comm", "tpu_wave_kernel",
+                                                      "tpu_serve_quantize",
+                                                      "tpu_traverse_kernel",
                                                       "tpu_health_policy",
                                                       "tpu_telemetry",
                                                       "tpu_telemetry_memory") \

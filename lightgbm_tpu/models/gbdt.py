@@ -1680,8 +1680,14 @@ class GBDT:
             # Device path -> compiled serve plan: the stacked tree pack and
             # binning tables are built once and cached (PredictPlan), so
             # repeat predicts skip re-stacking, re-upload AND host binning.
+            # quantize is pinned OFF here: the training-API predict must
+            # stay exact fp32 regardless of tpu_serve_quantize — the knob
+            # governs serve.Predictor packs, and routing it through this
+            # path would make Booster.predict's answers depend on batch
+            # size (native cutoff) and knob state (docs/SERVING.md).
             from ..serve import plan_for_model
-            plan = plan_for_model(self, num_iteration, start_iteration)
+            plan = plan_for_model(self, num_iteration, start_iteration,
+                                  quantize="off")
             if plan is not None:
                 if _is_sparse(X):
                     raw = plan.raw_scores_binned(

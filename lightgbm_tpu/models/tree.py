@@ -264,3 +264,193 @@ def forest_scores(stacked_by_class, bins: jnp.ndarray,
     cols = [jnp.zeros(bins.shape[0], jnp.float32) if s is None
             else _ensemble_sum(s, bins, nan_bins) for s in stacked_by_class]
     return jnp.stack(cols, axis=1)
+
+
+# ------------------------------------------------------- quantized serving
+# Quantized serving pack (ISSUE-12, docs/SERVING.md): the device-resident
+# twin of :func:`stack_trees` at ~1/4 the bytes.  Traversal DECISIONS stay
+# exact — bins and split thresholds are already integers in bin space, and
+# the categorical masks merely bit-pack — so the walk routes every row to
+# the same leaf the fp32 pack would.  Only leaf VALUES quantize: per-class
+# scale ``s = max|leaf| / qmax``, quanta accumulated in int32 across the
+# whole ensemble (exact), one dequantizing multiply at the end.  That makes
+# any two traversal implementations over the same pack (the XLA while-loop
+# walk and the fused Pallas kernel) bitwise-identical UNCONDITIONALLY —
+# integer sums cannot regroup — which is the identity the fused-vs-unfused
+# pins lean on (mirroring the PR-7 wave kernel's int32 histogram story).
+
+#: quantize mode -> (leaf dtype, max quantum)
+QUANT_BITS = {"int16": (np.int16, 32767), "int8": (np.int8, 127)}
+
+#: node-array width: every index (feature, bin, child, leaf) must fit i16
+QUANT_INDEX_MAX = 32767
+
+
+def tree_max_depth(left_child: np.ndarray, right_child: np.ndarray) -> int:
+    """Longest root->leaf hop count of one tree's child arrays (the fixed
+    trip count a masked fixed-depth walk needs to reach every leaf)."""
+    if len(left_child) == 0:
+        return 1
+    depth = 1
+    stack = [(0, 1)]
+    while stack:
+        node, d = stack.pop()
+        depth = max(depth, d)
+        for nxt in (int(left_child[node]), int(right_child[node])):
+            if nxt >= 0:
+                stack.append((nxt, d + 1))
+    return depth
+
+
+def quantize_stack_trees(trees: List[Tree], max_leaves: int, num_bins: int,
+                         mode: str):
+    """Stack per-tree arrays into the QUANTIZED serving pack: i16 node
+    arrays, bit-packed categorical masks, int8/int16 leaf quanta with ONE
+    per-class scale.  Returns None when the shape exceeds the narrow
+    encodings (callers fall back to the fp32 pack with a warning).
+
+    Degenerate trees (num_leaves <= 1) are encoded with sentinel children
+    ``-1`` at split row 0 routing every row to leaf 0, so the walk needs no
+    per-tree special case (and the fused kernel no num_leaves operand)."""
+    leaf_dt, qmax = QUANT_BITS[mode]
+    if (max_leaves > QUANT_INDEX_MAX or num_bins > QUANT_INDEX_MAX
+            or any(int(tr.split_feature.max(initial=0)) > QUANT_INDEX_MAX
+                   for tr in trees)):
+        return None
+    t = len(trees)
+    m = max(max_leaves - 1, 1)
+    bb = -(-num_bins // 8)                  # bit-packed cat-mask bytes
+    max_abs = max((float(np.abs(tr.leaf_value).max(initial=0.0))
+                   for tr in trees), default=0.0)
+    scale = (max_abs / qmax) if max_abs > 0 else 1.0
+    out = {
+        "split_feature": np.zeros((t, m), np.int16),
+        "split_bin": np.zeros((t, m), np.int16),
+        "default_left": np.zeros((t, m), bool),
+        "is_cat": np.zeros((t, m), bool),
+        "cat_bits": np.zeros((t, m, bb), np.uint8),
+        "left_child": np.zeros((t, m), np.int16),
+        "right_child": np.zeros((t, m), np.int16),
+        "leaf_q": np.zeros((t, max_leaves), leaf_dt),
+    }
+    depth = 1
+    for i, tr in enumerate(trees):
+        k = tr.num_splits()
+        if k == 0:
+            out["left_child"][i, 0] = -1     # sentinel: everything -> leaf 0
+            out["right_child"][i, 0] = -1
+        else:
+            out["split_feature"][i, :k] = tr.split_feature
+            out["split_bin"][i, :k] = tr.split_bin
+            out["default_left"][i, :k] = tr.default_left
+            out["is_cat"][i, :k] = tr.is_cat
+            packed = np.packbits(tr.cat_mask, axis=1, bitorder="little")
+            out["cat_bits"][i, :k, : packed.shape[1]] = packed
+            out["left_child"][i, :k] = tr.left_child
+            out["right_child"][i, :k] = tr.right_child
+            depth = max(depth,
+                        tree_max_depth(tr.left_child, tr.right_child))
+        if tr.num_leaves:
+            q = np.clip(np.rint(tr.leaf_value[: tr.num_leaves] / scale),
+                        -qmax, qmax)
+            out["leaf_q"][i, : tr.num_leaves] = q.astype(leaf_dt)
+    pack = {k: jnp.asarray(v) for k, v in out.items()}
+    # static (trace-time) metadata — part of the plan's identity, never
+    # device operands
+    pack["scale"] = float(scale)
+    pack["bits"] = 8 if mode == "int8" else 16
+    pack["depth"] = int(depth)
+    pack["num_bins"] = int(num_bins)
+    return pack
+
+
+def quantize_error_bound(pack) -> float:
+    """Worst-case |quantized - fp32| raw-score gap for one class: each
+    tree's leaf rounds by at most scale/2 (clipping only ever lands ON the
+    max-magnitude leaf, adding nothing).  The fp32-parity harness
+    (tests/test_serve_quantize.py) pins predictions inside this bound."""
+    t = int(pack["leaf_q"].shape[0])
+    return t * pack["scale"] * 0.5
+
+
+def _tree_walk_q(tree: dict, bins: jnp.ndarray,
+                 nan_bins: jnp.ndarray) -> jnp.ndarray:
+    """Single-tree traversal over one quantized pack slice -> (N,) int32
+    leaf quanta.  Decision logic is :func:`_tree_walk`'s, with the cat
+    mask read as a bit ((byte >> (col & 7)) & 1) and no degenerate-tree
+    cond (sentinel children encode those) — the SAME arithmetic the fused
+    Pallas kernel runs, so the two are bitwise-identical by construction."""
+    n = bins.shape[0]
+    bb = tree["cat_bits"].shape[1]
+
+    def cond(state):
+        _, done = state
+        return ~jnp.all(done)
+
+    def body(state):
+        node, done = state
+        f = tree["split_feature"][node].astype(jnp.int32)
+        col = bins[jnp.arange(n), f].astype(jnp.int32)
+        isnan = col == nan_bins[f]
+        iscat = tree["is_cat"][node]
+        byte = tree["cat_bits"][node, jnp.minimum(col >> 3, bb - 1)]
+        catbit = ((byte.astype(jnp.int32) >> (col & 7)) & 1) > 0
+        gl = jnp.where(iscat, catbit,
+                       col <= tree["split_bin"][node].astype(jnp.int32))
+        gl = jnp.where(isnan & ~iscat, tree["default_left"][node], gl)
+        nxt = jnp.where(gl, tree["left_child"][node],
+                        tree["right_child"][node]).astype(jnp.int32)
+        is_leaf = nxt < 0
+        node = jnp.where(is_leaf | done, node, nxt)
+        node = jnp.where(is_leaf & ~done, nxt, node)
+        done = done | is_leaf
+        return node, done
+
+    node0 = jnp.zeros(n, jnp.int32)
+    done0 = jnp.zeros(n, bool)
+    node, _ = jax.lax.while_loop(cond, body, (node0, done0))
+    leaf_idx = jnp.where(node < 0, ~node, 0)
+    return tree["leaf_q"][leaf_idx].astype(jnp.int32)
+
+
+_QPACK_ARRAYS = ("split_feature", "split_bin", "default_left", "is_cat",
+                 "cat_bits", "left_child", "right_child", "leaf_q")
+
+
+def _ensemble_sum_q(pack: dict, bins: jnp.ndarray,
+                    nan_bins: jnp.ndarray) -> jnp.ndarray:
+    """(N,) int32 sum of leaf quanta across the stacked pack via
+    ``lax.scan`` over the tree axis — int32 addition is associative, so
+    ANY traversal order over the same pack produces these exact integers
+    (the unconditional fused-vs-unfused identity)."""
+    n = bins.shape[0]
+    arrays = {k: pack[k] for k in _QPACK_ARRAYS}
+
+    def body(acc, tree):
+        return acc + _tree_walk_q(tree, bins, nan_bins), None
+
+    acc, _ = jax.lax.scan(body, jnp.zeros(n, jnp.int32), arrays)
+    return acc
+
+
+def forest_scores_quantized(packs_by_class, bins: jnp.ndarray,
+                            nan_bins: jnp.ndarray, *, fused: bool = False,
+                            interpret: bool = False) -> jnp.ndarray:
+    """(N, K) f32 per-class scores from quantized packs: int32 quanta sums
+    (while-loop walk, or the VMEM-resident Pallas kernel when ``fused``)
+    followed by ONE dequantizing multiply per class.  Both paths share the
+    dequant op, so their outputs are bitwise-identical whenever the integer
+    sums are — which integer accumulation guarantees."""
+    cols = []
+    for pack in packs_by_class:
+        if pack is None:
+            cols.append(jnp.zeros(bins.shape[0], jnp.float32))
+            continue
+        if fused:
+            from ..ops.pallas_traverse import fused_class_sums
+            acc = fused_class_sums(pack, bins, nan_bins,
+                                   interpret=interpret)
+        else:
+            acc = _ensemble_sum_q(pack, bins, nan_bins)
+        cols.append(acc.astype(jnp.float32) * jnp.float32(pack["scale"]))
+    return jnp.stack(cols, axis=1)

@@ -19,6 +19,7 @@ Quickstart::
 """
 
 from .bucketing import BucketLadder
+from .compile_cache import CompileCache
 from .metrics import ServeMetrics
 from .plan import (PredictPlan, cache_stats, clear_plan_cache,
                    plan_for_model)
@@ -26,7 +27,8 @@ from .predictor import (MicroBatcher, Predictor, ServeDeadlineError,
                         ServeOverloadError)
 
 __all__ = [
-    "BucketLadder", "MicroBatcher", "PredictPlan", "Predictor",
-    "ServeDeadlineError", "ServeMetrics", "ServeOverloadError",
-    "cache_stats", "clear_plan_cache", "plan_for_model",
+    "BucketLadder", "CompileCache", "MicroBatcher", "PredictPlan",
+    "Predictor", "ServeDeadlineError", "ServeMetrics",
+    "ServeOverloadError", "cache_stats", "clear_plan_cache",
+    "plan_for_model",
 ]

@@ -130,9 +130,11 @@ class ServeMetrics:
         counters (the fields docs/SERVING.md documents).
 
         STABLE SCHEMA: the plan-derived keys (``compiles``,
-        ``plan_bytes``, ``plan_cache``) are always present — ``None``
-        when no plan was passed — so scrapers and the Prometheus renderer
-        see the same metric set every call.  ``plan_bytes`` is THIS
+        ``plan_bytes``, ``plan_cache``, ``quantize``, ``traverse``,
+        ``aot``) are always present — ``None`` when no plan was passed
+        (and ``aot`` is None without a persistent compile cache) — so
+        scrapers and the Prometheus renderer see the same metric set
+        every call.  ``plan_bytes`` is THIS
         plan's resident device bytes (tree pack + bin tables);
         ``plan_cache`` carries the process-global hit/miss counters plus
         ``size`` (entries) and ``bytes`` (resident bytes across every
@@ -163,6 +165,16 @@ class ServeMetrics:
                              else int(getattr(plan, "plan_bytes", 0)))
         out["plan_cache"] = (None if plan is None
                              else dict(plan_cache_stats()))
+        # Quantized-pack / traversal-kernel / AOT-cache state (ISSUE-12):
+        # which pack format and traversal the plan serves with, and the
+        # zero-cold-start counters (``aot`` is None when no persistent
+        # compile cache is configured — a stable key either way).
+        out["quantize"] = (None if plan is None
+                           else getattr(plan, "quantize_mode", "off"))
+        out["traverse"] = (None if plan is None
+                           else getattr(plan, "traverse_mode", "unfused"))
+        out["aot"] = (None if plan is None
+                      else getattr(plan, "aot_stats", lambda: None)())
         return out
 
     def render_prometheus(self, plan=None,
@@ -177,6 +189,19 @@ class ServeMetrics:
             snap["plan_cache"] = {k: None for k in
                                   ("hits", "misses", "builds", "evictions",
                                    "size", "bytes")}
+        # Schema stability both ways: the quantize/traverse strings never
+        # render (the renderer skips non-numerics — they'd appear as NaN
+        # only when plan-less, flapping the series), and the aot block
+        # always carries the FULL counter shape so aot_* series exist on
+        # every scrape whether or not a compile cache is configured.
+        del snap["quantize"], snap["traverse"]
+        aot = snap["aot"] or {}
+        cache = aot.get("cache") or {}
+        snap["aot"] = {
+            "hits": aot.get("hits"), "compiles": aot.get("compiles"),
+            "cache": {k: cache.get(k) for k in
+                      ("hits", "misses", "stores", "errors")},
+        }
         return render_prometheus(snap, prefix=prefix)
 
 

@@ -26,7 +26,9 @@ the serving metrics).
 
 from __future__ import annotations
 
+import hashlib
 import threading
+import time
 import weakref
 from collections import OrderedDict
 from typing import Dict, List, Optional
@@ -35,7 +37,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..models.tree import forest_scores, stack_trees
+from ..models.tree import (forest_scores, forest_scores_quantized,
+                           quantize_error_bound, quantize_stack_trees,
+                           stack_trees)
+from ..utils.log import Log
 from .bucketing import BucketLadder
 from .device_binning import bin_rows_device, build_bin_tables, float_bits
 
@@ -44,7 +49,10 @@ class PredictPlan:
     """Frozen, device-resident predict state for one Booster slice."""
 
     def __init__(self, model, start_iteration: int, end_iteration: int,
-                 ladder: Optional[BucketLadder] = None):
+                 ladder: Optional[BucketLadder] = None,
+                 quantize: Optional[str] = None,
+                 traverse: Optional[str] = None,
+                 compile_cache: Optional[str] = None):
         binned = model.train_data.binned
         self._model_ref = weakref.ref(model)
         self.start_iteration = int(start_iteration)
@@ -63,44 +71,188 @@ class PredictPlan:
         trees_by_class = model.host_trees(self.start_iteration,
                                           self.end_iteration)
         self.num_trees = sum(len(t) for t in trees_by_class)
-        self._stacked = [
-            stack_trees(trees, model.cfg.num_leaves, binned.max_num_bins)
-            if trees else None
-            for trees in trees_by_class]
         self._nan_bins = jnp.asarray(binned.nan_bins, jnp.int32)
+        # Quantized serving packs (ISSUE-12, docs/SERVING.md): int16/int8
+        # leaf quanta + narrow node arrays + bit-packed cat masks — ~4x
+        # smaller resident footprint with exact routing; leaf values
+        # round within quantize_error_bound().  Shapes the narrow
+        # encodings can't hold degrade to the fp32 pack with a warning.
+        self._stacked = None
+        self._packs = None
+        self.quantize_mode = "off"
+        quantize = _resolve_quantize(model, quantize, warn=True)
+        if quantize != "off":
+            packs = [quantize_stack_trees(trees, model.cfg.num_leaves,
+                                          binned.max_num_bins, quantize)
+                     if trees else None for trees in trees_by_class]
+            if any(p is None and trees
+                   for p, trees in zip(packs, trees_by_class)):
+                Log.warning(
+                    f"serve: tpu_serve_quantize={quantize} needs "
+                    "num_leaves/bins/features <= 32767; falling back to "
+                    "the fp32 pack")
+            else:
+                self._packs = packs
+                self.quantize_mode = quantize
+        if self._packs is None:
+            self._stacked = [
+                stack_trees(trees, model.cfg.num_leaves,
+                            binned.max_num_bins)
+                if trees else None
+                for trees in trees_by_class]
+        self.traverse_mode, self.traverse_degrade = _resolve_traverse(
+            model, traverse, self.quantize_mode, self._packs,
+            self.num_features)
+        self._interpret = jax.default_backend() != "tpu"
         self.stack_count = 1          # re-stacks would increment (never do)
-        # Resident bytes for this plan (stacked tree pack + bin tables +
-        # NaN routing) — the per-plan half of the serve byte accounting
-        # (docs/SERVING.md): plan-cache admission/eviction by bytes
-        # (ROADMAP item 1) consumes exactly this number.
-        self.plan_bytes = _pytree_bytes(
-            (self._stacked, self._tables, self._nan_bins))
+        # Resident bytes for this plan (tree pack — quantized or fp32 —
+        # + bin tables + NaN routing) — the per-plan half of the serve
+        # byte accounting (docs/SERVING.md): plan-cache admission/eviction
+        # by bytes (ROADMAP item 1) consumes exactly this number.
+        # ``pack_bytes`` is the tree pack alone: the part quantization
+        # shrinks (the bin tables are f64-exactness-bound and shared by
+        # every mode), so shrink ratios stay meaningful on small models
+        # where the tables dominate.
+        self.pack_bytes = _pytree_bytes(
+            self._packs if self._packs is not None else self._stacked)
+        self.plan_bytes = self.pack_bytes + _pytree_bytes(
+            (self._tables, self._nan_bins))
+
+        def _scores(bins):
+            if self._packs is not None:
+                return forest_scores_quantized(
+                    self._packs, bins, self._nan_bins,
+                    fused=self.traverse_mode == "fused",
+                    interpret=self._interpret)
+            return forest_scores(self._stacked, bins, self._nan_bins)
 
         def _from_bits(hi, lo):
-            bins = bin_rows_device(self._tables, hi, lo)
-            return forest_scores(self._stacked, bins, self._nan_bins)
-
-        def _from_bins(bins):
-            return forest_scores(self._stacked, bins, self._nan_bins)
+            return _scores(bin_rows_device(self._tables, hi, lo))
 
         # watch_compiles (telemetry/spans.py): each new ladder rung's XLA
         # compile lands as a compile.end event; launches already run
         # under the predictor's serve/predict span.
         from ..telemetry import watch_compiles
-        self._predict_bits = watch_compiles(jax.jit(_from_bits),
+        self._jit_bits = jax.jit(_from_bits)
+        self._jit_binned = jax.jit(_scores)
+        self._predict_bits = watch_compiles(self._jit_bits,
                                             "serve/predict_bits")
-        self._predict_binned = watch_compiles(jax.jit(_from_bins),
+        self._predict_binned = watch_compiles(self._jit_binned,
                                               "serve/predict_binned")
         self._shapes = set()          # padded (kind, rows) this plan compiled
         self._lock = threading.Lock()
+        # Persistent AOT compile cache (serve/compile_cache.py): compiled
+        # executables for this plan's ladder rungs round-trip through disk
+        # so a restart/hot-swap pays ZERO XLA compiles on warm entries.
+        self._aot: Dict[tuple, object] = {}
+        self.aot_hits = 0
+        self.aot_compiles = 0
+        self._ccache = None
+        self._identity = None
+        if compile_cache is None:
+            from .compile_cache import cache_dir_for
+            compile_cache = cache_dir_for(model.cfg)
+        if compile_cache:
+            from .compile_cache import CompileCache
+            self._ccache = CompileCache(compile_cache)
+
+    # ------------------------------------------------------------- identity
+    @property
+    def identity(self) -> str:
+        """Content digest of everything the compiled predict programs bake
+        in (pack arrays, bin tables, NaN routing, modes) — the plan half
+        of the AOT cache key.  Two processes serving the same model slice
+        the same way share it; any retrain, re-slice or mode change forks
+        it."""
+        if self._identity is None:
+            h = hashlib.sha256()
+            h.update(f"{self.num_class}|{self.num_features}|"
+                     f"{self.quantize_mode}|{self.traverse_mode}|"
+                     f"{self._interpret}".encode())
+            for leaf in jax.tree_util.tree_leaves(
+                    (self._packs if self._packs is not None
+                     else self._stacked, self._tables, self._nan_bins)):
+                if hasattr(leaf, "shape"):
+                    h.update(np.ascontiguousarray(
+                        np.asarray(leaf)).tobytes())
+                else:
+                    h.update(repr(leaf).encode())
+            self._identity = h.hexdigest()
+        return self._identity
+
+    def quantize_error_bound(self) -> float:
+        """Worst-case |quantized - fp32| raw-score gap (max across
+        classes; 0.0 for fp32 packs) — the fp32-parity harness's pinned
+        tolerance (tests/test_serve_quantize.py)."""
+        if self._packs is None:
+            return 0.0
+        return max((quantize_error_bound(p) for p in self._packs
+                    if p is not None), default=0.0)
+
+    # ---------------------------------------------------------- AOT dispatch
+    def _call(self, kind: str, *args):
+        """Launch one predict program: straight through the jitted entry
+        when no compile cache is configured (today's path), else through
+        the per-rung AOT executable — loaded from disk when a prior
+        process compiled it (zero cold-start), compiled-and-persisted
+        otherwise."""
+        if self._ccache is None:
+            fn = (self._predict_bits if kind == "bits"
+                  else self._predict_binned)
+            return fn(*args)
+        key = (kind, int(args[0].shape[0]))
+        with self._lock:
+            compiled = self._aot.get(key)
+        if compiled is None:
+            compiled = self._aot_compile(kind, key, args)
+        return compiled(*args)
+
+    def _aot_compile(self, kind: str, key: tuple, args):
+        from .compile_cache import entry_key
+        ck = entry_key(self.identity, kind, key[1])
+        compiled = self._ccache.load(ck)
+        fresh = compiled is None
+        if fresh:
+            jit_fn = self._jit_bits if kind == "bits" else self._jit_binned
+            t0 = time.perf_counter()
+            compiled = jit_fn.lower(*args).compile()
+            # compile telemetry (the jit seam can't see AOT compiles):
+            # every fresh rung compile lands as a compile.end event with
+            # its memory_analysis byte summary, mirroring profile_iter.
+            from ..telemetry.memory import note_compile
+            note_compile(f"serve/aot_{kind}", time.perf_counter() - t0,
+                         compiled=compiled)
+            self._ccache.store(ck, compiled)
+        with self._lock:
+            self._aot[key] = compiled
+            if fresh:
+                self.aot_compiles += 1
+            else:
+                self.aot_hits += 1
+        return compiled
+
+    def aot_stats(self) -> Optional[Dict[str, int]]:
+        """Zero-cold-start counters: this plan's disk hits vs fresh
+        compiles, plus the cache-level frame counters (None when no cache
+        is configured) — ``BENCH_serve``'s post-restart compile count
+        reads exactly this."""
+        if self._ccache is None:
+            return None
+        with self._lock:
+            out = {"hits": self.aot_hits, "compiles": self.aot_compiles}
+        out["cache"] = self._ccache.stats()
+        return out
 
     # ------------------------------------------------------------ accounting
     def compile_count(self) -> int:
-        """Distinct compiled programs behind this plan.  Prefers the jit
-        executable-cache sizes (actual XLA compiles); falls back to the
-        padded-shape census when running on a jax without ``_cache_size``."""
-        n = 0
-        for fn in (self._predict_bits, self._predict_binned):
+        """Distinct FRESH XLA compiles behind this plan: the jit
+        executable-cache sizes plus AOT compiles this process actually
+        paid (disk-loaded executables are deliberately NOT counted — they
+        are the compiles a restart skipped, reported via aot_stats()).
+        Falls back to the padded-shape census on a jax without
+        ``_cache_size``."""
+        n = self.aot_compiles
+        for fn in (self._jit_bits, self._jit_binned):
             try:
                 n += int(fn._cache_size())
             except Exception:  # noqa: BLE001 — older jax: census fallback
@@ -137,7 +289,7 @@ class PredictPlan:
         hi, lo = float_bits(X)
         (hi, lo), padded = self._pad([hi, lo], n)
         self._note_shape("bits", padded)
-        scores = self._predict_bits(jnp.asarray(hi), jnp.asarray(lo))
+        scores = self._call("bits", jnp.asarray(hi), jnp.asarray(lo))
         if metrics is not None:
             metrics.observe_batch(n, padded)
         out = np.asarray(jax.device_get(scores), np.float64)[:n]
@@ -155,7 +307,7 @@ class PredictPlan:
                 + self.init_scores[None, :]
         (bins,), padded = self._pad([bins], n)
         self._note_shape("binned", padded)
-        scores = self._predict_binned(jnp.asarray(bins))
+        scores = self._call("binned", jnp.asarray(bins))
         if metrics is not None:
             metrics.observe_batch(n, padded)
         out = np.asarray(jax.device_get(scores), np.float64)[:n]
@@ -177,6 +329,64 @@ def _pytree_bytes(tree) -> int:
     for leaf in jax.tree_util.tree_leaves(tree):
         total += int(getattr(leaf, "nbytes", 0) or 0)
     return total
+
+
+def _resolve_quantize(model, quantize: Optional[str],
+                      warn: bool = False) -> str:
+    """Effective pack quantize mode: the explicit kwarg wins, else the
+    booster's ``tpu_serve_quantize`` knob; unknown spellings mean off
+    (warned only from the plan BUILD — this also runs in the hot-path
+    cache-key computation, which must not spam the log)."""
+    if quantize is None:
+        quantize = getattr(model.cfg, "tpu_serve_quantize", "off")
+    quantize = str(quantize).lower()
+    if quantize not in ("off", "int16", "int8"):
+        if warn:
+            Log.warning(f"serve: unknown tpu_serve_quantize={quantize!r} "
+                        "(expected off|int16|int8); using off")
+        return "off"
+    return quantize
+
+
+def _resolve_traverse(model, traverse: Optional[str], quantize_mode: str,
+                      packs, num_features: int):
+    """(mode, degrade_reason) for the traversal kernel.  fused needs a
+    quantized pack (integer identity is the kernel's contract) and the
+    VMEM fit gate; auto additionally requires a live TPU backend (on CPU
+    the kernel only runs in interpret mode — a test vehicle, engaged by
+    forcing fused, never by auto)."""
+    if traverse is None:
+        traverse = getattr(model.cfg, "tpu_traverse_kernel", "auto")
+    traverse = str(traverse).lower()
+    if traverse not in ("auto", "fused", "unfused"):
+        Log.warning(f"serve: unknown tpu_traverse_kernel={traverse!r} "
+                    "(expected auto|fused|unfused); using unfused")
+        return "unfused", f"unknown mode {traverse!r}"
+    if traverse == "unfused":
+        return "unfused", None
+    if quantize_mode == "off" or packs is None:
+        reason = "fused traversal needs a quantized pack " \
+                 "(tpu_serve_quantize=int16|int8)"
+        if traverse == "fused":
+            Log.warning(f"serve: tpu_traverse_kernel=fused ignored — "
+                        f"{reason}")
+            return "unfused", reason
+        return "unfused", None          # auto simply doesn't engage
+    from ..ops.pallas_traverse import traverse_layout_fits
+    fits = all(
+        traverse_layout_fits(int(p["leaf_q"].shape[0]),
+                             int(p["leaf_q"].shape[1]), num_features,
+                             int(p["num_bins"]))
+        for p in packs if p is not None)
+    if not fits:
+        reason = "tree pack exceeds the traversal kernel's VMEM budget"
+        if traverse == "fused":
+            Log.warning(f"serve: tpu_traverse_kernel=fused ignored — "
+                        f"{reason}")
+        return "unfused", reason
+    if traverse == "auto" and jax.default_backend() != "tpu":
+        return "unfused", None
+    return "fused", None
 
 
 # ---------------------------------------------------------------- plan cache
@@ -224,7 +434,10 @@ def _resolve_slice(model, num_iteration: Optional[int],
 
 def plan_for_model(model, num_iteration: Optional[int] = None,
                    start_iteration: int = 0,
-                   ladder: Optional[BucketLadder] = None
+                   ladder: Optional[BucketLadder] = None,
+                   quantize: Optional[str] = None,
+                   traverse: Optional[str] = None,
+                   compile_cache: Optional[str] = None
                    ) -> Optional[PredictPlan]:
     """Fetch (or build) the cached PredictPlan for a GBDT slice.
 
@@ -232,17 +445,31 @@ def plan_for_model(model, num_iteration: Optional[int] = None,
     ``num_trees``, ``_pred_version`` — the latter bumped by in-place leaf
     mutations like the C-API's SetLeafValue/Refit): training another
     round, rolling one back, or rewriting leaves changes the key, so a
-    stale pack can never serve.  Returns None when the dataset cannot be
-    device-binned exactly (callers fall back to the legacy host path);
-    that verdict is dataset-level and permanent, so it is memoized on the
-    model — the hot predict path must not re-derive the bin tables just
-    to fail again."""
+    stale pack can never serve.  ``quantize``/``traverse``/
+    ``compile_cache`` override the booster's knobs per plan (per-tenant
+    pack formats, ROADMAP item 1) and ride the key — a quantized plan and
+    the fp32 plan of the same model coexist in the cache.  Returns None
+    when the dataset cannot be device-binned exactly (callers fall back
+    to the legacy host path); that verdict is dataset-level and
+    permanent, so it is memoized on the model — the hot predict path must
+    not re-derive the bin tables just to fail again."""
     if getattr(model, "_serve_unsupported", False):
         return None
     ladder = ladder or BucketLadder()
     start, end = _resolve_slice(model, num_iteration, start_iteration)
+    # Key on NORMALIZED mode requests (kwarg-or-knob, lowercased; cache
+    # dir through the env/knob resolution): Predictor(bst) and
+    # Predictor(bst, traverse="auto") describe the same plan and must
+    # share one device-resident build, not double the cache bytes.
+    if traverse is None:
+        traverse = getattr(model.cfg, "tpu_traverse_kernel", "auto")
+    traverse = str(traverse).lower()
+    if compile_cache is None:
+        from .compile_cache import cache_dir_for
+        compile_cache = cache_dir_for(model.cfg)
     key = (id(model), start, end, int(model.iter_), int(model.num_trees),
-           int(getattr(model, "_pred_version", 0)), ladder)
+           int(getattr(model, "_pred_version", 0)), ladder,
+           _resolve_quantize(model, quantize), traverse, compile_cache)
     while True:
         with _CACHE_LOCK:
             plan = _CACHE.get(key)
@@ -270,7 +497,9 @@ def plan_for_model(model, num_iteration: Optional[int] = None,
         ev.wait()
     plan = None
     try:
-        plan = PredictPlan(model, start, end, ladder=ladder)
+        plan = PredictPlan(model, start, end, ladder=ladder,
+                           quantize=quantize, traverse=traverse,
+                           compile_cache=compile_cache)
     except ValueError:
         model._serve_unsupported = True
         return None

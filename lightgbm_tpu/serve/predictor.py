@@ -105,7 +105,14 @@ class Predictor:
                  start_iteration: int = 0,
                  ladder: Optional[BucketLadder] = None,
                  max_compiles: int = 16,
-                 host_fallback: bool = True):
+                 host_fallback: bool = True,
+                 quantize: Optional[str] = None,
+                 traverse: Optional[str] = None,
+                 compile_cache: Optional[str] = None):
+        """``quantize``/``traverse``/``compile_cache`` override the
+        booster's ``tpu_serve_quantize`` / ``tpu_traverse_kernel`` /
+        ``tpu_serve_compile_cache`` knobs for THIS predictor (per-tenant
+        pack formats and cache dirs; docs/SERVING.md)."""
         model = getattr(booster, "_gbdt", booster)
         if not hasattr(model, "train_data"):
             raise ValueError(
@@ -126,7 +133,9 @@ class Predictor:
         self._model = model
         self._raw_score = bool(raw_score)
         self.plan = plan_for_model(model, num_iteration, start_iteration,
-                                   ladder=ladder)
+                                   ladder=ladder, quantize=quantize,
+                                   traverse=traverse,
+                                   compile_cache=compile_cache)
         if self.plan is None:
             raise ValueError(
                 "device binning cannot reproduce this dataset's bin "

@@ -14,7 +14,7 @@ import pytest
 
 from bench import (_load_watchdog, _probe_backend, _probe_block,
                    run_fused_rung, run_goss_rung, run_ltr_rung,
-                   run_wide_rung)
+                   run_serve_fused_rung, run_wide_rung)
 
 
 def _assert_hlo_cost(blob):
@@ -109,6 +109,30 @@ def test_goss_rung_blob_one_dispatch():
     assert blob["dispatches_per_iter"] == 1.0
     assert blob["host_syncs_per_iter"] <= 2.0
     _assert_hlo_cost(blob)
+
+
+def test_serve_fused_rung_blob():
+    """The quantized-traversal serving rung (ISSUE-12): int8 pack + fused
+    Pallas traversal (interpret mode on CPU — the kernel body runs), the
+    fused-vs-unfused integer identity asserted in-rung, >= 3x pack
+    shrink, fp32 parity inside the analytic bound, and the zero-cold-
+    start restart paying no compiles."""
+    blob = run_serve_fused_rung(2600, 2, "cpu", jax, features=10,
+                                num_leaves=15, calls=4, max_batch=64)
+    assert blob["rows"] == 2600 and blob["quantize"] == "int8"
+    assert blob["traverse"] == "fused"
+    assert blob["interpret_mode"] is True
+    assert blob["fused_bitwise_unfused"] is True
+    assert blob["warm_qps"] > 0
+    assert blob["p99_ms"] >= blob["p50_ms"] >= 0
+    assert blob["pack_shrink"] >= 3.0
+    assert 0 < blob["plan_bytes"] < blob["plan_bytes_fp32"]
+    assert blob["parity_ok"] is True
+    assert blob["parity_err"] <= blob["parity_bound"] + 1e-12
+    r = blob["restart"]
+    assert r["cold_compiles"] >= 1
+    assert r["restart_compiles"] == 0
+    assert r["restart_aot_hits"] >= 1
 
 
 # --------------------------- watchdog probe block (ISSUE-6 satellite) ----

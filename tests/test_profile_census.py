@@ -45,6 +45,26 @@ def test_fused_wave_census_one_dispatch_per_wave():
     assert unfused["dispatches_per_iter"] == 1.0
 
 
+def test_predict_dispatch_census_one_dispatch_per_call():
+    """ISSUE-12: the serve plan costs exactly ONE compiled dispatch and
+    ONE host sync per raw predict call — on BOTH traversal paths (the
+    fused Pallas kernel rides inside the same jitted program, so fusion
+    cannot add launches).  The output transform adds one eager dispatch's
+    sync (the documented convert-output cost, docs/SERVING.md)."""
+    from tools.profile_iter import predict_dispatch_census
+
+    blobs = {b["path"]: b for b in predict_dispatch_census(
+        rows=1024, features=6, iters=4, calls=3)}
+    assert set(blobs) == {"fused", "unfused"}
+    assert blobs["fused"]["traverse_active"] == "fused"
+    assert blobs["unfused"]["traverse_active"] == "unfused"
+    for blob in blobs.values():
+        assert blob["dispatches_per_predict_raw"] == 1.0, blob
+        assert blob["host_syncs_per_predict_raw"] == 1.0, blob
+        assert blob["dispatches_per_predict_transform"] == 1.0, blob
+        assert blob["host_syncs_per_predict_transform"] == 2.0, blob
+
+
 def test_census_linear_solve_no_per_leaf_syncs():
     """The batched linear-leaf solve: host syncs per iteration must NOT
     scale with num_leaves (the per-leaf Python solve loop pulled 6 arrays

@@ -24,6 +24,8 @@ import pytest
 import lightgbm_tpu as lgb
 from lightgbm_tpu import serve
 from lightgbm_tpu.binning import bin_dataset, find_bin
+
+pytestmark = pytest.mark.serve
 from lightgbm_tpu.serve.bucketing import BucketLadder
 from lightgbm_tpu.serve.device_binning import (bin_rows_device,
                                                build_bin_tables, float_bits)

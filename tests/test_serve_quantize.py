@@ -1,0 +1,374 @@
+"""Quantized serving packs + fused Pallas traversal + AOT compile cache
+(ISSUE-12).  Pins the subsystem's three contracts:
+
+- **fp32 parity**: quantized predictions sit inside the analytic bound
+  ``num_trees * scale / 2`` (the training-AUC-parity-pin style harness),
+  across dense / sparse / multiclass-softmax / NaN-missing / categorical
+  inputs — and the ROUTING is exact, witnessed by an independent numpy
+  walker over the quantized pack matching the device path integer-for-
+  integer;
+- **fused == unfused, bitwise, unconditionally**: integer accumulation
+  over the same pack cannot regroup, pinned across the shape-bucket
+  ladder (interpret-mode kernel on CPU — tier-1 runs the kernel body);
+- **zero cold-start**: a simulated process restart against a warm AOT
+  cache dir pays zero XLA compiles and answers bitwise-identically;
+  corrupt and version-stale entries are detected, warned about and
+  rebuilt (the PR-6 checksummed-frame discipline).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import serve
+from lightgbm_tpu.models.tree import (QUANT_BITS, quantize_error_bound,
+                                      quantize_stack_trees, tree_max_depth)
+
+pytestmark = pytest.mark.serve
+
+
+def _messy_data(n=1600, f=6, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f) * np.array([1.0, 50.0, 1e-3, 1e5, 1.0, 1.0])[:f]
+    X[rng.rand(n, f) < 0.08] = np.nan
+    if f > 4:
+        X[:, 4] = rng.randint(0, 9, n)
+        X[rng.rand(n) < 0.04, 4] = 777    # unseen at predict for some rows
+    y = (X[:, 0] + np.nan_to_num(X[:, 1]) / 50.0 > 0).astype(np.float64)
+    return X, y
+
+
+P = {"objective": "binary", "num_leaves": 15, "min_data_in_leaf": 5,
+     "verbosity": -1, "categorical_feature": "4"}
+
+
+@pytest.fixture(scope="module")
+def messy():
+    return _messy_data()
+
+
+@pytest.fixture(scope="module")
+def bst(messy):
+    X, y = messy
+    return lgb.train(P, lgb.Dataset(X, label=y), 8)
+
+
+# ------------------------------------------------------------ fp32 parity
+@pytest.mark.parametrize("mode", ["int16", "int8"])
+def test_parity_dense_messy(messy, bst, mode):
+    """Dense + NaN + categorical(incl. unseen) raw scores inside the
+    analytic quantization bound; plan reports the mode it serves with."""
+    X, _ = messy
+    ref = serve.Predictor(bst, raw_score=True).predict(X[:700])
+    pred = serve.Predictor(bst, raw_score=True, quantize=mode)
+    assert pred.plan.quantize_mode == mode
+    got = pred.predict(X[:700])
+    bound = pred.plan.quantize_error_bound()
+    assert bound > 0
+    assert np.abs(got - ref).max() <= bound + 1e-12
+    snap = pred.metrics_snapshot()
+    assert snap["quantize"] == mode
+
+
+@pytest.mark.parametrize("mode", ["int16", "int8"])
+def test_parity_sparse(mode):
+    sp = pytest.importorskip("scipy.sparse")
+    rng = np.random.RandomState(5)
+    X = rng.randn(1200, 8) * (rng.rand(1200, 8) < 0.3)
+    y = (X[:, 0] > 0).astype(np.float64)
+    bsp = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "verbosity": -1}, lgb.Dataset(X, label=y), 6)
+    pred = serve.Predictor(bsp, raw_score=True, quantize=mode)
+    ref = serve.Predictor(bsp, raw_score=True).predict(X[:400])
+    got = pred.predict(sp.csr_matrix(X[:400]))
+    assert np.abs(got - ref).max() <= pred.plan.quantize_error_bound() + 1e-12
+    # sparse and dense route through the SAME pack: bitwise-equal
+    np.testing.assert_array_equal(got, pred.predict(X[:400]))
+
+
+def test_parity_multiclass_softmax():
+    """Raw margins inside the bound AND transformed (softmax) outputs
+    close — the output transform runs outside the quantized program."""
+    rng = np.random.RandomState(4)
+    X = rng.randn(1200, 5)
+    X[rng.rand(1200, 5) < 0.05] = np.nan
+    y = rng.randint(0, 3, 1200)
+    bst3 = lgb.train({"objective": "multiclass", "num_class": 3,
+                      "num_leaves": 7, "verbosity": -1},
+                     lgb.Dataset(X, label=y), 6)
+    raw_ref = serve.Predictor(bst3, raw_score=True).predict(X[:333])
+    pq = serve.Predictor(bst3, raw_score=True, quantize="int16")
+    raw_q = pq.predict(X[:333])
+    bound = pq.plan.quantize_error_bound()
+    assert np.abs(raw_q - raw_ref).max() <= bound + 1e-12
+    soft = serve.Predictor(bst3, quantize="int16").predict(X[:333])
+    assert soft.shape == (333, 3)
+    np.testing.assert_allclose(soft.sum(axis=1), 1.0, rtol=1e-5)
+    ref_soft = serve.Predictor(bst3).predict(X[:333])
+    np.testing.assert_allclose(soft, ref_soft, atol=5 * bound + 1e-7)
+
+
+def _walk_pack_numpy(pack, bins, nan_bins):
+    """Independent numpy reference walker over the QUANTIZED pack —
+    routing through bit-packed cat masks, NaN default routing and
+    sentinel degenerate trees, accumulating int32 quanta.  The device
+    paths must match it integer-for-integer (routing exactness)."""
+    sf = np.asarray(pack["split_feature"])
+    sb = np.asarray(pack["split_bin"])
+    dl = np.asarray(pack["default_left"])
+    ic = np.asarray(pack["is_cat"])
+    cb = np.asarray(pack["cat_bits"])
+    lc = np.asarray(pack["left_child"])
+    rc = np.asarray(pack["right_child"])
+    lq = np.asarray(pack["leaf_q"])
+    t = sf.shape[0]
+    n = bins.shape[0]
+    acc = np.zeros(n, np.int64)
+    for ti in range(t):
+        for r in range(n):
+            node = 0
+            while True:
+                f = int(sf[ti, node])
+                col = int(bins[r, f])
+                if ic[ti, node]:
+                    go_left = bool((cb[ti, node, col >> 3]
+                                    >> (col & 7)) & 1)
+                elif col == int(nan_bins[f]):
+                    go_left = bool(dl[ti, node])
+                else:
+                    go_left = col <= int(sb[ti, node])
+                nxt = int(lc[ti, node] if go_left else rc[ti, node])
+                if nxt < 0:
+                    acc[r] += int(lq[ti, ~nxt])
+                    break
+                node = nxt
+    return acc
+
+
+def test_routing_exact_vs_numpy_walker(messy, bst):
+    """The device integer sums equal an independent host walker's —
+    quantization moved ONLY the leaf values, never a routing decision
+    (categorical edges, NaN defaults and unseen categories included)."""
+    import jax.numpy as jnp
+
+    from lightgbm_tpu.models.tree import _ensemble_sum_q
+
+    X, _ = messy
+    binned = bst._gbdt.train_data.binned
+    bins = binned.apply(X[:200]).astype(np.int32)
+    nan_bins = np.asarray(binned.nan_bins)
+    trees = bst._gbdt.host_trees()[0]
+    pack = quantize_stack_trees(trees, bst._gbdt.cfg.num_leaves,
+                                binned.max_num_bins, "int16")
+    dev = np.asarray(_ensemble_sum_q(pack, jnp.asarray(bins),
+                                     jnp.asarray(nan_bins, jnp.int32)))
+    host = _walk_pack_numpy(pack, bins, nan_bins)
+    np.testing.assert_array_equal(dev, host.astype(np.int32))
+
+
+# ------------------------------------------------- pack format + size wins
+def test_pack_shrink_ratio_bench_shape():
+    """The acceptance-criteria shape (max_bin 255 ensemble): quantized
+    serve.plan_bytes >= 3x smaller than fp32."""
+    rng = np.random.RandomState(0)
+    X = rng.randn(8000, 16)
+    X[rng.rand(8000, 16) < 0.02] = np.nan
+    y = (X[:, 0] + np.nan_to_num(X[:, 1]) > 0).astype(np.float64)
+    b = lgb.train({"objective": "binary", "num_leaves": 31,
+                   "verbosity": -1}, lgb.Dataset(X, label=y), 20)
+    fp = serve.plan_for_model(b._gbdt, quantize="off")
+    for mode in ("int16", "int8"):
+        q = serve.plan_for_model(b._gbdt, quantize=mode)
+        assert fp.plan_bytes / q.plan_bytes >= 3.0, (
+            mode, fp.plan_bytes, q.plan_bytes)
+
+
+def test_pack_encoding_and_bound(bst):
+    """Narrow dtypes, bit-packed cat masks, sentinel degenerate trees,
+    and the analytic error bound's shape."""
+    g = bst._gbdt
+    trees = g.host_trees()[0]
+    nb = g.train_data.binned.max_num_bins
+    for mode, (dt, qmax) in QUANT_BITS.items():
+        pack = quantize_stack_trees(trees, g.cfg.num_leaves, nb, mode)
+        assert pack["leaf_q"].dtype == dt
+        assert pack["split_feature"].dtype == np.int16
+        assert pack["cat_bits"].dtype == np.uint8
+        assert pack["cat_bits"].shape[2] == -(-nb // 8)
+        assert int(np.abs(np.asarray(pack["leaf_q"])).max()) <= qmax
+        assert quantize_error_bound(pack) == \
+            len(trees) * pack["scale"] * 0.5
+        assert pack["depth"] >= 1
+    # shape gate: an impossible encoding returns None (caller degrades)
+    assert quantize_stack_trees(trees, 40000, nb, "int16") is None
+    assert tree_max_depth(np.zeros(0, np.int32), np.zeros(0, np.int32)) == 1
+
+
+def test_untrained_and_degenerate_trees(messy):
+    """Sentinel-encoded degenerate trees: an untrained booster's quantized
+    predictor answers init scores, same as fp32."""
+    X, y = _messy_data(n=400)
+    b0 = lgb.Booster(params=dict(P), train_set=lgb.Dataset(X, label=y))
+    pred = serve.Predictor(b0, raw_score=True, quantize="int16")
+    out = pred.predict(X[:10])
+    np.testing.assert_allclose(out, np.full(10, b0._gbdt.init_scores[0]))
+
+
+# ------------------------------------------- fused traversal: bitwise pin
+def test_fused_bitwise_unfused_across_ladder(messy, bst):
+    """The ISSUE-12 identity criterion: fused (interpret-mode Pallas on
+    CPU) == unfused (XLA while-loop walk), bitwise, across ladder rungs
+    AND within-rung sizes (1 vs 31 pad onto the same rung; 33/100/512
+    span three more) — integer accumulation cannot regroup.  int8
+    identity rides test_fused_multiclass_and_sparse_bitwise."""
+    X, _ = messy
+    fused = serve.Predictor(bst, raw_score=True, quantize="int16",
+                            traverse="fused")
+    unfused = serve.Predictor(bst, raw_score=True, quantize="int16",
+                              traverse="unfused")
+    assert fused.plan.traverse_mode == "fused"
+    assert unfused.plan.traverse_mode == "unfused"
+    for n in (1, 31, 33, 100, 512):
+        np.testing.assert_array_equal(fused.predict(X[:n]),
+                                      unfused.predict(X[:n]))
+
+
+def test_fused_multiclass_and_sparse_bitwise():
+    sp = pytest.importorskip("scipy.sparse")
+    rng = np.random.RandomState(9)
+    X = rng.randn(900, 7) * (rng.rand(900, 7) < 0.4)
+    X[rng.rand(900, 7) < 0.05] = np.nan
+    y = rng.randint(0, 3, 900)
+    b3 = lgb.train({"objective": "multiclass", "num_class": 3,
+                    "num_leaves": 7, "verbosity": -1},
+                   lgb.Dataset(X, label=y), 4)
+    kw = dict(raw_score=True, quantize="int8")
+    fused = serve.Predictor(b3, traverse="fused", **kw)
+    unfused = serve.Predictor(b3, traverse="unfused", **kw)
+    np.testing.assert_array_equal(fused.predict(X[:200]),
+                                  unfused.predict(X[:200]))
+    Xs = sp.csr_matrix(np.nan_to_num(X[:200]))
+    np.testing.assert_array_equal(fused.predict(Xs), unfused.predict(Xs))
+
+
+def test_microbatcher_composes_with_quantized_fused(messy, bst):
+    """The quantized/fused plan rides the whole serving stack: coalesced
+    microbatcher requests resolve to exactly what direct predicts
+    return (plan-cache hit reuses the ladder-pinned programs)."""
+    X, _ = messy
+    pred = serve.Predictor(bst, raw_score=True, quantize="int16",
+                           traverse="fused")
+    ref = pred.predict(X[:30])
+    mb = pred.batcher(max_batch=32, max_wait_ms=20)
+    futs = [mb.submit(X[i:i + 3]) for i in range(0, 30, 3)]
+    got = np.concatenate([f.result(timeout=60) for f in futs])
+    mb.close()
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_traverse_gates_and_degrade(messy, bst):
+    """fused without a quantized pack degrades (warn + reason); auto off
+    TPU stays unfused; the VMEM layout gate is monotone in pack size."""
+    from lightgbm_tpu.ops.pallas_traverse import (traverse_layout,
+                                                  traverse_layout_fits)
+    p = serve.Predictor(bst, traverse="fused")           # quantize off
+    assert p.plan.traverse_mode == "unfused"
+    assert "quantized pack" in (p.plan.traverse_degrade or "")
+    p2 = serve.Predictor(bst, quantize="int16")          # auto, CPU
+    assert p2.plan.traverse_mode == "unfused"
+    assert p2.plan.traverse_degrade is None
+    lay = traverse_layout(20, 31, 16, 256)
+    assert lay["fits"] and lay["total_bytes"] > 0
+    assert not traverse_layout_fits(4000, 4096, 2000, 256)
+
+
+# --------------------------------------------- AOT compile cache (restart)
+def test_aot_cache_zero_cold_start(messy, bst, tmp_path):
+    """Simulated restart: second predictor against the warm cache dir
+    loads every rung from disk — zero fresh compiles, bitwise-identical
+    answers, counters visible in the metrics snapshot."""
+    X, _ = messy
+    d = str(tmp_path / "aot")
+    serve.clear_plan_cache()
+    p1 = serve.Predictor(bst, raw_score=True, compile_cache=d)
+    r1 = p1.predict(X[:100])
+    st1 = p1.plan.aot_stats()
+    assert st1["compiles"] >= 1 and st1["hits"] == 0
+    assert p1.plan.compile_count() == st1["compiles"]
+    entries = [f for f in os.listdir(d) if f.endswith(".aot")]
+    assert len(entries) == st1["compiles"]
+    serve.clear_plan_cache()                 # "restart"
+    p2 = serve.Predictor(bst, raw_score=True, compile_cache=d)
+    r2 = p2.predict(X[:100])
+    st2 = p2.plan.aot_stats()
+    assert st2["compiles"] == 0 and st2["hits"] >= 1
+    assert p2.plan.compile_count() == 0      # the zero in zero cold-start
+    np.testing.assert_array_equal(r1, r2)
+    snap = p2.metrics_snapshot()
+    assert snap["aot"]["hits"] >= 1
+    serve.clear_plan_cache()
+
+
+def test_aot_cache_corrupt_entry_rebuilt(messy, bst, tmp_path):
+    """A torn/corrupt frame fails the checksum, is unlinked with a
+    warning and rebuilt from a fresh compile — requests never fail."""
+    X, _ = messy
+    d = str(tmp_path / "aot")
+    serve.clear_plan_cache()
+    p1 = serve.Predictor(bst, raw_score=True, compile_cache=d)
+    r1 = p1.predict(X[:64])
+    name = next(f for f in os.listdir(d) if f.endswith(".aot"))
+    path = os.path.join(d, name)
+    size = os.path.getsize(path)
+    with open(path, "r+b") as fh:
+        fh.truncate(size // 2)
+    serve.clear_plan_cache()
+    p2 = serve.Predictor(bst, raw_score=True, compile_cache=d)
+    r2 = p2.predict(X[:64])
+    st = p2.plan.aot_stats()
+    assert st["compiles"] == 1 and st["cache"]["errors"] >= 1
+    np.testing.assert_array_equal(r1, r2)
+    assert os.path.getsize(path) > size // 2     # rebuilt entry republished
+    serve.clear_plan_cache()
+
+
+def test_aot_cache_sweep_stale(tmp_path):
+    """Hygiene: sweep_stale keeps loadable entries, drops corrupt and
+    version-stale ones."""
+    import pickle
+
+    from lightgbm_tpu.serialization import write_atomic_frame
+    from lightgbm_tpu.serve.compile_cache import CompileCache
+
+    d = str(tmp_path / "aot")
+    cc = CompileCache(d)
+    os.makedirs(d, exist_ok=True)
+    # corrupt frame
+    with open(os.path.join(d, "bad.aot"), "wb") as fh:
+        fh.write(b"not a frame")
+    # version-stale (valid frame, wrong version tag)
+    stale = pickle.dumps(({"versions": {"jax": "0.0.0", "jaxlib": "0.0.0",
+                                        "backend": "cpu"}},
+                          b"", None, None), protocol=4)
+    write_atomic_frame(os.path.join(d, "stale.aot"), stale)
+    res = cc.sweep_stale()
+    assert res == {"kept": 0, "removed": 2}
+    assert not [f for f in os.listdir(d) if f.endswith(".aot")]
+    # loading a missing key is a clean miss
+    assert cc.load("0" * 64) is None
+    assert cc.stats()["misses"] >= 1
+
+
+def test_quantized_plans_coexist_in_cache(messy, bst):
+    """The plan-cache key carries the quantize mode: fp32 and quantized
+    plans of one model are distinct entries (per-tenant pack formats)."""
+    serve.clear_plan_cache()
+    a = serve.plan_for_model(bst._gbdt, quantize="off")
+    b = serve.plan_for_model(bst._gbdt, quantize="int8")
+    c = serve.plan_for_model(bst._gbdt, quantize="int8")
+    assert a is not b and b is c
+    assert serve.cache_stats()["builds"] == 2
+    assert a.identity != b.identity
+    serve.clear_plan_cache()

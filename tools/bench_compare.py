@@ -66,6 +66,17 @@ WATCHED: List[Tuple[str, bool]] = [
     ("peak_hbm_bytes", False),
     ("compile_s", False),
     ("dispatches_per_iter", False),
+    # BENCH_serve blobs (tools/serve_bench.py, ISSUE-12): the serving
+    # trajectory gates on the same machinery — warm QPS, tail latency,
+    # fresh-compile count, resident pack bytes and the zero-cold-start
+    # restart compile count.  n/a on training blobs (and vice versa), so
+    # the two blob families coexist in one trajectory.
+    ("serve_warm_qps", True),
+    ("serve_p50_ms", False),
+    ("serve_p99_ms", False),
+    ("serve_compiles", False),
+    ("serve_plan_bytes", False),
+    ("serve_restart_compiles", False),
 ]
 
 
@@ -135,7 +146,20 @@ def extract_metrics(blob: dict) -> Dict[str, Optional[float]]:
                                     "peak_bytes_in_use")),
         "compile_s": _num(_dig(d, "memory", "compile", "seconds")),
         "dispatches_per_iter": _num(d.get("dispatches_per_iter")),
+        "serve_warm_qps": None, "serve_p50_ms": None,
+        "serve_p99_ms": None, "serve_compiles": None,
+        "serve_plan_bytes": None, "serve_restart_compiles": None,
     }
+    if blob.get("metric") == "BENCH_serve":
+        # serve blobs carry their watched fields top-level
+        # (tools/serve_bench.py); the serve gate only ever compares serve
+        # blobs against serve blobs — everything else stays n/a.
+        out["serve_warm_qps"] = _num(blob.get("warm_qps"))
+        out["serve_p50_ms"] = _num(blob.get("p50_ms"))
+        out["serve_p99_ms"] = _num(blob.get("p99_ms"))
+        out["serve_compiles"] = _num(blob.get("compiles"))
+        out["serve_plan_bytes"] = _num(blob.get("plan_bytes"))
+        out["serve_restart_compiles"] = _num(blob.get("restart_compiles"))
     return out
 
 
@@ -153,8 +177,16 @@ def compare_pair(old: dict, new: dict, max_regress: float,
             rows.append((name, _fmt(vo), _fmt(vn), "-", "n/a"))
             continue
         if vo == 0:
-            rows.append((name, _fmt(vo), _fmt(vn), "-",
-                         "n/a (old is zero)"))
+            if not higher_better and vn > 0:
+                # a lower-is-better metric leaving zero is an infinite-
+                # fraction regression (e.g. restart_compiles 0 -> 3 means
+                # the zero-cold-start guarantee broke) — never skippable.
+                rows.append((name, _fmt(vo), _fmt(vn), "+inf",
+                             "REGRESS (was zero)"))
+                regressed.append(name)
+            else:
+                rows.append((name, _fmt(vo), _fmt(vn), "-",
+                             "n/a (old is zero)" if vn != 0 else "ok"))
             continue
         delta = (vn - vo) / abs(vo)
         # regression = the bad direction: slower / fewer QPS / more bytes

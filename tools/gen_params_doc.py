@@ -83,6 +83,42 @@ _DESCRIPTIONS = {
         "predict batches up to this many rows take the native C++ host "
         "traversal; larger batches go through the compiled serve plan "
         "(docs/SERVING.md); 0 routes everything to the device"),
+    "tpu_serve_quantize": (
+        "quantized serving packs (serve/plan.py + models/tree.py, "
+        "docs/SERVING.md): off|int16|int8 — int16/int8 leaf-value quanta "
+        "+ i16 node arrays + bit-packed categorical masks, ~4x smaller "
+        "resident tree packs (more tenants per chip; serve.plan_bytes "
+        "shrinks accordingly).  Routing decisions stay EXACT (bins and "
+        "thresholds remain integers through the bit-key transform); leaf "
+        "values round within `num_trees * scale / 2` "
+        "(PredictPlan.quantize_error_bound, parity pinned in "
+        "tests/test_serve_quantize.py).  Governs serve.Predictor packs "
+        "ONLY — Booster.predict's internal plan routing pins "
+        "quantize=off, so the training-API predict stays exact fp32 "
+        "regardless of this knob; shapes past the narrow encodings "
+        "(num_leaves/bins/features > 32767) degrade to off with a "
+        "warning"),
+    "tpu_traverse_kernel": (
+        "serving traversal kernel (ops/pallas_traverse.py): "
+        "auto|fused|unfused — fused keeps the whole quantized tree pack "
+        "VMEM-resident and pipelines row blocks through the pallas grid "
+        "(one streamed pass over binned rows vs per-depth XLA gathers); "
+        "int32 quanta accumulation makes fused bitwise-identical to "
+        "unfused UNCONDITIONALLY.  auto = fused on TPU when a quantized "
+        "pack is active and the VMEM fit gate "
+        "(pallas_traverse.traverse_layout) passes; fused = force "
+        "(interpret mode on CPU — tier-1 coverage vehicle, slow; needs "
+        "tpu_serve_quantize != off or it degrades with a warning); "
+        "unfused = always the XLA while-loop walk"),
+    "tpu_serve_compile_cache": (
+        "persistent AOT compile cache for serving programs "
+        "(serve/compile_cache.py): a directory of serialized compiled "
+        "executables in checksummed frames, keyed by plan identity + "
+        "padded batch shape + jax/jaxlib version + backend, so a process "
+        "restart or hot model swap pays ZERO predict compiles "
+        "(BENCH_serve's restart_compiles); corrupt/version-stale entries "
+        "are detected, warned about and rebuilt; '' disables; the "
+        "LIGHTGBM_TPU_SERVE_CACHE_DIR env var overrides"),
     "checkpoint_interval": (
         "atomic training snapshots (resilience/checkpoint.py, "
         "docs/ROBUSTNESS.md) every N committed boosting rounds, emitted at "

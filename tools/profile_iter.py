@@ -242,6 +242,89 @@ def fused_wave_census(rows=4096, features=12, num_leaves=15, leaf_batch=4):
     return out
 
 
+def predict_dispatch_census(rows=2048, features=8, iters=20, calls=6,
+                            num_leaves=15):
+    """Per-predict-call dispatch/host-sync counts for the serve plan,
+    fused (quantized pack + Pallas traversal) vs unfused (ISSUE-12 — the
+    serving twin of the training censuses above).  The whole point of the
+    one-program plan is that EITHER traversal costs exactly one compiled
+    dispatch and one device_get per raw predict call: the fused kernel
+    rides inside the same jitted program, so fusion can never add
+    launches.  The output-transform path (raw_score=False) adds one eager
+    dispatch + one sync — the documented convert-output cost
+    (docs/SERVING.md).  Returns one blob per path, pinned by
+    tests/test_profile_census.py."""
+    import jax
+    import numpy as np
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu import serve
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(rows, features)
+    X[rng.rand(rows, features) < 0.05] = np.nan
+    y = (X[:, 0] + X[:, 1] > 0).astype(np.float64)
+    bst = lgb.train({"objective": "binary", "num_leaves": num_leaves,
+                     "verbosity": -1}, lgb.Dataset(X, label=y), iters)
+    out = []
+    for name, kw in (("unfused", {"quantize": "off",
+                                  "traverse": "unfused"}),
+                     ("fused", {"quantize": "int16",
+                                "traverse": "fused"})):
+        blob = {"path": name}
+        for raw in (True, False):
+            pred = serve.Predictor(bst, raw_score=raw, **kw)
+            plan = pred.plan
+            pred.predict(X[:64])             # compile outside the census
+            counts = {"dispatch": 0, "sync": 0}
+            wrapped = []
+
+            def wrap(obj, attr):
+                fn = getattr(obj, attr)
+
+                def counting(*a, __fn=fn, **k):
+                    counts["dispatch"] += 1
+                    return __fn(*a, **k)
+
+                setattr(obj, attr, counting)
+                wrapped.append((obj, attr, fn))
+
+            # the plan's ONE dispatch seam: every compiled predict launch
+            # (jit or AOT executable alike) goes through _call
+            wrap(plan, "_call")
+            orig_get = jax.device_get
+
+            def counting_get(x):
+                counts["sync"] += 1
+                return orig_get(x)
+
+            jax.device_get = counting_get
+            try:
+                for _ in range(calls):
+                    pred.predict(X[:64])
+            finally:
+                jax.device_get = orig_get
+                for obj, attr, fn in wrapped:
+                    setattr(obj, attr, fn)
+            key = "raw" if raw else "transform"
+            blob[f"dispatches_per_predict_{key}"] = round(
+                counts["dispatch"] / calls, 2)
+            blob[f"host_syncs_per_predict_{key}"] = round(
+                counts["sync"] / calls, 2)
+        blob["quantize"] = kw["quantize"]
+        blob["traverse_active"] = pred.plan.traverse_mode
+        out.append(blob)
+    # The census's plans (device-resident packs) must not stay live past
+    # it: callers may census the process-wide buffer set afterwards, and
+    # a PredictPlan is a reference cycle (jitted closures capture the
+    # plan) — clear the cache AND collect so the packs free now.
+    import gc
+    pred = plan = None
+    serve.clear_plan_cache()
+    gc.collect()
+    return out
+
+
 def census_from_log(path):
     """Dispatch-wait / host-bookkeeping census replayed from a telemetry
     JSONL log's ``train.iter`` events (``tpu_telemetry_log``), so the one
@@ -379,6 +462,15 @@ def main():
               f"hist_dispatches/wave={blob['hist_dispatches_per_wave']} "
               f"(leaf_batch={blob['leaf_batch']}) "
               f"program_dispatches/iter={blob['dispatches_per_iter']}")
+
+    # ---- serve predict path (tpu_traverse_kernel, ISSUE-12) -------------
+    print("predict dispatch census (serve plan, fused vs unfused):")
+    for blob in predict_dispatch_census(rows=min(rows, 8192)):
+        print(f"  {blob['path']:<8} traverse={blob['traverse_active']:<8} "
+              f"dispatches/predict={blob['dispatches_per_predict_raw']} "
+              f"host_syncs/predict={blob['host_syncs_per_predict_raw']} "
+              f"(+transform: {blob['dispatches_per_predict_transform']}/"
+              f"{blob['host_syncs_per_predict_transform']})")
 
 
 if __name__ == "__main__":
