@@ -90,6 +90,15 @@ FUSED_ITERS = int(os.environ.get("BENCH_FUSED_ITERS", 12))
 SERVE_FUSED_CHECK = os.environ.get("BENCH_SERVE_FUSED", "1") == "1"
 SERVE_FUSED_ITERS = int(os.environ.get("BENCH_SERVE_FUSED_ITERS", 12))
 SERVE_FUSED_CALLS = int(os.environ.get("BENCH_SERVE_FUSED_CALLS", 20))
+# Out-of-core streaming rung (ISSUE-13, lightgbm_tpu/stream/): the Higgs
+# shape sharded to disk and trained at a DELIBERATELY tiny
+# tpu_stream_budget_mb, witnessing peak streaming-buffer bytes <= budget
+# (asserted in-rung against the residency accounting), prefetch
+# hit/stall seconds, and s/iter vs the same config in-core.
+STREAM_CHECK = os.environ.get("BENCH_STREAM", "1") == "1"
+STREAM_ITERS = int(os.environ.get("BENCH_STREAM_ITERS", 6))
+STREAM_BUDGET_MB = float(os.environ.get("BENCH_STREAM_BUDGET_MB", 8.0))
+STREAM_LEAVES = int(os.environ.get("BENCH_STREAM_LEAVES", 31))
 
 
 def _pack_eff(iters, pack):
@@ -425,6 +434,94 @@ def run_fused_rung(rows, iters, platform, jax, features=None,
     }
 
 
+def run_stream_rung(rows, iters, platform, jax, features=None,
+                    num_leaves=None, budget_mb=None):
+    """Out-of-core streaming rung (ISSUE-13): the Higgs shape sharded to a
+    disk store and trained through the budget-bounded residency pipeline
+    (``lightgbm_tpu/stream/``, docs/STREAMING.md).  The blob WITNESSES the
+    budget: peak streaming-buffer bytes (residency accounting, the same
+    buffers the live-buffer census sees) must sit under
+    ``tpu_stream_budget_mb`` or the rung refuses to publish.  On CPU the
+    rung also asserts the streamed trees bitwise-equal the in-core run's
+    (on TPU the fp32 guarantee needs rows_block-aligned chunks, so there
+    it reports the flag without asserting); ``s_per_iter`` lands beside
+    the in-core number so the streaming tax is a tracked trajectory
+    metric (tools/bench_compare.py)."""
+    import shutil
+    import tempfile
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.stream import dataset_to_shards, train_streamed
+
+    features = features or FEATURES
+    num_leaves = num_leaves or STREAM_LEAVES
+    budget_mb = budget_mb or STREAM_BUDGET_MB
+    X, y = make_higgs_like(rows, features)
+    params = {"objective": "binary", "num_leaves": num_leaves,
+              "learning_rate": 0.1, "max_bin": 255, "min_data_in_leaf": 0,
+              "min_sum_hessian_in_leaf": 100.0, "metric": "none",
+              "verbosity": -1}
+    tmp = tempfile.mkdtemp(prefix="lgbm_stream_bench_")
+    try:
+        rows_per_shard = max(min(rows // 8, 262144), 4096)
+        ds = lgb.Dataset(X, label=y, params=params, free_raw_data=True)
+        t0 = time.time()
+        store = dataset_to_shards(ds, os.path.join(tmp, "store"),
+                                  rows_per_shard, params=params)
+        build_s = time.time() - t0
+        sp = dict(params, tpu_stream_budget_mb=budget_mb)
+        t0 = time.time()
+        bst = train_streamed(sp, store, num_boost_round=iters)
+        stream_s = time.time() - t0
+        stats = dict(bst._stream_stats)
+        budget_bytes = int(budget_mb * (1 << 20))
+        peak = max(stats["peak_bytes"], stats["goss_resident_bytes"])
+        # the witness: a blob that violated its own budget would be worse
+        # than no blob
+        assert peak <= budget_bytes, (
+            f"stream residency exceeded its budget: {peak} > "
+            f"{budget_bytes} bytes ({stats})")
+        bst2, incore_s = _rung_train(params, dict(X=X, label=y), iters, jax)
+        # _rung_train warms up with ONE extra round before the timed
+        # window — compare the first `iters` trees of both models
+        identical = (
+            bst.model_to_string(num_iteration=iters)
+            .split("\nfeature_importances")[0]
+            == bst2.model_to_string(num_iteration=iters)
+            .split("\nfeature_importances")[0])
+        if platform == "cpu":
+            assert identical, \
+                "streamed trees diverged from in-core on the CPU backend"
+        full_bins_bytes = rows * ((features + 1) // 2
+                                  if stats.get("packed4") else features)
+        return {
+            "rows": rows, "features": features, "iters": iters,
+            "num_leaves": num_leaves, "platform": platform,
+            "budget_mb": budget_mb, "rows_per_shard": rows_per_shard,
+            "shards": store.num_shards,
+            "shard_build_s": round(build_s, 3),
+            "residency": stats["residency"],
+            "chunks": stats["chunks"],
+            "chunk_bytes": stats["chunk_bytes"],
+            "peak_stream_bytes": int(peak),
+            "budget_bytes": budget_bytes,
+            "budget_ok": True,
+            "full_bins_bytes": int(full_bins_bytes),
+            "prefetch_hits": stats["prefetch_hits"],
+            "prefetch_stalls": stats["prefetch_stalls"],
+            "stall_s": stats["stall_s"],
+            "upload_bytes": stats["upload_bytes"],
+            "train_time_s": round(stream_s, 3),
+            "s_per_iter": round(stream_s / iters, 4),
+            "incore_s_per_iter": round(incore_s / iters, 4),
+            "stream_slowdown": round(stream_s / max(incore_s, 1e-9), 2),
+            "row_iters_per_sec": round(rows * iters / stream_s, 1),
+            "bitwise_identical": bool(identical),
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def run_serve_fused_rung(rows, iters, platform, jax, features=None,
                          num_leaves=31, calls=None, max_batch=1024):
     """Quantized-traversal serving rung (ISSUE-12): trains a small model,
@@ -729,7 +826,7 @@ def run_bench(rows, iters):
 
     def emit(quant_rate, predict_stats=None, ltr_stats=None,
              wide_stats=None, goss_stats=None, fused_stats=None,
-             serve_fused_stats=None):
+             serve_fused_stats=None, stream_stats=None):
         print(json.dumps({
             "metric": "binary_255leaves_row_iters_per_sec",
             "value": round(row_iters_per_sec, 1),
@@ -794,6 +891,11 @@ def run_bench(rows, iters):
                 # Quantized-traversal serving rung (ISSUE-12): int8 pack +
                 # fused Pallas traversal + AOT restart — the serving twin.
                 "serve_fused": serve_fused_stats,
+                # Out-of-core streaming rung (ISSUE-13): Higgs shape at a
+                # deliberately tiny tpu_stream_budget_mb — peak streaming
+                # bytes <= budget witnessed in-rung, prefetch stall
+                # seconds, s/iter vs in-core.
+                "stream": stream_stats,
                 "reference": "LightGBM CPU 16t Higgs 10.5Mx28 500it in "
                              "130.094s (docs/Experiments.rst:113)",
             },
@@ -864,6 +966,19 @@ def run_bench(rows, iters):
             serve_fused_stats = {"error": f"{e!r}"[:200]}
         emit(None, predict_stats, ltr_stats, wide_stats, goss_stats,
              fused_stats, serve_fused_stats)
+    stream_stats = None
+    if STREAM_CHECK:
+        try:
+            # per-split full-matrix sweeps make streaming O(num_leaves)
+            # passes per tree — shrink the rung so the blob materializes
+            # even on the CPU fallback
+            stream_stats = run_stream_rung(
+                max(min(rows // 16, 131072), 8192),
+                max(min(STREAM_ITERS, iters), 2), platform, jax)
+        except Exception as e:  # noqa: BLE001
+            stream_stats = {"error": f"{e!r}"[:200]}
+        emit(None, predict_stats, ltr_stats, wide_stats, goss_stats,
+             fused_stats, serve_fused_stats, stream_stats)
 
     quant_rate = None
     if QUANT_CHECK and not QUANTIZED:
@@ -877,7 +992,7 @@ def run_bench(rows, iters):
             quant_rate = f"failed: {e!r}"[:200]
     if quant_rate is not None:
         emit(quant_rate, predict_stats, ltr_stats, wide_stats, goss_stats,
-             fused_stats, serve_fused_stats)
+             fused_stats, serve_fused_stats, stream_stats)
 
 
 def _scan_json(stdout):

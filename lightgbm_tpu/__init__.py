@@ -41,11 +41,11 @@ _PLOT_FNS = ("plot_importance", "plot_metric", "plot_split_value_histogram",
 def __getattr__(name):
     # sklearn wrappers / plotting / serving are imported lazily to keep the
     # base import light.
-    if name == "serve":
+    if name in ("serve", "stream"):
         # importlib (not ``from . import``): the fromlist machinery would
         # re-enter this __getattr__ and recurse.
         import importlib
-        return importlib.import_module(".serve", __name__)
+        return importlib.import_module(f".{name}", __name__)
     if name in ("LGBMModel", "LGBMClassifier", "LGBMRegressor", "LGBMRanker"):
         from . import sklearn as _sk
         return getattr(_sk, name)

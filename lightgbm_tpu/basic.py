@@ -446,6 +446,25 @@ class Dataset:
         self.construct().save_binary(filename)
         return self
 
+    def to_shards(self, path: str, rows_per_shard: Optional[int] = None,
+                  params: Optional[Dict[str, Any]] = None,
+                  resume: bool = False):
+        """Partition the constructed (binned) dataset into a sharded
+        streaming store at ``path`` (lightgbm_tpu/stream/,
+        docs/STREAMING.md): fixed-row-count checksummed shard frames plus
+        a manifest carrying the bin-mapper identity.  Honors
+        ``free_raw_data``: the raw host matrix is released once the
+        binned representation exists, so the store build's host RSS is
+        bounded by binned + one shard instead of raw + binned.  Returns
+        the opened :class:`~.stream.store.ShardedDataset`."""
+        from .config import Config
+        from .stream.store import dataset_to_shards
+        if rows_per_shard is None:
+            rows_per_shard = Config(
+                self._merged_params(params)).tpu_stream_rows_per_shard
+        return dataset_to_shards(self, path, rows_per_shard,
+                                 params=params, resume=resume)
+
     def create_valid(self, data, label=None, weight=None, group=None,
                      init_score=None, params=None) -> "Dataset":
         return Dataset(data, label=label, reference=self, weight=weight,

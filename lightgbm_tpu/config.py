@@ -347,6 +347,32 @@ _PARAMS: List[Tuple[str, Any, Any, Tuple[str, ...], Optional[Tuple[Any, Any]]]] 
     # plus a jax.live_arrays() shape/dtype census per tracked span —
     # O(live buffers) host work per dispatch boundary).
     ("tpu_telemetry_memory", str, "off", ("telemetry_memory",), None),
+    # ---- Out-of-core streaming training (lightgbm_tpu/stream/,
+    # docs/STREAMING.md) ----
+    # Device-byte budget for the streaming residency pipeline: the
+    # host->device chunk double buffer (and the goss-residency compact
+    # slice) must fit inside it; the detail.stream bench rung witnesses
+    # live streaming-buffer bytes <= this budget.  Per-row training state
+    # (scores/gradients/partition, O(N) bytes) is deliberately outside
+    # the budget — it is ~F*itemsize times smaller than the bins matrix
+    # the budget exists to keep off the device.
+    ("tpu_stream_budget_mb", float, 256.0, ("stream_budget_mb",),
+     (0.01, None)),
+    # Residency mode: chunks = every bins pass sweeps budget-bounded
+    # chunks (bitwise-identical trees to in-core training); goss = only
+    # the device-GOSS sampled slice is resident per iteration (compact
+    # gather + one routing sweep; needs data_sample_strategy=goss with
+    # device GOSS, and stochastically-rounded quantized gradients degrade
+    # back to chunks).  auto = chunks.
+    ("tpu_stream_residency", str, "auto", (), None),  # auto|chunks|goss
+    # Default row count per shard file for Dataset.to_shards; smaller
+    # shards give the residency pipeline finer chunking under tight
+    # budgets at the cost of more frames.
+    ("tpu_stream_rows_per_shard", int, 65536, (), (256, None)),
+    # Double-buffered async prefetch: assemble + upload the next chunk
+    # while the current one's dispatches run.  Disable to debug (every
+    # chunk then uploads synchronously, counted as a prefetch stall).
+    ("tpu_stream_prefetch", bool, True, (), None),
 ]
 
 _CANONICAL: Dict[str, Tuple[str, Any, Any, Optional[Tuple[Any, Any]]]] = {}
@@ -396,7 +422,8 @@ def _coerce(name: str, typ: Any, value: Any) -> Any:
                                                       "tpu_traverse_kernel",
                                                       "tpu_health_policy",
                                                       "tpu_telemetry",
-                                                      "tpu_telemetry_memory") \
+                                                      "tpu_telemetry_memory",
+                                                      "tpu_stream_residency") \
             else str(value)
     if typ in ("list_int", "list_float", "list_str"):
         if value is None:

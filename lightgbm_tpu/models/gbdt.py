@@ -574,6 +574,16 @@ class GBDT:
                 self._fg_dev, self._fo_dev)
             grew = arrays.num_leaves > 1
             lv = jnp.where(grew, arrays.leaf_value * shrink, 0.0)
+            # Defined rounding for the score update (docs/STREAMING.md):
+            # without the barrier XLA may (or may not, per surrounding
+            # graph) refuse to materialize lv and instead fuse the shrink
+            # multiply into the gather+add as an FMA — a per-program
+            # 1-ULP coin flip.  The barrier pins the semantics to
+            # "materialized lv, then one exact add per row", the ONE
+            # arithmetic every path (fused/unfused/pack/streamed)
+            # reproduces, which is what makes streamed==in-core bitwise
+            # provable instead of fusion-heuristic-dependent.
+            lv = jax.lax.optimization_barrier(lv)
             arrays = arrays._replace(
                 leaf_value=lv, internal_value=arrays.internal_value * shrink)
             return scores_k + lv[row_leaf], arrays, row_leaf

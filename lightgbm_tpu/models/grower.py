@@ -472,6 +472,49 @@ def wave_fused_for(cfg: GrowerConfig, mesh=None,
     return resolve_impl(cfg.histogram_impl) in ("pallas", "flat")
 
 
+def stream_unsupported_reason(cfg: GrowerConfig, mesh=None) -> Optional[str]:
+    """Why this composition cannot run the out-of-core streaming grower
+    (``lightgbm_tpu/stream/``, docs/STREAMING.md); None = stream-capable.
+    Shared by ``make_grower``'s stream kit, the stream trainer's
+    validation and the tests so they cannot disagree.
+
+    The streaming grower is a host-driven twin of the mask layout: every
+    per-split pass over the bins matrix (partition update + the smaller
+    sibling's histogram) is row-separable, so it runs chunk-by-chunk
+    under a byte budget.  Compositions whose growth step needs
+    NON-row-separable state are excluded:
+
+    - a device mesh: residency is a single-device host->device pipeline
+      (multi-host streaming composes with pre-partitioned shards instead);
+    - voting: local-histogram voting has no global per-leaf histogram to
+      chunk-accumulate into;
+    - EFB bundling: bundle-space decode tables are per-shard-build state
+      the store does not carry (dense streaming shapes don't bundle);
+    - forced splits: ``_apply_forced`` reads arbitrary leaves' resident
+      histograms outside the chunk sweep;
+    - intermediate/advanced monotone: the per-step refresh rescans every
+      leaf, not just the split one;
+    - CEGB / interaction constraints: per-path feature state is updated
+      by ``_children_updates`` variants the kit does not thread.
+    """
+    if mesh is not None:
+        return "device mesh (stream residency is single-device)"
+    if cfg.voting:
+        return "voting-parallel keeps local histograms"
+    if cfg.bundled:
+        return "EFB bundling"
+    if cfg.forced_splits:
+        return "forced splits"
+    if (cfg.mono_intermediate or cfg.mono_advanced) \
+            and cfg.split.has_monotone:
+        return "intermediate/advanced monotone refresh"
+    if cfg.split.use_cegb:
+        return "CEGB penalties"
+    if cfg.interaction_groups:
+        return "interaction constraints"
+    return None
+
+
 def _split_buckets(n: int) -> list:
     """Static slice sizes covering leaf row counts 1..n."""
     sizes = []
@@ -2839,6 +2882,14 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
         else:
             vals = jnp.stack([g, h, in_bag.astype(jnp.float32)], axis=-1)
             scale3 = None
+        # Defined rounding for the histogram inputs (docs/STREAMING.md):
+        # without the barrier XLA may fuse the grad*sample_mask multiply
+        # into the histogram scatter-add as an FMA — a per-program 1-ULP
+        # coin flip the streamed chunk programs cannot replicate (it only
+        # surfaces when the mask is inexact, e.g. GOSS amplification).
+        # Materialized vals make every downstream histogram an adds-only
+        # fold, the one arithmetic all layouts and the stream kit share.
+        vals = jax.lax.optimization_barrier(vals)
         if need_key and split_key is None:
             split_key = jax.random.PRNGKey(0)
         n = grad.shape[0]
@@ -2894,6 +2945,161 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
                 leaf_weight=jnp.where(active, h_leaf, 0.0))
         return tree, row_leaf
 
+    # ------------------------------------------------- streaming grow kit
+    # Chunked histogram accumulation hook (lightgbm_tpu/stream/,
+    # docs/STREAMING.md): the mask-layout growth body decomposed into
+    # jitted pieces whose only full-N inputs are row-separable — a
+    # host-driven driver sweeps bins CHUNKS through ``chunk_root`` /
+    # ``chunk_step`` under a byte budget while the decision state
+    # (``_GrowState``) stays device-resident and O(L).  Every piece reuses
+    # the SAME split/selection/update functions the in-core layouts trace
+    # (_init_state/_root_best/_update_tree/_children_updates/_finish), so
+    # a streamed tree's decisions are the in-core tree's decisions
+    # whenever the chunk-accumulated histogram sums equal the in-core
+    # ones — unconditionally for quantized int32 histograms, and exactly
+    # for fp32 whenever the sums are exactly representable (the same
+    # caveat as the histogram pool and fused wave kernel carry).
+    def _make_stream_kit(num_features: int):
+        reason = stream_unsupported_reason(cfg, mesh)
+        if reason is not None:
+            raise ValueError(f"streaming growth unsupported: {reason}")
+        f = int(num_features)
+        hist_kw = dict(num_bins=HB, impl=cfg.histogram_impl,
+                       rows_block=cfg.rows_block, packed4=cfg.packed4,
+                       features=f if cfg.packed4 else 0)
+
+        def _prep(grad, hess, sample_mask, quant_key=None):
+            """(vals, scale3) for one tree — the exact _grow_impl prologue
+            (GOSS/bagging weights folded, quantized discretization keyed
+            identically), shared so streamed and in-core gradients can
+            never diverge."""
+            g = grad * sample_mask
+            h = hess * sample_mask
+            in_bag = sample_mask > 0.0
+            if cfg.quantized:
+                from ..ops.quantize import (discretize_gradients,
+                                            gradient_scales)
+                if quant_key is None:
+                    quant_key = jax.random.PRNGKey(0)
+                g_scale, h_scale = gradient_scales(
+                    g, h, cfg.num_grad_quant_bins)
+                gq, hq = discretize_gradients(g, h, g_scale, h_scale,
+                                              quant_key,
+                                              cfg.stochastic_rounding)
+                vals = jnp.stack([gq, hq, in_bag.astype(jnp.int8)], axis=-1)
+                scale3 = jnp.stack(
+                    [g_scale, h_scale, jnp.asarray(1.0, jnp.float32)])
+                return jax.lax.optimization_barrier(vals), scale3
+            vals = jnp.stack([g, h, in_bag.astype(jnp.float32)], axis=-1)
+            # same barrier as _grow_impl: histogram inputs materialize,
+            # so chunked folds replay the in-core adds exactly
+            return jax.lax.optimization_barrier(vals), None
+
+        def _chunk_root(acc, bins_c, vals_c, count):
+            """Accumulate one chunk's rows into the root histogram.
+            ``count`` masks the static-shape pad tail: the driver slices
+            ``vals`` from the full device vector, so a short chunk's pad
+            slots alias the NEXT chunk's rows and must contribute zero.
+            ``acc`` seeds the histogram (``init=``), so the cross-chunk
+            fold replays the one-call add order exactly."""
+            valid = jnp.arange(vals_c.shape[0], dtype=jnp.int32) < count
+            vals_c = jnp.where(valid[:, None], vals_c,
+                               jnp.zeros_like(vals_c))
+            return histogram_from_vals(bins_c, vals_c, init=acc, **hist_kw)
+
+        def _sk_init(root_hist, n_rows, scale3=None, meta=None,
+                     feature_mask=None, key=None):
+            # exact _grow_mask root block: per-channel totals from feature
+            # 0's bins, shared root-best scan, stored at leaf 0
+            root_tot = jnp.sum(_scale_hist(root_hist[0:1], scale3)[0],
+                               axis=0)
+            root_g, root_h, root_c = root_tot[0], root_tot[1], root_tot[2]
+            state = _init_state(n_rows, f, root_hist.shape[0], root_hist,
+                                root_g, root_h, root_c, key)
+            state, root_bs = _root_best(state, scale3, meta, feature_mask,
+                                        None, None)
+            return _store_best(state, jnp.asarray(0), root_bs,
+                               jnp.asarray(True))
+
+        def _sk_select(st):
+            """This step's split decision, read from the resident state —
+            the scalars every chunk's partition/histogram pass consumes."""
+            leaf = jnp.argmax(st.best_gain).astype(jnp.int32)
+            new_leaf = st.num_leaves
+            cl = st.best_cl[leaf]
+            cr = st.leaf_count[leaf] - cl
+            small_is_left = cl <= cr
+            target = jnp.where(small_is_left, leaf, new_leaf)
+            return (leaf, new_leaf, st.best_feature[leaf],
+                    st.best_bin[leaf], st.best_default_left[leaf],
+                    st.best_is_cat[leaf], st.best_cat_mask[leaf],
+                    target, small_is_left)
+
+        def _sk_chunk(acc, bins_c, vals_c, row_leaf_c, sel, nan_bins):
+            """One chunk's share of one split: partition update for the
+            chunk's rows + the smaller sibling's partial histogram.  Pad
+            rows carry ``row_leaf == -1`` and contribute nothing."""
+            (leaf, new_leaf, feat, sbin, dleft, scat, cmask,
+             target, _sl) = sel
+            if cfg.packed4:
+                byte = jnp.take(bins_c, feat // 2, axis=1).astype(jnp.int32)
+                col = jnp.where(feat % 2 == 0, byte & 15, (byte >> 4) & 15)
+            else:
+                col = jnp.take(bins_c, feat, axis=1).astype(jnp.int32)
+            is_nan = col == nan_bins[feat]
+            go_left = jnp.where(scat, cmask[col], col <= sbin)
+            go_left = jnp.where(is_nan & ~scat, dleft, go_left)
+            mine = row_leaf_c == leaf
+            row_leaf_c = jnp.where(mine & ~go_left, new_leaf, row_leaf_c)
+            mask = row_leaf_c == target
+            masked = jnp.where(mask[:, None], vals_c,
+                               jnp.zeros_like(vals_c))
+            acc = histogram_from_vals(bins_c, masked, init=acc, **hist_kw)
+            return acc, row_leaf_c
+
+        def _sk_apply(st, sel, hist_small, scale3=None, meta=None,
+                      feature_mask=None):
+            """Execute the selected split from the chunk-accumulated
+            smaller-sibling histogram — the exact mask-layout body tail."""
+            (leaf, new_leaf, _feat, _sbin, _dleft, _scat, _cmask,
+             _target, small_is_left) = sel
+            node = st.num_leaves - 1
+            pg, ph, pc = (st.leaf_sum_grad[leaf], st.leaf_sum_hess[leaf],
+                          st.leaf_count[leaf])
+            gl, hl, cl = st.best_gl[leaf], st.best_hl[leaf], st.best_cl[leaf]
+            gr, hr, cr = pg - gl, ph - hl, pc - cl
+            hist_parent = st.leaf_hist[leaf]
+            hist_big = hist_parent - hist_small
+            hist_left = jnp.where(small_is_left, hist_small, hist_big)
+            hist_right = jnp.where(small_is_left, hist_big, hist_small)
+            tree = _update_tree(st, leaf, new_leaf, node, pg, ph, pc)
+            st = st._replace(tree=tree)
+            return _children_updates(st, leaf, new_leaf, hist_left,
+                                     hist_right, gl, hl, cl, gr, hr, cr,
+                                     meta, feature_mask, None, None, scale3)
+
+        def _sk_probe(st):
+            """(num_leaves, max_gain) — the while-loop condition scalars
+            (the streaming driver's one tiny host sync per split)."""
+            return st.num_leaves, jnp.max(st.best_gain)
+
+        import types
+        return types.SimpleNamespace(
+            prep=jax.jit(_prep),
+            chunk_root=jax.jit(_chunk_root),
+            init=jax.jit(_sk_init),
+            select=jax.jit(_sk_select),
+            chunk_step=jax.jit(_sk_chunk),
+            apply=jax.jit(_sk_apply),
+            probe=jax.jit(_sk_probe),
+            finish=jax.jit(_finish),
+            hist_dtype=(jnp.int32 if cfg.quantized else jnp.float32),
+            hist_shape=(f, HB, 3),
+            max_leaves=L,
+            packed4=cfg.packed4,
+            quantized=cfg.quantized,
+        )
+
     # Telemetry span at the ONE dispatch boundary (telemetry/spans.py):
     # the whole wave loop — histogram build, sibling subtract, split scan,
     # partition — is a single compiled program, so the host-side span
@@ -2917,4 +3123,8 @@ def make_grower(cfg: GrowerConfig, mesh=None, data_axis: str = "data"):
     # lax.scan body that is already under jit; the raw function skips the
     # redundant inner-jit trace (semantics identical — nested jit inlines).
     grow.raw = _grow_impl
+    # Streaming grow kit factory (lightgbm_tpu/stream/): chunked twin of
+    # the mask-layout body, sharing its state/update/scan functions.
+    grow.stream_kit = _make_stream_kit
+    grow.stream_reason = stream_unsupported_reason(cfg, mesh)
     return grow

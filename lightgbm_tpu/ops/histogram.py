@@ -36,6 +36,10 @@ def pack_bins4(bins: jnp.ndarray) -> jnp.ndarray:
     (``src/io/dense_bin.hpp``) packs ROW pairs; packing FEATURE pairs here
     keeps row gathers contiguous, which is what the perm layout streams."""
     n, f = bins.shape
+    if n == 0:
+        # zero-row placeholder (streamed training): reshape(-1) cannot
+        # infer a dimension from an empty array
+        return jnp.zeros((0, (f + 1) // 2), jnp.uint8)
     b = bins.astype(jnp.uint8)
     if f % 2:
         b = jnp.pad(b, ((0, 0), (0, 1)))
@@ -72,6 +76,10 @@ def histogram_onehot(
     rows_block: int = 16384,
     packed4: bool = False,   # bins carry two 4-bit features per byte
     features: int = 0,       # real F when packed4
+    init: Optional[jnp.ndarray] = None,  # seed accumulator (streaming:
+                             # chunk k continues chunk k-1's scan carry, so
+                             # the cross-chunk fold replays the one-call
+                             # block order exactly — docs/STREAMING.md)
 ) -> jnp.ndarray:            # (F, num_bins, 3) f32 — or i32 for int8 vals
     n, cols = bins.shape
     f = features if packed4 else cols
@@ -103,8 +111,9 @@ def histogram_onehot(
                               precision=jax.lax.Precision.HIGHEST)
         return acc + part, None
 
-    init = jnp.zeros((f, num_bins, 3), dtype=acc_dtype)
-    hist, _ = jax.lax.scan(body, init, (bins_blk, vals_blk))
+    acc0 = (jnp.zeros((f, num_bins, 3), dtype=acc_dtype)
+            if init is None else init.astype(acc_dtype))
+    hist, _ = jax.lax.scan(body, acc0, (bins_blk, vals_blk))
     return hist
 
 
@@ -112,7 +121,8 @@ def histogram_onehot(
                                              "features"))
 def histogram_segment(
     bins: jnp.ndarray, vals: jnp.ndarray, *, num_bins: int,
-    packed4: bool = False, features: int = 0
+    packed4: bool = False, features: int = 0,
+    init: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
     """Scatter-add variant (useful on CPU; TPU scatters serialize)."""
     if packed4:
@@ -121,7 +131,8 @@ def histogram_segment(
     integer = jnp.issubdtype(vals.dtype, jnp.integer)
     acc_dtype = jnp.int32 if integer else vals.dtype
     flat_ids = bins.astype(jnp.int32) + jnp.arange(f, dtype=jnp.int32)[None, :] * num_bins
-    hist = jnp.zeros((f * num_bins, 3), dtype=acc_dtype)
+    hist = (jnp.zeros((f * num_bins, 3), dtype=acc_dtype)
+            if init is None else init.astype(acc_dtype).reshape(-1, 3))
     hist = hist.at[flat_ids].add(vals.astype(acc_dtype)[:, None, :])
     return hist.reshape(f, num_bins, 3)
 
@@ -144,28 +155,41 @@ def histogram_from_vals(
     rows_block: int = 16384,
     packed4: bool = False,
     features: int = 0,
+    init: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
-    """Histogram from pre-packed (N, 3) channel values."""
+    """Histogram from pre-packed (N, 3) channel values.
+
+    ``init`` seeds the accumulator (streaming chunk accumulation,
+    docs/STREAMING.md): for the scatter and blockwise-scan impls the
+    seeded per-chunk calls replay the EXACT add sequence of the one-call
+    full-N histogram (chunk k's first add continues chunk k-1's carry),
+    which is what makes streamed fp32 histograms bitwise-equal to in-core
+    ones; the pallas kernel reduces per-chunk then adds the seed (integer
+    quantized histograms stay exact either way)."""
     impl = resolve_impl(impl)
     if impl in ("pallas", "flat", "flat_bf16"):
         from .pallas_histogram import histogram_flat
         if jnp.issubdtype(vals.dtype, jnp.integer):
             # Quantized histograms: s8 x s8 -> s32 on the MXU's double-rate
             # int8 path (reference Int32HistogramSumReducer, bin.h:48-81).
-            return histogram_flat(bins, vals, num_bins=num_bins,
-                                  rows_block=rows_block, dtype="int8",
-                                  packed4=packed4, features=features)
-        return histogram_flat(bins, vals, num_bins=num_bins,
-                              rows_block=rows_block,
-                              dtype="bf16" if impl == "flat_bf16" else "f32",
-                              packed4=packed4, features=features)
+            out = histogram_flat(bins, vals, num_bins=num_bins,
+                                 rows_block=rows_block, dtype="int8",
+                                 packed4=packed4, features=features)
+        else:
+            out = histogram_flat(bins, vals, num_bins=num_bins,
+                                 rows_block=rows_block,
+                                 dtype="bf16" if impl == "flat_bf16"
+                                 else "f32",
+                                 packed4=packed4, features=features)
+        return out if init is None else init + out
     if impl == "onehot":
         return histogram_onehot(bins, vals, num_bins=num_bins,
                                 rows_block=rows_block, packed4=packed4,
-                                features=features)
+                                features=features, init=init)
     if impl == "segment":
         return histogram_segment(bins, vals, num_bins=num_bins,
-                                 packed4=packed4, features=features)
+                                 packed4=packed4, features=features,
+                                 init=init)
     raise ValueError(f"unknown histogram impl: {impl}")
 
 

@@ -48,6 +48,13 @@ class ServeMetrics:
         # scores came back non-finite — answered from the host mirror
         # instead of shipping NaN to a caller.
         self.nan_scores = 0
+        # Hot-swap accounting (docs/STREAMING.md serve handoff):
+        # plan_swaps = stale plans refreshed by the per-request freshness
+        # check (the model mutated under this predictor); model_swaps =
+        # explicit Predictor.swap_model calls (continual retrain/refit
+        # landing without a restart).
+        self.plan_swaps = 0
+        self.model_swaps = 0
         # Registry mirrors resolved ONCE (get-or-create instruments are
         # stable objects with their own locks): the serve hot path pays no
         # table lookup under the registry lock per observation.  Caveat:
@@ -64,6 +71,8 @@ class ServeMetrics:
         self._c_faults = reg.counter("serve.device_faults")
         self._c_fallbacks = reg.counter("serve.host_fallbacks")
         self._c_nan = reg.counter("serve.nan_scores")
+        self._c_plan_swaps = reg.counter("serve.plan_swaps")
+        self._c_model_swaps = reg.counter("serve.model_swaps")
 
     # ------------------------------------------------------------- recording
     def observe_request(self, rows: int, seconds: float) -> None:
@@ -113,6 +122,16 @@ class ServeMetrics:
             self.nan_scores += 1
         self._c_nan.inc()
 
+    def observe_plan_swap(self) -> None:
+        with self._lock:
+            self.plan_swaps += 1
+        self._c_plan_swaps.inc()
+
+    def observe_model_swap(self) -> None:
+        with self._lock:
+            self.model_swaps += 1
+        self._c_model_swaps.inc()
+
     # ------------------------------------------------------------ reporting
     def latency_quantiles_ms(self) -> Dict[str, Optional[float]]:
         with self._lock:
@@ -158,6 +177,8 @@ class ServeMetrics:
                 "device_faults": self.device_faults,
                 "host_fallbacks": self.host_fallbacks,
                 "nan_scores": self.nan_scores,
+                "plan_swaps": self.plan_swaps,
+                "model_swaps": self.model_swaps,
             }
         out.update(self.latency_quantiles_ms())
         out["compiles"] = None if plan is None else plan.compile_count()

@@ -92,7 +92,13 @@ class PredictPlan:
                     "num_leaves/bins/features <= 32767; falling back to "
                     "the fp32 pack")
             else:
-                self._packs = packs
+                # the dequant scale is VALUE-derived (max|leaf|): carry it
+                # as a 0-d device operand so a refit/retrain swap (same
+                # structure, new values) keeps the structural identity —
+                # and the zero-cold-start executables — intact
+                self._packs = [None if p is None
+                               else dict(p, scale=jnp.float32(p["scale"]))
+                               for p in packs]
                 self.quantize_mode = quantize
         if self._packs is None:
             self._stacked = [
@@ -118,16 +124,31 @@ class PredictPlan:
         self.plan_bytes = self.pack_bytes + _pytree_bytes(
             (self._tables, self._nan_bins))
 
-        def _scores(bins):
-            if self._packs is not None:
-                return forest_scores_quantized(
-                    self._packs, bins, self._nan_bins,
-                    fused=self.traverse_mode == "fused",
-                    interpret=self._interpret)
-            return forest_scores(self._stacked, bins, self._nan_bins)
+        # The pack/table arrays ride as jit ARGUMENTS (one device-resident
+        # pytree), not closure constants: the compiled executables then
+        # depend only on SHAPES/dtypes/modes, so a hot-swapped model
+        # version (same architecture, new values) reuses the previous
+        # version's executables — in-process jit cache AND the persistent
+        # AOT cache (structural ``identity``) — paying ZERO cold-start
+        # compiles on swap (docs/STREAMING.md serve handoff).
+        self._arrays, self._static = _partition_arrays(
+            ((self._packs if self._packs is not None else self._stacked),
+             self._tables, self._nan_bins))
+        quantized = self._packs is not None
+        fused = self.traverse_mode == "fused"
+        interp = self._interpret
+        static = self._static
 
-        def _from_bits(hi, lo):
-            return _scores(bin_rows_device(self._tables, hi, lo))
+        def _scores(arrs, bins):
+            packs, _tables, nan_bins = _merge_arrays(arrs, static)
+            if quantized:
+                return forest_scores_quantized(
+                    packs, bins, nan_bins, fused=fused, interpret=interp)
+            return forest_scores(packs, bins, nan_bins)
+
+        def _from_bits(arrs, hi, lo):
+            _packs, tables, _nb = _merge_arrays(arrs, static)
+            return _scores(arrs, bin_rows_device(tables, hi, lo))
 
         # watch_compiles (telemetry/spans.py): each new ladder rung's XLA
         # compile lands as a compile.end event; launches already run
@@ -155,28 +176,38 @@ class PredictPlan:
         if compile_cache:
             from .compile_cache import CompileCache
             self._ccache = CompileCache(compile_cache)
+        # model mutation state at build time (iter_, num_trees,
+        # _pred_version): the Predictor's per-request freshness check
+        # compares the live model against this to hot-swap stale plans
+        self.built_state = (int(model.iter_), int(model.num_trees),
+                            int(getattr(model, "_pred_version", 0)))
 
     # ------------------------------------------------------------- identity
     @property
     def identity(self) -> str:
-        """Content digest of everything the compiled predict programs bake
-        in (pack arrays, bin tables, NaN routing, modes) — the plan half
-        of the AOT cache key.  Two processes serving the same model slice
-        the same way share it; any retrain, re-slice or mode change forks
-        it."""
+        """STRUCTURAL digest of everything the compiled predict programs
+        bake in — shapes/dtypes of every pack/table leaf plus the modes
+        and static metadata; array VALUES are runtime arguments and
+        deliberately not hashed.  That makes the AOT cache key shared
+        across model VERSIONS of the same architecture: a retrain/refit
+        hot-swap loads the previous version's executables from disk
+        (zero cold-start), while a re-slice, shape change, mode change or
+        jax upgrade still forks the key.  Safe because the executables
+        carry no model values — every call passes the plan's own
+        resident arrays."""
         if self._identity is None:
             h = hashlib.sha256()
             h.update(f"{self.num_class}|{self.num_features}|"
                      f"{self.quantize_mode}|{self.traverse_mode}|"
                      f"{self._interpret}".encode())
-            for leaf in jax.tree_util.tree_leaves(
-                    (self._packs if self._packs is not None
-                     else self._stacked, self._tables, self._nan_bins)):
-                if hasattr(leaf, "shape"):
-                    h.update(np.ascontiguousarray(
-                        np.asarray(leaf)).tobytes())
-                else:
-                    h.update(repr(leaf).encode())
+            for leaf in jax.tree_util.tree_leaves(self._arrays):
+                h.update(f"{tuple(leaf.shape)}|{leaf.dtype}".encode())
+            # static metadata (quantized scale excluded by partition? no:
+            # non-array leaves — scale/bits/depth — ARE baked into the
+            # trace, so they stay in the digest)
+            h.update(repr(jax.tree_util.tree_leaves(
+                self._static, is_leaf=lambda x: not isinstance(
+                    x, (dict, list, tuple)))).encode())
             self._identity = h.hexdigest()
         return self._identity
 
@@ -186,7 +217,9 @@ class PredictPlan:
         tolerance (tests/test_serve_quantize.py)."""
         if self._packs is None:
             return 0.0
-        return max((quantize_error_bound(p) for p in self._packs
+        # scale rides as a 0-d device operand (structural identity);
+        # the bound is host-facing — pin it back to a float
+        return max((float(quantize_error_bound(p)) for p in self._packs
                     if p is not None), default=0.0)
 
     # ---------------------------------------------------------- AOT dispatch
@@ -199,13 +232,13 @@ class PredictPlan:
         if self._ccache is None:
             fn = (self._predict_bits if kind == "bits"
                   else self._predict_binned)
-            return fn(*args)
+            return fn(self._arrays, *args)
         key = (kind, int(args[0].shape[0]))
         with self._lock:
             compiled = self._aot.get(key)
         if compiled is None:
             compiled = self._aot_compile(kind, key, args)
-        return compiled(*args)
+        return compiled(self._arrays, *args)
 
     def _aot_compile(self, kind: str, key: tuple, args):
         from .compile_cache import entry_key
@@ -215,7 +248,7 @@ class PredictPlan:
         if fresh:
             jit_fn = self._jit_bits if kind == "bits" else self._jit_binned
             t0 = time.perf_counter()
-            compiled = jit_fn.lower(*args).compile()
+            compiled = jit_fn.lower(self._arrays, *args).compile()
             # compile telemetry (the jit seam can't see AOT compiles):
             # every fresh rung compile lands as a compile.end event with
             # its memory_analysis byte summary, mirroring profile_iter.
@@ -321,6 +354,47 @@ class PredictPlan:
         for m in rungs:
             self.raw_scores(np.zeros((m, self.num_features)))
         return len(rungs)
+
+
+class _ArraySlot:
+    """Sentinel marking 'this leaf lives in the arrays pytree'."""
+
+    __slots__ = ()
+
+    def __repr__(self):
+        return "<array>"
+
+
+_ARRAY = _ArraySlot()
+
+
+def _partition_arrays(obj):
+    """Split a nested pack/table structure into (device arrays pytree,
+    static skeleton).  Arrays become jit ARGUMENTS (uploaded once here);
+    ints/floats/strings stay trace-time constants.  ``_merge_arrays``
+    reassembles the original structure inside the trace."""
+    if isinstance(obj, dict):
+        arrs, stat = {}, {}
+        for k, v in obj.items():
+            arrs[k], stat[k] = _partition_arrays(v)
+        return arrs, stat
+    if isinstance(obj, (list, tuple)):
+        pairs = [_partition_arrays(v) for v in obj]
+        return (type(obj)(p[0] for p in pairs),
+                type(obj)(p[1] for p in pairs))
+    if hasattr(obj, "shape") and hasattr(obj, "dtype"):
+        return jnp.asarray(obj), _ARRAY
+    return None, obj
+
+
+def _merge_arrays(arrs, stat):
+    if stat is _ARRAY:
+        return arrs
+    if isinstance(stat, dict):
+        return {k: _merge_arrays(arrs[k], stat[k]) for k in stat}
+    if isinstance(stat, (list, tuple)):
+        return type(stat)(_merge_arrays(a, s) for a, s in zip(arrs, stat))
+    return stat
 
 
 def _pytree_bytes(tree) -> int:

@@ -113,25 +113,18 @@ class Predictor:
         booster's ``tpu_serve_quantize`` / ``tpu_traverse_kernel`` /
         ``tpu_serve_compile_cache`` knobs for THIS predictor (per-tenant
         pack formats and cache dirs; docs/SERVING.md)."""
-        model = getattr(booster, "_gbdt", booster)
-        if not hasattr(model, "train_data"):
-            raise ValueError(
-                "serve.Predictor needs a dataset-backed booster (training "
-                "Booster or GBDT); a text-loaded model carries no bin "
-                "mappers — retrain or keep its Booster.predict path")
-        if getattr(model, "base_model", None) is not None:
-            raise ValueError(
-                "serve.Predictor does not support continuation boosters "
-                "(base_model); save_model() and retrain, or use "
-                "Booster.predict")
-        if model.cfg.linear_tree:
-            raise ValueError(
-                "serve.Predictor does not support linear trees (leaf "
-                "models need raw-value host math); use Booster.predict")
+        model = self._validate_model(booster)
         if num_iteration is None and getattr(booster, "best_iteration", -1) > 0:
             num_iteration = booster.best_iteration
         self._model = model
         self._raw_score = bool(raw_score)
+        # per-plan option set, kept for freshness-driven rebuilds and
+        # swap_model (the hot-swap paths must resolve the SAME plan the
+        # constructor would)
+        self._ladder = ladder
+        self._quantize = quantize
+        self._traverse = traverse
+        self._compile_cache = compile_cache
         self.plan = plan_for_model(model, num_iteration, start_iteration,
                                    ladder=ladder, quantize=quantize,
                                    traverse=traverse,
@@ -154,10 +147,81 @@ class Predictor:
         self._start_iteration = max(int(start_iteration), 0)
         self._host_mirror_cache = None
 
+    @staticmethod
+    def _validate_model(booster):
+        model = getattr(booster, "_gbdt", booster)
+        if not hasattr(model, "train_data"):
+            raise ValueError(
+                "serve.Predictor needs a dataset-backed booster (training "
+                "Booster or GBDT); a text-loaded model carries no bin "
+                "mappers — retrain or keep its Booster.predict path")
+        if getattr(model, "base_model", None) is not None:
+            raise ValueError(
+                "serve.Predictor does not support continuation boosters "
+                "(base_model); save_model() and retrain, or use "
+                "Booster.predict")
+        if model.cfg.linear_tree:
+            raise ValueError(
+                "serve.Predictor does not support linear trees (leaf "
+                "models need raw-value host math); use Booster.predict")
+        return model
+
     # ------------------------------------------------------------------ API
     @property
     def num_features(self) -> int:
         return self.plan.num_features
+
+    def _maybe_refresh_plan(self) -> None:
+        """Plan freshness (the hot-swap contract, docs/STREAMING.md /
+        docs/SERVING.md): a model mutated since this predictor's plan was
+        built — continued training, rollback, an in-place refit's
+        ``_pred_version`` bump, DART renorm — must never serve the stale
+        pack.  The check is three int compares on the hot path; on
+        mismatch the plan re-resolves through the cache (same option
+        set), counted as ``plan_swaps``."""
+        m = self._model
+        state = (int(m.iter_), int(m.num_trees),
+                 int(getattr(m, "_pred_version", 0)))
+        if state == self.plan.built_state:
+            return
+        plan = plan_for_model(m, self._num_iteration,
+                              self._start_iteration, ladder=self._ladder,
+                              quantize=self._quantize,
+                              traverse=self._traverse,
+                              compile_cache=self._compile_cache)
+        if plan is None:
+            # dataset-level verdicts cannot change mid-flight; defensive
+            raise ValueError("device binning unavailable for this model")
+        if plan is not self.plan:
+            self.plan = plan
+            self.metrics.observe_plan_swap()
+
+    def swap_model(self, booster) -> None:
+        """Land a NEW booster (a continual retrain, a streamed refit) in
+        this RUNNING predictor — no process restart: the plan re-resolves
+        for the new model and, because executables are keyed
+        structurally (same architecture => same AOT entries), the new
+        version pays zero cold-start compiles.  Counted in
+        ``ServeMetrics.model_swaps``; the host fallback mirror resets."""
+        model = self._validate_model(booster)
+        if self._num_iteration is None \
+                and getattr(booster, "best_iteration", -1) > 0:
+            num_iteration = booster.best_iteration
+        else:
+            num_iteration = self._num_iteration
+        plan = plan_for_model(model, num_iteration, self._start_iteration,
+                              ladder=self._ladder, quantize=self._quantize,
+                              traverse=self._traverse,
+                              compile_cache=self._compile_cache)
+        if plan is None:
+            raise ValueError(
+                "device binning cannot reproduce the new model's bin "
+                "mappers exactly; keeping the current model")
+        self._model = model
+        self._num_iteration = num_iteration
+        self.plan = plan
+        self._host_mirror_cache = None
+        self.metrics.observe_model_swap()
 
     def predict(self, X, _record: bool = True,
                 _validated: bool = False) -> np.ndarray:
@@ -169,6 +233,7 @@ class Predictor:
         ``_validated`` skips the Inf-input scan for callers (the
         MicroBatcher) that already door-step-checked every row."""
         t0 = time.perf_counter()
+        self._maybe_refresh_plan()
         sparse = _is_sparse(X)
         if sparse:
             if X.shape[1] != self.plan.num_features:

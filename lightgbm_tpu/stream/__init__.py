@@ -1,0 +1,35 @@
+"""lightgbm_tpu.stream — out-of-core streaming training
+(docs/STREAMING.md).
+
+Train datasets bigger than HBM, retrain continuously, hot-swap the
+result into serving:
+
+- :mod:`.store`: sharded binned store — a constructed dataset
+  partitioned into checksummed atomic frames with a bin-mapper-identity
+  manifest (``Dataset.to_shards()`` / :meth:`ShardedDataset.open`).
+- :mod:`.residency`: the ``tpu_stream_budget_mb``-bounded host->device
+  chunk pipeline with double-buffered async prefetch and no-copy
+  eviction.
+- :mod:`.train`: :func:`train_streamed` — streamed boosting whose trees
+  are bitwise-identical to in-core training (chunked histogram
+  accumulation through the grower's stream kit), plus the
+  gradient-based GOSS residency mode.
+- :mod:`.continual`: :class:`ContinualSession` (ingest -> retrain ->
+  publish into a running Predictor) and :func:`refit_streamed`.
+"""
+
+from .continual import ContinualSession, refit_streamed
+from .residency import ChunkPlan, ResidencyManager, pack_bins4_host
+from .store import (ShardedDataset, ShardManifest, StreamStoreError,
+                    append_rows, bin_identity, dataset_to_shards,
+                    write_store)
+from .train import (StreamDataset, StreamTrainer, base_scores_over_store,
+                    stream_degrade_reason, train_streamed)
+
+__all__ = [
+    "ChunkPlan", "ContinualSession", "ResidencyManager", "ShardManifest",
+    "ShardedDataset", "StreamDataset", "StreamStoreError", "StreamTrainer",
+    "append_rows", "base_scores_over_store", "bin_identity",
+    "dataset_to_shards", "pack_bins4_host", "refit_streamed",
+    "stream_degrade_reason", "train_streamed", "write_store",
+]

@@ -1,0 +1,232 @@
+"""Continual retraining over a shard store, plus streamed refit —
+the product surface that turns the out-of-core trainer into a
+"retrain on the clickstream forever" loop (docs/STREAMING.md):
+
+- :class:`ContinualSession` owns a store + params, ingests raw chunks
+  (binned through the store's FROZEN mappers), retrains either fresh or
+  as an ``init_model`` continuation of the last published model, and
+  hot-swaps the result into a running :class:`~..serve.Predictor`
+  without a process restart (``Predictor.swap_model`` bumps the plan,
+  counted in ServeMetrics; the structural AOT cache key means the new
+  version pays zero cold-start compiles).
+- :func:`refit_streamed` re-leafs an existing model over the store
+  (reference ``GBDT::RefitTree`` semantics) shard-by-shard — the
+  routing passes never materialize the full matrix.
+
+Continuation bookkeeping: a chained booster's raw scores over the store
+are maintained INCREMENTALLY (chain = previous chain + the newest
+model's own trees, routed in bin space with f64 accumulation in the
+same order ``LoadedModel.predict_raw`` folds), so every retrain's init
+fold is bitwise the fold ``engine.train(init_model=...)`` would compute
+— without ever routing the chained base's raw-value trees.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Optional, Union
+
+import numpy as np
+
+from .store import ShardedDataset, append_rows, bin_identity
+from .train import base_scores_over_store, train_streamed
+
+
+def _own_tree_scores(booster, store: ShardedDataset) -> np.ndarray:
+    """f64 raw scores of the booster's OWN trees (base excluded, init
+    scores excluded) over the store, by bin-space routing in
+    iteration-major-per-class order — the chain increment."""
+    g = booster._gbdt
+    k = g.num_class
+    n = store.num_data
+    out = np.zeros((n, k), np.float64)
+    nan_bins = np.asarray(g.train_data.binned.nan_bins)
+    models = g.models
+    iters = min(len(m) for m in models) if models else 0
+    for lo, hi, bins in store.iter_shards():
+        bins = np.asarray(bins)
+        for kk in range(k):
+            for t in range(iters):
+                tree = models[kk][t]
+                leaf = tree.predict_leaf_bins(bins, nan_bins)
+                out[lo:hi, kk] += np.asarray(tree.leaf_value,
+                                             np.float64)[leaf]
+    return out
+
+
+class ContinualSession:
+    """One continuous-retraining loop: a store, a param set, the latest
+    published model, and the chain's raw scores over the store."""
+
+    def __init__(self, store: Union[str, ShardedDataset], params: dict,
+                 model=None):
+        self.store = (store if isinstance(store, ShardedDataset)
+                      else ShardedDataset.open(store))
+        self.params = dict(params)
+        self.model = model
+        self._base_scores: Optional[np.ndarray] = None
+        # serialized-chain cache for ingest(): reparsing the whole chain
+        # per ingested chunk would be O(model size) host work forever
+        self._chain_cache = None
+        if model is not None:
+            self._base_scores = self._chain_scores_full()
+
+    def _chain_scores_full(self) -> np.ndarray:
+        g = self.model._gbdt
+        if getattr(g, "base_model", None) is not None:
+            raise ValueError(
+                "adopting an already-chained booster needs its chain "
+                "scores; start the session before the first continuation "
+                "or retrain fresh once")
+        out = base_scores_over_store(self.model, self.store)
+        return out.reshape(self.store.num_data, -1)
+
+    # --------------------------------------------------------------- ingest
+    def ingest(self, X, y, weight=None) -> ShardedDataset:
+        """Bin a raw chunk through the frozen mappers, append it to the
+        store, and extend the chain scores for the new rows (computed
+        through the serialized chain — the same f64 fold the next
+        retrain's init uses)."""
+        X = np.asarray(X, np.float64)
+        pred = None
+        if self.model is not None:
+            if (self._chain_cache is None
+                    or self._chain_cache[0] is not self.model):
+                from ..serialization import load_model_string
+                self._chain_cache = (self.model, load_model_string(
+                    self.model.model_to_string()))
+            chain = self._chain_cache[1]
+            pred = np.asarray(chain.predict_raw(X), np.float64).reshape(
+                X.shape[0], -1)
+        self.store = append_rows(self.store, X, y, weight=weight)
+        if self._base_scores is not None:
+            if pred is None:
+                pred = np.zeros((X.shape[0],
+                                 self._base_scores.shape[1]))
+            self._base_scores = np.concatenate([self._base_scores, pred])
+        return self.store
+
+    # ---------------------------------------------------------------- train
+    def train(self, num_boost_round: int, continue_training: bool = True,
+              **kwargs):
+        """Retrain over the current store.  ``continue_training=True``
+        boosts on top of the published model (``init_model``
+        continuation: its raw scores fold into the init score and its
+        trees ride along in the saved model); False trains from scratch.
+        The result becomes the session's published model."""
+        if continue_training and self.model is not None:
+            bst = train_streamed(
+                dict(self.params), self.store, num_boost_round,
+                init_model=self.model,
+                init_model_scores=self._base_scores.copy(),
+                **kwargs)
+            self._base_scores = (self._base_scores
+                                 + _own_tree_scores(bst, self.store))
+        else:
+            bst = train_streamed(dict(self.params), self.store,
+                                 num_boost_round, **kwargs)
+            self._base_scores = base_scores_over_store(
+                bst, self.store).reshape(self.store.num_data, -1)
+        self.model = bst
+        return bst
+
+    # ---------------------------------------------------------------- refit
+    def refit(self, decay_rate: float = 0.9):
+        """Re-leaf the published model over the CURRENT store (e.g. after
+        ingesting fresh labels) and publish the result."""
+        if self.model is None:
+            raise ValueError("no model to refit; train first")
+        new_b = refit_streamed(self.model, self.store,
+                               decay_rate=decay_rate)
+        # leaf values changed: the chain scores must be re-derived
+        self._base_scores = base_scores_over_store(
+            new_b, self.store).reshape(self.store.num_data, -1)
+        self.model = new_b
+        return new_b
+
+    # -------------------------------------------------------------- serving
+    def publish(self, predictor) -> None:
+        """Land the published model in a RUNNING predictor — no process
+        restart, no compile storm (the structural AOT key reuses the
+        previous version's cached executables)."""
+        if self.model is None:
+            raise ValueError("no model to publish; train first")
+        predictor.swap_model(self.model)
+
+
+def refit_streamed(booster, store: Union[str, ShardedDataset],
+                   decay_rate: float = 0.9,
+                   label=None, weight=None):
+    """Refit (re-leaf) a booster over a shard store, shard-by-shard —
+    the streaming twin of ``Booster.refit`` (reference ``GBDT::
+    RefitTree`` + ``FitByExistingTree``).  Tree structures are kept;
+    leaf values become ``decay * old + (1 - decay) * shrinkage *
+    leaf_output(sum_grad, sum_hess)`` with the sums accumulated from
+    per-shard routing.  Returns a NEW booster (device ensembles updated
+    too, so serving plans rebuilt from it carry the refit values)."""
+    import jax.numpy as jnp
+
+    from ..refit import _init_objective, _refit_pass
+    if not isinstance(store, ShardedDataset):
+        store = ShardedDataset.open(store)
+    gbdt = booster._gbdt
+    cfg = gbdt.cfg
+    if getattr(gbdt, "base_model", None) is not None:
+        raise ValueError(
+            "refit_streamed cannot re-leaf a chained continuation "
+            "booster (the base model's raw-value trees cannot route "
+            "binned store rows); refit before continuing or keep the "
+            "host refit path")
+    store.assert_compatible(
+        bin_identity(gbdt.train_data.binned.mappers,
+                     gbdt.train_data.binned.max_num_bins),
+        what="the booster's bin mappers")
+    k_cls = gbdt.num_class
+    n = store.num_data
+    nan_bins = np.asarray(gbdt.train_data.binned.nan_bins)
+
+    new_b = copy.copy(booster)
+    new_gbdt = copy.copy(gbdt)
+    new_b._gbdt = new_gbdt
+    new_gbdt.dev_models = [list(m) for m in gbdt.dev_models]
+    new_gbdt._host_cache = [list(m) for m in gbdt._host_cache]
+    # refit rewrites leaves in place on the copy: bump ITS version so any
+    # plan keyed on a recycled id can never serve the old pack
+    new_gbdt._pred_version = int(getattr(gbdt, "_pred_version", 0)) + 1
+    objective = _init_objective(
+        copy.copy(gbdt.objective),
+        store.label if label is None else label,
+        store.weight if weight is None else weight,
+        store.group, cfg)
+
+    def _route_all(tree) -> np.ndarray:
+        leaf = np.empty(n, np.int64)
+        for lo, hi, bins in store.iter_shards():
+            leaf[lo:hi] = tree.predict_leaf_bins(np.asarray(bins),
+                                                 nan_bins)
+        return leaf
+
+    def route(it, k):
+        tree = copy.copy(gbdt.models[k][it])
+        new_gbdt._host_cache[k][it] = tree
+        return (_route_all(tree), tree.num_leaves, tree.shrinkage,
+                np.asarray(tree.leaf_value, np.float64))
+
+    def store_fn(it, k, new_leaf, counts, leaf, gk, hk):
+        tree = new_gbdt._host_cache[k][it]
+        nl = len(new_leaf)
+        tree.leaf_value = tree.leaf_value.copy()
+        tree.leaf_value[:nl] = new_leaf
+        tree.leaf_count = counts[: len(tree.leaf_count)]
+        arrays = new_gbdt.dev_models[k][it]
+        lv = np.zeros(arrays.leaf_value.shape[0], np.float32)
+        lv[:nl] = new_leaf
+        new_gbdt.dev_models[k][it] = arrays._replace(
+            leaf_value=jnp.asarray(lv))
+        return None
+
+    n_iters = min(len(m) for m in gbdt.models) if gbdt.models else 0
+    init_scores = np.asarray(gbdt.init_scores, np.float64)
+    _refit_pass(n, k_cls, n_iters, init_scores, objective, cfg,
+                decay_rate, route, store_fn)
+    return new_b

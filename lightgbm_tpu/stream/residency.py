@@ -1,0 +1,272 @@
+"""Residency manager: the byte-budgeted host->device chunk pipeline that
+turns dataset size into a disk/host problem instead of an HBM problem
+(docs/STREAMING.md; arXiv:2005.09148 chunked host->device out-of-core
+design, arXiv:1806.11248 external-memory pages).
+
+``tpu_stream_budget_mb`` bounds the DEVICE bytes the pipeline may hold:
+chunks (groups of consecutive store shards, padded to one static row
+count so every sweep reuses ONE compiled chunk program) are
+double-buffered — while the consumer's dispatches chew on chunk *i*, a
+worker thread assembles chunk *i+1* on the host (shard concat + optional
+4-bit nibble packing) and starts its H2D copy, so upload time hides
+behind compute.  Eviction is an explicit ``Array.delete()`` the moment
+the consumer moves on — no copy, the buffer is simply dropped — which
+keeps ``live_bytes() <= budget`` at every instant (the invariant the
+``detail.stream`` bench rung witnesses against the live-buffer census).
+
+Telemetry (PR-9 registry + JSONL sink; ``tpu_telemetry=off`` is inert —
+this is all host-side accounting around unchanged compiled programs):
+``stream.prefetch_hits`` / ``stream.prefetch_stalls`` counters,
+``stream.upload_bytes``, a ``stream.stall_s`` histogram, and one
+``stream.chunk`` event per upload with its bytes / wait seconds / hit
+flag, rendered by ``tools/telemetry_report.py --stream``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Iterator, List, Tuple
+
+import numpy as np
+
+from ..telemetry import emit, registry, span
+from .store import ShardedDataset
+
+
+def pack_bins4_host(bins: np.ndarray) -> np.ndarray:
+    """Host-side twin of ``ops.histogram.pack_bins4`` (feature-pair nibble
+    packing) so a packed4 training config uploads HALF the chunk bytes —
+    the packing itself must not require the unpacked chunk on device."""
+    n, f = bins.shape
+    b = bins.astype(np.uint8)
+    if f % 2:
+        b = np.pad(b, ((0, 0), (0, 1)))
+    return (b[:, 0::2] | (b[:, 1::2] << 4))
+
+
+class ChunkPlan:
+    """Static chunking of a store under a byte budget: consecutive shards
+    grouped so one PADDED device chunk fits half the budget (the other
+    half is the prefetched successor)."""
+
+    def __init__(self, store: ShardedDataset, budget_bytes: int,
+                 packed4: bool = False):
+        self.packed4 = bool(packed4)
+        itemsize = store.bins_dtype.itemsize
+        cols = store.num_features
+        if packed4:
+            if itemsize != 1:
+                raise ValueError("packed4 streaming needs uint8 bins")
+            cols = (store.num_features + 1) // 2
+        self.cols = cols
+        self.itemsize = itemsize
+        half = max(int(budget_bytes) // 2, 1)
+        per_shard = [r * cols * itemsize for r in store.manifest.shard_rows]
+        too_big = [i for i, b in enumerate(per_shard) if b > half]
+        if too_big:
+            need = 2 * max(per_shard) / 1e6
+            raise ValueError(
+                f"tpu_stream_budget_mb too small: shard {too_big[0]} is "
+                f"{per_shard[too_big[0]] / 1e6:.1f}MB on device and the "
+                "double-buffered pipeline needs 2 chunks resident — raise "
+                f"the budget past {need:.1f}MB or rebuild the store with "
+                "smaller rows_per_shard")
+        # greedy grouping of consecutive shards under half the budget
+        groups: List[Tuple[int, int]] = []      # [shard_lo, shard_hi)
+        cur_lo, cur_bytes = 0, 0
+        for i, nb in enumerate(per_shard):
+            if cur_bytes and cur_bytes + nb > half:
+                groups.append((cur_lo, i))
+                cur_lo, cur_bytes = i, 0
+            cur_bytes += nb
+        if store.num_shards:
+            groups.append((cur_lo, store.num_shards))
+        self.groups = groups
+        bounds = store._bounds
+        self.row_ranges = [(int(bounds[lo]), int(bounds[hi]))
+                           for lo, hi in groups]
+        # ONE static row count: every chunk pads to the largest, so the
+        # whole sweep reuses a single compiled chunk program
+        self.chunk_rows = max((hi - lo for lo, hi in self.row_ranges),
+                              default=0)
+        self.chunk_bytes = self.chunk_rows * cols * itemsize
+
+    @property
+    def num_chunks(self) -> int:
+        return len(self.groups)
+
+
+class ResidencyManager:
+    """Byte-budgeted, double-buffered chunk sweeps over a shard store."""
+
+    def __init__(self, store: ShardedDataset, budget_bytes: int,
+                 packed4: bool = False, prefetch: bool = True,
+                 mmap: bool = True):
+        self.store = store
+        self.budget_bytes = int(budget_bytes)
+        self.plan = ChunkPlan(store, budget_bytes, packed4=packed4)
+        if 2 * self.plan.chunk_bytes > self.budget_bytes:
+            raise ValueError(
+                f"tpu_stream_budget_mb too small: two "
+                f"{self.plan.chunk_bytes / 1e6:.1f}MB chunks must fit "
+                f"{self.budget_bytes / 1e6:.1f}MB (double buffering); "
+                "raise the budget or shrink rows_per_shard")
+        self.prefetch = bool(prefetch)
+        self.mmap = bool(mmap)
+        self._pool = (ThreadPoolExecutor(max_workers=1,
+                                         thread_name_prefix="lgbm-stream")
+                      if self.prefetch else None)
+        self._lock = threading.Lock()
+        self._live = 0
+        self.peak_bytes = 0
+        self.uploads = 0
+        self.upload_bytes = 0
+        self.prefetch_hits = 0
+        self.prefetch_stalls = 0
+        self.stall_s = 0.0
+        reg = registry()
+        self._c_hits = reg.counter("stream.prefetch_hits")
+        self._c_stalls = reg.counter("stream.prefetch_stalls")
+        self._c_upload = reg.counter("stream.upload_bytes")
+        self._h_stall = reg.histogram("stream.stall_s")
+
+    # ------------------------------------------------------------ assembly
+    def _assemble(self, ci: int) -> np.ndarray:
+        """Host-side chunk: shard concat (+ nibble pack) + static-row pad."""
+        lo_s, hi_s = self.plan.groups[ci]
+        parts = [np.asarray(self.store.shard_bins(i, mmap=self.mmap))
+                 for i in range(lo_s, hi_s)]
+        bins = parts[0] if len(parts) == 1 else np.concatenate(parts)
+        if self.plan.packed4:
+            bins = pack_bins4_host(bins)
+        pad = self.plan.chunk_rows - bins.shape[0]
+        if pad:
+            bins = np.pad(bins, ((0, pad), (0, 0)))
+        return np.ascontiguousarray(bins)
+
+    def _upload(self, ci: int):
+        import jax
+        host = self._assemble(ci)
+        with span("stream/chunk_upload"):
+            arr = jax.device_put(host)
+        nb = int(host.nbytes)
+        with self._lock:
+            self._live += nb
+            self.peak_bytes = max(self.peak_bytes, self._live)
+            self.uploads += 1
+            self.upload_bytes += nb
+        self._c_upload.inc(nb)
+        return arr
+
+    def _release(self, arr) -> None:
+        nb = int(arr.nbytes)
+        try:
+            arr.delete()            # no-copy eviction: drop the buffer
+        except Exception:  # noqa: BLE001 — deleted/donated already
+            pass
+        with self._lock:
+            self._live -= nb
+
+    def live_bytes(self) -> int:
+        with self._lock:
+            return self._live
+
+    # -------------------------------------------------------------- sweeps
+    def sweep(self) -> Iterator[Tuple[int, int, int, object]]:
+        """Yield ``(chunk_index, row_lo, row_hi, device_bins)`` across the
+        store, with the NEXT chunk's host assembly + H2D copy overlapping
+        the consumer's work on the current one.  The yielded buffer is
+        deleted when the consumer advances — do not retain it."""
+        n = self.plan.num_chunks
+        if n == 0:
+            return
+        pending = (self._pool.submit(self._upload, 0) if self._pool
+                   else None)
+        try:
+            for ci in range(n):
+                if pending is not None:
+                    hit = pending.done()
+                    t0 = time.perf_counter()
+                    with span("stream/prefetch_wait"):
+                        arr = pending.result()
+                    pending = None
+                    wait = time.perf_counter() - t0
+                else:
+                    hit = False
+                    t0 = time.perf_counter()
+                    arr = self._upload(ci)
+                    wait = time.perf_counter() - t0
+                with self._lock:
+                    if hit:
+                        self.prefetch_hits += 1
+                    else:
+                        self.prefetch_stalls += 1
+                        self.stall_s += wait
+                (self._c_hits if hit else self._c_stalls).inc()
+                if not hit:
+                    self._h_stall.observe(wait)
+                emit("stream.chunk", chunk=ci, bytes=int(arr.nbytes),
+                     wait_s=round(wait, 6), prefetch_hit=bool(hit))
+                if self._pool is not None and ci + 1 < n:
+                    pending = self._pool.submit(self._upload, ci + 1)
+                lo, hi = self.plan.row_ranges[ci]
+                try:
+                    yield ci, lo, hi, arr
+                finally:
+                    self._release(arr)
+        finally:
+            # a consumer that raises (or closes the generator) mid-sweep
+            # must not leak the in-flight prefetch: drain and release it
+            # so live_bytes() stays truthful and the buffer is dropped
+            # deterministically, not at GC's leisure
+            if pending is not None:
+                try:
+                    self._release(pending.result())
+                except Exception:  # noqa: BLE001 — upload itself failed
+                    pass
+
+    def gather_rows(self, indices: np.ndarray) -> np.ndarray:
+        """Host-side gather of arbitrary rows across shards (the
+        gradient-based GOSS residency mode's sampled-slice fetch).
+        Returns UNPACKED (len(indices), F) bins in the given order."""
+        idx = np.asarray(indices, np.int64)
+        out = np.empty((len(idx), self.store.num_features),
+                       self.store.bins_dtype)
+        bounds = self.store._bounds
+        shard_of = np.searchsorted(bounds, idx, side="right") - 1
+        for si in np.unique(shard_of):
+            sel = np.nonzero(shard_of == si)[0]
+            bins = self.store.shard_bins(int(si), mmap=self.mmap)
+            out[sel] = bins[idx[sel] - bounds[si]]
+        return out
+
+    # ----------------------------------------------------------- lifecycle
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "budget_bytes": self.budget_bytes,
+                "chunks": self.plan.num_chunks,
+                "chunk_rows": self.plan.chunk_rows,
+                "chunk_bytes": self.plan.chunk_bytes,
+                "packed4": self.plan.packed4,
+                "live_bytes": self._live,
+                "peak_bytes": self.peak_bytes,
+                "uploads": self.uploads,
+                "upload_bytes": self.upload_bytes,
+                "prefetch_hits": self.prefetch_hits,
+                "prefetch_stalls": self.prefetch_stalls,
+                "stall_s": round(self.stall_s, 6),
+            }
+
+    def close(self) -> None:
+        if self._pool is not None:
+            self._pool.shutdown(wait=True)
+            self._pool = None
+
+    def __enter__(self) -> "ResidencyManager":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

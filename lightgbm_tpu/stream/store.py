@@ -1,0 +1,435 @@
+"""Sharded binned store: a constructed dataset partitioned into
+fixed-row-count, checksummed, atomically-published shard files — the
+on-disk half of out-of-core streaming training (docs/STREAMING.md).
+
+Layout of a store directory::
+
+    manifest.json   # atomic frame wrapping the JSON manifest (written LAST)
+    meta.npz        # atomic frame wrapping np.savez of the per-row metadata
+                    #   (label/weight/init_score/position), group sizes,
+                    #   monotone constraints, feature names AND the flattened
+                    #   bin mappers (binning.mappers_to_arrays)
+    shard_00000.bins ...   # atomic frames whose payload is the raw C-order
+                    #   bins bytes of that row range — mmap-able at the
+                    #   fixed frame-header offset
+
+Every file rides the PR-6 checksummed atomic frame
+(``serialization.write_atomic_frame``): a torn write or bitrot is
+DETECTED, never deserialized.  The manifest is written last, so a crash
+mid-build (or mid-append) leaves either the previous consistent store or
+the complete new one; shard files not named by the manifest are ignored.
+
+The manifest carries a **bin-mapper identity digest** (sha256 over the
+flattened mapper arrays + the padded bin axis): shards binned under
+different mappers can never mix — ``ShardedDataset.assert_compatible``
+refuses, and :func:`append_rows` re-bins new raw chunks through the
+store's OWN frozen mappers by construction.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import io
+import json
+import os
+from typing import Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from ..serialization import (FRAME_MAGIC, FrameCorruptError, read_frame,
+                             write_atomic_frame)
+from ..utils.log import Log
+
+STORE_VERSION = 1
+MANIFEST_NAME = "manifest.json"
+META_NAME = "meta.npz"
+_HEADER_LEN = len(FRAME_MAGIC) + 8 + 32      # serialization frame header
+
+
+class StreamStoreError(ValueError):
+    """The store is damaged or incompatible (corrupt frame, mapper
+    identity mismatch, torn build)."""
+
+
+def bin_identity(mappers, max_num_bins: int) -> str:
+    """Content digest of the bin mappers — the compatibility key that
+    keeps shards from different binnings apart (manifest ``bin_identity``,
+    checked by :meth:`ShardedDataset.assert_compatible`)."""
+    from ..binning import mappers_to_arrays
+    h = hashlib.sha256()
+    h.update(f"B={int(max_num_bins)}".encode())
+    for key, arr in sorted(mappers_to_arrays(mappers).items()):
+        h.update(key.encode())
+        h.update(np.ascontiguousarray(np.asarray(arr)).tobytes())
+    return h.hexdigest()
+
+
+def _shard_name(i: int) -> str:
+    return f"shard_{i:05d}.bins"
+
+
+def _write_shard(path: str, bins_rows: np.ndarray) -> None:
+    write_atomic_frame(path, np.ascontiguousarray(bins_rows).tobytes())
+
+
+def _meta_payload(**arrays) -> bytes:
+    buf = io.BytesIO()
+    np.savez(buf, **{k: v for k, v in arrays.items() if v is not None})
+    return buf.getvalue()
+
+
+@dataclasses.dataclass
+class ShardManifest:
+    version: int
+    bin_identity: str
+    num_rows: int
+    num_features: int
+    bins_dtype: str              # "uint8" | "uint16"
+    max_num_bins: int
+    shard_rows: List[int]        # row count per shard, in order
+    shards: List[str]            # shard file names, in order
+    has_weight: bool = False
+    has_init_score: bool = False
+    has_group: bool = False
+    has_position: bool = False
+    init_score_cols: int = 1
+
+    def to_json(self) -> bytes:
+        return json.dumps(dataclasses.asdict(self), sort_keys=True).encode()
+
+    @classmethod
+    def from_json(cls, payload: bytes) -> "ShardManifest":
+        d = json.loads(payload.decode())
+        if int(d.get("version", -1)) != STORE_VERSION:
+            raise StreamStoreError(
+                f"unsupported store version {d.get('version')!r} "
+                f"(this build reads version {STORE_VERSION})")
+        return cls(**d)
+
+
+def write_store(path: str, td, rows_per_shard: int,
+                resume: bool = False) -> "ShardedDataset":
+    """Partition a constructed ``TrainData`` into a shard store at
+    ``path``.  With ``resume=True`` existing shard files that validate
+    (length + checksum) are kept — the corrupt-frame fallback: a torn or
+    bit-rotted shard from an interrupted build is detected and REWRITTEN
+    instead of aborting or silently shipping garbage."""
+    b = td.binned
+    n, f = b.num_data, b.num_features
+    rows_per_shard = max(int(rows_per_shard), 1)
+    os.makedirs(path, exist_ok=True)
+    shard_rows, names = [], []
+    reused = 0
+    for i, lo in enumerate(range(0, max(n, 1), rows_per_shard)):
+        hi = min(lo + rows_per_shard, n)
+        name = _shard_name(i)
+        fp = os.path.join(path, name)
+        rows = b.bins[lo:hi]
+        if resume and os.path.exists(fp):
+            try:
+                payload = read_frame(fp)
+                if payload == np.ascontiguousarray(rows).tobytes():
+                    shard_rows.append(hi - lo)
+                    names.append(name)
+                    reused += 1
+                    continue
+                raise FrameCorruptError(f"{fp}: stale content")
+            except FrameCorruptError as e:
+                Log.warning(f"stream store: rewriting shard {name} ({e})")
+        _write_shard(fp, rows)
+        shard_rows.append(hi - lo)
+        names.append(name)
+    if reused:
+        Log.info(f"stream store: kept {reused} valid existing shard(s)")
+    from ..binning import mappers_to_arrays
+    init_score = td.init_score
+    iscols = 1
+    if init_score is not None:
+        init_score = np.asarray(init_score, np.float64).reshape(n, -1)
+        iscols = init_score.shape[1]
+    meta = _meta_payload(
+        label=np.asarray(td.label),
+        weight=td.weight, init_score=init_score, group=td.group,
+        position=td.position, monotone=td.monotone_constraints,
+        feature_names=(np.asarray(td.feature_names)
+                       if td.feature_names else None),
+        **mappers_to_arrays(b.mappers))
+    write_atomic_frame(os.path.join(path, META_NAME), meta)
+    manifest = ShardManifest(
+        version=STORE_VERSION,
+        bin_identity=bin_identity(b.mappers, b.max_num_bins),
+        num_rows=n, num_features=f, bins_dtype=str(b.bins.dtype),
+        max_num_bins=int(b.max_num_bins),
+        shard_rows=shard_rows, shards=names,
+        has_weight=td.weight is not None,
+        has_init_score=td.init_score is not None,
+        has_group=td.group is not None,
+        has_position=td.position is not None,
+        init_score_cols=iscols)
+    # manifest last: a crash anywhere above leaves the previous
+    # consistent generation (or no store), never a torn one
+    write_atomic_frame(os.path.join(path, MANIFEST_NAME),
+                       manifest.to_json())
+    return ShardedDataset.open(path)
+
+
+def dataset_to_shards(dataset, path: str, rows_per_shard: int = 65536,
+                      params: Optional[dict] = None,
+                      resume: bool = False) -> "ShardedDataset":
+    """``Dataset.to_shards`` implementation: construct (bin) the dataset,
+    write the store, and honor ``free_raw_data`` — the raw host feature
+    matrix (f64, ~8x the binned bytes at max_bin<=256) is dropped as soon
+    as the binned representation exists, so the store build's host RSS is
+    bounded by the binned matrix + one raw chunk, not raw + binned
+    (pinned via MemoryTracker.host_peak_rss_mb in tests/test_stream.py)."""
+    td = dataset.construct(params)
+    if getattr(dataset, "free_raw_data", False):
+        # bounded-RSS contract: only the binned representation is needed
+        # from here on — the raw matrix would otherwise sit in RSS for
+        # the whole shard sweep (and the Dataset's lifetime)
+        dataset.data = np.zeros((0, td.num_features))
+        td.raw = None
+    return write_store(path, td, rows_per_shard, resume=resume)
+
+
+class ShardedDataset:
+    """Read handle for a shard store: manifest + per-row metadata resident
+    on the host, bins fetched shard-by-shard (optionally memory-mapped) —
+    the full binned matrix never materializes here."""
+
+    def __init__(self, path: str, manifest: ShardManifest, meta: dict):
+        from ..binning import mappers_from_arrays
+        self.path = path
+        self.manifest = manifest
+        self.mappers = mappers_from_arrays(meta)
+        self.label = np.asarray(meta["label"])
+        self.weight = meta.get("weight")
+        self.group = meta.get("group")
+        self.position = meta.get("position")
+        self.monotone = meta.get("monotone")
+        init = meta.get("init_score")
+        self.init_score = None if init is None else np.asarray(init)
+        names = meta.get("feature_names")
+        self.feature_names = (None if names is None
+                              else [str(x) for x in names])
+        self._bounds = np.concatenate(
+            [[0], np.cumsum(manifest.shard_rows)]).astype(np.int64)
+        if self._bounds[-1] != manifest.num_rows:
+            raise StreamStoreError(
+                f"{path}: manifest shard rows sum to {self._bounds[-1]}, "
+                f"expected {manifest.num_rows}")
+        if len(self.label) > manifest.num_rows:
+            # append_rows publishes meta BEFORE the manifest: a crash
+            # between the two leaves an orphaned metadata tail exactly
+            # like orphaned shard files — the manifest is the authority,
+            # so slice the per-row columns back to the consistent store
+            # (the crash contract: previous generation, never a brick)
+            Log.warning(
+                f"{path}: metadata carries {len(self.label)} rows but the "
+                f"manifest names {manifest.num_rows} — dropping the "
+                "orphaned tail of an interrupted append")
+            nr = manifest.num_rows
+            self.label = self.label[:nr]
+            if self.weight is not None:
+                self.weight = self.weight[:nr]
+            if self.position is not None:
+                self.position = self.position[:nr]
+            if self.init_score is not None:
+                self.init_score = self.init_score[:nr]
+        if len(self.label) != manifest.num_rows:
+            raise StreamStoreError(
+                f"{path}: metadata rows ({len(self.label)}) != manifest "
+                f"rows ({manifest.num_rows})")
+
+    # ------------------------------------------------------------- opening
+    @classmethod
+    def open(cls, path: str) -> "ShardedDataset":
+        mp = os.path.join(path, MANIFEST_NAME)
+        if not os.path.exists(mp):
+            raise StreamStoreError(
+                f"{path!r} is not a shard store (no {MANIFEST_NAME}; an "
+                "interrupted build leaves no manifest by design — rebuild "
+                "with Dataset.to_shards)")
+        manifest = ShardManifest.from_json(read_frame(mp))
+        meta_payload = read_frame(os.path.join(path, META_NAME))
+        with np.load(io.BytesIO(meta_payload), allow_pickle=False) as d:
+            meta = {k: d[k] for k in d.files}
+        return cls(path, manifest, meta)
+
+    # ------------------------------------------------------------ geometry
+    @property
+    def num_data(self) -> int:
+        return self.manifest.num_rows
+
+    @property
+    def num_features(self) -> int:
+        return self.manifest.num_features
+
+    @property
+    def num_shards(self) -> int:
+        return len(self.manifest.shards)
+
+    @property
+    def bins_dtype(self) -> np.dtype:
+        return np.dtype(self.manifest.bins_dtype)
+
+    @property
+    def bin_identity(self) -> str:
+        return self.manifest.bin_identity
+
+    def shard_bounds(self, i: int) -> Tuple[int, int]:
+        return int(self._bounds[i]), int(self._bounds[i + 1])
+
+    def shard_nbytes(self, i: int) -> int:
+        return (self.manifest.shard_rows[i] * self.num_features
+                * self.bins_dtype.itemsize)
+
+    def assert_compatible(self, other_identity: str, what: str = "shards"
+                          ) -> None:
+        if other_identity != self.bin_identity:
+            raise StreamStoreError(
+                f"{self.path}: {what} were binned under different bin "
+                "mappers (identity mismatch) — shards from different "
+                "binnings can never mix; rebin through this store's "
+                "mappers (stream.append_rows does)")
+
+    # ------------------------------------------------------------- reading
+    def shard_bins(self, i: int, mmap: bool = True) -> np.ndarray:
+        """One shard's (rows_i, F) bins.  ``mmap=True`` maps the payload
+        at the fixed frame-header offset (lazy page-in, validated by
+        length); ``mmap=False`` reads + sha256-validates the full frame.
+        Any damage raises :class:`FrameCorruptError` — upstream callers
+        (residency, refit) surface it with the shard path so the operator
+        can rebuild with ``to_shards(resume=True)``."""
+        fp = os.path.join(self.path, self.manifest.shards[i])
+        rows = self.manifest.shard_rows[i]
+        shape = (rows, self.num_features)
+        if not mmap:
+            payload = read_frame(fp)
+            arr = np.frombuffer(payload, dtype=self.bins_dtype)
+            if arr.size != rows * self.num_features:
+                raise FrameCorruptError(
+                    f"{fp}: payload holds {arr.size} values, expected "
+                    f"{rows * self.num_features}")
+            return arr.reshape(shape)
+        expect = rows * self.num_features * self.bins_dtype.itemsize
+        if os.path.getsize(fp) != _HEADER_LEN + expect:
+            raise FrameCorruptError(
+                f"{fp}: truncated shard ({os.path.getsize(fp)} bytes, "
+                f"expected {_HEADER_LEN + expect})")
+        return np.memmap(fp, dtype=self.bins_dtype, mode="r",
+                         offset=_HEADER_LEN, shape=shape)
+
+    def iter_shards(self, mmap: bool = True
+                    ) -> Iterator[Tuple[int, int, np.ndarray]]:
+        """Yield ``(row_lo, row_hi, bins)`` per shard in row order."""
+        for i in range(self.num_shards):
+            lo, hi = self.shard_bounds(i)
+            yield lo, hi, self.shard_bins(i, mmap=mmap)
+
+    def verify(self) -> List[int]:
+        """Checksum-validate every shard; returns the corrupt indices."""
+        bad = []
+        for i in range(self.num_shards):
+            try:
+                self.shard_bins(i, mmap=False)
+            except (FrameCorruptError, OSError):
+                bad.append(i)
+        return bad
+
+    # ------------------------------------------------------ binned metadata
+    def binned_meta(self):
+        """A zero-row :class:`~..binning.BinnedData` carrying this store's
+        mappers and padded-bin metadata — everything the grower/serve
+        paths need except the matrix itself (which streams)."""
+        from ..binning import BinnedData
+        b = BinnedData.from_prebinned(
+            np.zeros((0, self.num_features), self.bins_dtype), self.mappers)
+        if b.max_num_bins != self.manifest.max_num_bins:
+            raise StreamStoreError(
+                f"{self.path}: mapper bin axis {b.max_num_bins} != "
+                f"manifest {self.manifest.max_num_bins}")
+        return b
+
+
+def append_rows(store: ShardedDataset, X: np.ndarray, label: np.ndarray,
+                weight: Optional[np.ndarray] = None,
+                init_score: Optional[np.ndarray] = None
+                ) -> ShardedDataset:
+    """Continual-ingest append: bin raw rows through the store's FROZEN
+    mappers and publish them as new shards (manifest rewritten last, so a
+    crash leaves the previous consistent store).  Metadata columns the
+    store carries must keep arriving (and vice versa) — a half-weighted
+    dataset would silently change loss semantics mid-stream."""
+    from ..binning import BinnedData, _bin_full_matrix, mappers_to_arrays
+    m = store.manifest
+    X = np.asarray(X, np.float64)
+    if X.ndim != 2 or X.shape[1] != store.num_features:
+        raise ValueError(
+            f"append_rows expects (N, {store.num_features}) raw rows, "
+            f"got {X.shape}")
+    label = np.asarray(label).ravel()
+    if len(label) != X.shape[0]:
+        raise ValueError(
+            f"append_rows: {X.shape[0]} rows but {len(label)} labels")
+    if not np.isfinite(label).all():
+        raise ValueError("append_rows: labels must be finite")
+    if m.has_group:
+        raise StreamStoreError(
+            "append_rows cannot extend a ranking store (query-grouped "
+            "rows need whole-query ingest; rebuild the store instead)")
+    if m.has_position:
+        raise StreamStoreError(
+            "append_rows cannot extend a store with per-row positions "
+            "(unbiased-LTR side data); rebuild the store instead")
+    if m.has_weight != (weight is not None):
+        raise ValueError(
+            "append_rows: weight must be supplied exactly when the store "
+            f"carries weights (store has_weight={m.has_weight})")
+    if m.has_init_score != (init_score is not None):
+        raise ValueError(
+            "append_rows: init_score must be supplied exactly when the "
+            f"store carries one (store has_init_score={m.has_init_score})")
+    bins = _bin_full_matrix(X, store.mappers, store.bins_dtype)
+    # fresh shard files (never overwrite live ones)
+    i0 = store.num_shards
+    rows_per = max(m.shard_rows) if m.shard_rows else len(bins)
+    new_names, new_rows = [], []
+    for j, lo in enumerate(range(0, len(bins), max(rows_per, 1))):
+        hi = min(lo + rows_per, len(bins))
+        name = _shard_name(i0 + j)
+        _write_shard(os.path.join(store.path, name), bins[lo:hi])
+        new_names.append(name)
+        new_rows.append(hi - lo)
+    new_init = None
+    iscols = m.init_score_cols
+    if m.has_init_score:
+        old = np.asarray(store.init_score, np.float64).reshape(
+            m.num_rows, -1)
+        add = np.asarray(init_score, np.float64).reshape(len(bins), -1)
+        if add.shape[1] != old.shape[1]:
+            raise ValueError(
+                f"append_rows: init_score has {add.shape[1]} columns, "
+                f"store carries {old.shape[1]}")
+        new_init = np.concatenate([old, add])
+        iscols = new_init.shape[1]
+    meta = _meta_payload(
+        label=np.concatenate([store.label, label]),
+        weight=(None if not m.has_weight else np.concatenate(
+            [np.asarray(store.weight, np.float32),
+             np.asarray(weight, np.float32).ravel()])),
+        init_score=new_init, group=store.group, position=None,
+        monotone=store.monotone,
+        feature_names=(np.asarray(store.feature_names)
+                       if store.feature_names else None),
+        **mappers_to_arrays(store.mappers))
+    write_atomic_frame(os.path.join(store.path, META_NAME), meta)
+    manifest = dataclasses.replace(
+        m, num_rows=m.num_rows + len(bins),
+        shard_rows=list(m.shard_rows) + new_rows,
+        shards=list(m.shards) + new_names,
+        init_score_cols=iscols)
+    write_atomic_frame(os.path.join(store.path, MANIFEST_NAME),
+                       manifest.to_json())
+    return ShardedDataset.open(store.path)

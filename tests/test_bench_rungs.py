@@ -14,7 +14,7 @@ import pytest
 
 from bench import (_load_watchdog, _probe_backend, _probe_block,
                    run_fused_rung, run_goss_rung, run_ltr_rung,
-                   run_serve_fused_rung, run_wide_rung)
+                   run_serve_fused_rung, run_stream_rung, run_wide_rung)
 
 
 def _assert_hlo_cost(blob):
@@ -138,6 +138,24 @@ def test_serve_fused_rung_blob():
 # --------------------------- watchdog probe block (ISSUE-6 satellite) ----
 PROBE_KEYS = {"verdict", "backend", "devices", "latency_s", "budget_s",
               "error"}
+
+
+def test_stream_rung_blob_budget_witnessed():
+    """The out-of-core streaming rung (ISSUE-13): trains through the
+    budget-bounded residency pipeline, WITNESSES peak streaming bytes <=
+    the budget (asserted in-rung too — a violating blob never publishes),
+    reports the prefetch ledger, and on CPU asserts the streamed trees
+    bitwise-equal the in-core run's."""
+    blob = run_stream_rung(4096, 2, "cpu", jax, features=10, num_leaves=7,
+                           budget_mb=0.25)
+    assert blob["rows"] == 4096 and blob["budget_ok"] is True
+    assert blob["bitwise_identical"] is True
+    assert 0 < blob["peak_stream_bytes"] <= blob["budget_bytes"]
+    assert blob["peak_stream_bytes"] < blob["full_bins_bytes"] \
+        or blob["chunks"] == 1
+    assert blob["prefetch_hits"] + blob["prefetch_stalls"] >= blob["chunks"]
+    assert blob["s_per_iter"] > 0 and blob["incore_s_per_iter"] > 0
+    assert blob["shards"] >= 1 and blob["train_time_s"] > 0
 
 
 def test_probe_block_carries_outer_watchdog_verdict(monkeypatch):

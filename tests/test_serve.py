@@ -357,3 +357,44 @@ def test_plan_invalidation_on_update_and_rollback(messy, bst, monkeypatch):
     bst.update()
     bst.predict(X[:100], raw_score=True)
     assert serve.cache_stats()["builds"] == 4
+
+
+def test_running_predictor_hot_swaps_on_model_mutation(messy):
+    """End-to-end hot-swap (ISSUE-13 satellite): an ALREADY-CONSTRUCTED
+    Predictor must never keep serving a stale pack after its model
+    mutates — continued training, rollback, or a refit.  The plan-cache
+    key tests above only cover plan_for_model; this pins the Predictor's
+    per-request freshness check (the stale-pack hole it closes)."""
+    X, y = messy
+    params = {"objective": "regression", "num_leaves": 7, "verbosity": -1,
+              "min_data_in_leaf": 5}
+    bst = lgb.train(params, lgb.Dataset(X, label=y, params=params),
+                    num_boost_round=3)
+    pred = serve.Predictor(bst, raw_score=True)
+    q = X[:64]
+    out1 = pred.predict(q)
+    np.testing.assert_array_equal(out1, pred.plan.raw_scores(q)[:, 0])
+    assert pred.metrics.plan_swaps == 0
+    # continued training on the SAME booster object
+    bst.update()
+    out2 = pred.predict(q)
+    assert pred.metrics.plan_swaps == 1
+    assert not np.array_equal(out1, out2)
+    np.testing.assert_array_equal(
+        out2, serve.Predictor(bst, raw_score=True).predict(q))
+    # rollback swaps again (state changed, _pred_version bumped)
+    bst.rollback_one_iter()
+    out3 = pred.predict(q)
+    assert pred.metrics.plan_swaps == 2
+    np.testing.assert_array_equal(out3, out1)
+    # an unchanged model pays NO further swaps (three int compares only)
+    pred.predict(q)
+    assert pred.metrics.plan_swaps == 2
+    # a refit lands via swap_model (new booster object, new leaf values)
+    refit = bst.refit(X, np.asarray(y) + 1.0, decay_rate=0.3)
+    pred.swap_model(refit)
+    out4 = pred.predict(q)
+    assert pred.metrics.model_swaps == 1
+    assert not np.array_equal(out4, out3)
+    snap = pred.metrics_snapshot()
+    assert snap["plan_swaps"] == 2 and snap["model_swaps"] == 1

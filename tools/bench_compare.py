@@ -77,6 +77,15 @@ WATCHED: List[Tuple[str, bool]] = [
     ("serve_compiles", False),
     ("serve_plan_bytes", False),
     ("serve_restart_compiles", False),
+    # detail.stream rung (ISSUE-13, lightgbm_tpu/stream/): the streaming
+    # trajectory — per-iteration wall cost under the budget, prefetch
+    # stall seconds (a pipeline that stops overlapping regresses here
+    # before s/iter moves), and the peak resident streaming bytes (which
+    # leaving its budget is an unconditional regression the rung itself
+    # also refuses to publish).
+    ("stream_s_per_iter", False),
+    ("stream_stall_s", False),
+    ("stream_peak_bytes", False),
 ]
 
 
@@ -149,6 +158,10 @@ def extract_metrics(blob: dict) -> Dict[str, Optional[float]]:
         "serve_warm_qps": None, "serve_p50_ms": None,
         "serve_p99_ms": None, "serve_compiles": None,
         "serve_plan_bytes": None, "serve_restart_compiles": None,
+        "stream_s_per_iter": _num(_dig(d, "stream", "s_per_iter")),
+        "stream_stall_s": _num(_dig(d, "stream", "stall_s")),
+        "stream_peak_bytes": _num(_dig(d, "stream",
+                                       "peak_stream_bytes")),
     }
     if blob.get("metric") == "BENCH_serve":
         # serve blobs carry their watched fields top-level

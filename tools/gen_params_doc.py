@@ -223,6 +223,36 @@ _DESCRIPTIONS = {
         "not steady-state serving).  Replay with "
         "tools/telemetry_report.py --memory; every BENCH blob carries "
         "the detail.memory block tools/bench_compare.py gates on"),
+    "tpu_stream_budget_mb": (
+        "device-byte budget for the out-of-core streaming residency "
+        "pipeline (lightgbm_tpu/stream/, docs/STREAMING.md): the "
+        "host->device chunk double buffer (and the goss-residency "
+        "compact slice) must fit inside it — dataset size becomes a "
+        "disk/host problem instead of an HBM problem.  Per-row training "
+        "state (scores/gradients/partition, O(N) bytes, ~F*itemsize "
+        "smaller than the bins matrix) is deliberately outside the "
+        "budget; the detail.stream bench rung witnesses live "
+        "streaming-buffer bytes <= budget"),
+    "tpu_stream_residency": (
+        "streaming residency mode: chunks (default via auto) sweeps "
+        "budget-bounded chunks through every bins pass — streamed trees "
+        "are BITWISE-identical to in-core training (seeded chunk "
+        "histogram accumulation replays the in-core add order; pinned "
+        "in tests/test_stream.py); goss keeps only the device-GOSS "
+        "sampled slice resident per iteration (compact gather + one "
+        "routing sweep; needs data_sample_strategy=goss with device "
+        "GOSS; stochastically-rounded quantized gradients degrade back "
+        "to chunks with a warning)"),
+    "tpu_stream_rows_per_shard": (
+        "rows per shard file for Dataset.to_shards (stream/store.py): "
+        "smaller shards give the residency pipeline finer chunking "
+        "under tight budgets at the cost of more checksummed frames"),
+    "tpu_stream_prefetch": (
+        "double-buffered async prefetch: assemble + upload the next "
+        "chunk while the current one's dispatches run (upload time "
+        "hides behind compute; stream.prefetch_hits/stalls count the "
+        "overlap).  Disable to debug — every chunk then uploads "
+        "synchronously as a counted stall"),
 }
 
 

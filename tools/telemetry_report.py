@@ -159,6 +159,33 @@ def memory_rows(events: List[dict]) -> List[tuple]:
             for span, a in sorted(per.items())]
 
 
+def stream_rows(events: List[dict]) -> List[tuple]:
+    """Aggregation of ``stream.chunk`` events (ISSUE-13,
+    lightgbm_tpu/stream/residency.py): per-chunk-slot upload count,
+    total uploaded MB, prefetch hit/stall split and total/max wait
+    seconds — the streaming pipeline's health at a glance (a pipeline
+    that stopped overlapping shows up as stalls ~= uploads)."""
+    per: Dict[int, Dict[str, float]] = {}
+    for e in events:
+        if e["kind"] != "stream.chunk":
+            continue
+        agg = per.setdefault(int(e.get("chunk", -1)),
+                             {"n": 0, "bytes": 0, "hits": 0, "stalls": 0,
+                              "wait": 0.0, "max_wait": 0.0})
+        agg["n"] += 1
+        agg["bytes"] += int(e.get("bytes", 0))
+        if e.get("prefetch_hit"):
+            agg["hits"] += 1
+        else:
+            agg["stalls"] += 1
+        w = float(e.get("wait_s", 0.0))
+        agg["wait"] += w
+        agg["max_wait"] = max(agg["max_wait"], w)
+    return [(ci, a["n"], _mb(a["bytes"]), a["hits"], a["stalls"],
+             f"{a['wait']:.4f}", f"{a['max_wait']:.4f}")
+            for ci, a in sorted(per.items())]
+
+
 def compile_rows(events: List[dict]) -> List[tuple]:
     """Per-label aggregation of ``compile.end`` events."""
     per: Dict[str, List[float]] = collections.defaultdict(list)
@@ -198,6 +225,11 @@ def report(path: str, memory: bool = False) -> int:
     inc = incident_rows(events)
     if inc:
         _table("incidents", ("kind", "iter", "detail"), inc)
+    stream = stream_rows(events)
+    if stream:
+        _table("stream chunks (residency pipeline)",
+               ("chunk", "uploads", "MB_total", "hits", "stalls",
+                "wait_s", "max_wait_s"), stream)
     if memory:
         _table("memory watermarks (MB, per span)",
                ("span", "events", "peak_hbm", "hbm_in_use", "live_bufs",
