@@ -275,6 +275,30 @@ _PARAMS: List[Tuple[str, Any, Any, Tuple[str, ...], Optional[Tuple[Any, Any]]]] 
     # Corrupt or version-stale entries are detected (checksummed frames),
     # warned about and rebuilt.
     ("tpu_serve_compile_cache", str, "", ("serve_compile_cache",), None),
+    # ---- Serve request-path observability (ISSUE-14,
+    # docs/OBSERVABILITY.md serve section) ----
+    # Per-request tracing: on = every Predictor.predict / MicroBatcher
+    # request gets a host-side phase breakdown (queue-wait, bin/assemble,
+    # device dispatch, post-process — recorded at dispatch boundaries
+    # only), sampled serve.request JSONL events and a bounded
+    # slow-request exemplar ring in ServeMetrics.snapshot().  off
+    # (default) is bitwise-inert: the compiled predict programs and the
+    # 1-dispatch census are identical (tests/test_serve_tracing.py) —
+    # and armed tracing still adds ZERO device dispatches.
+    ("tpu_serve_request_log", str, "off", (), None),  # off|on
+    # Fraction of traced requests emitting a serve.request event
+    # (deterministic pacing over the request sequence, not random);
+    # requests past tpu_serve_slow_ms are ALWAYS sampled.
+    ("tpu_serve_request_sample", float, 0.01, (), (0.0, 1.0)),
+    # Slow-request threshold (ms): traced requests at/above it bypass
+    # sampling and enter the top-K exemplar ring; 0 disables the
+    # slow override (pure rate sampling, no ring entries).
+    ("tpu_serve_slow_ms", float, 100.0, (), (0.0, None)),
+    # p99 latency SLO target (ms) driving rolling-window SLO-attainment
+    # and error-budget-burn gauges (serve.slo_attainment /
+    # serve.slo_budget_burn) with shed/deadline/fault attribution;
+    # 0 disables SLO accounting.
+    ("tpu_serve_slo_p99_ms", float, 0.0, (), (0.0, None)),
     # ---- Resilience / fault tolerance (docs/ROBUSTNESS.md) ----
     # Atomic training snapshots (resilience/checkpoint.py) every N
     # committed boosting rounds, emitted at iter-pack commit boundaries;
@@ -419,6 +443,7 @@ def _coerce(name: str, typ: Any, value: Any) -> Any:
                                                       "data_sample_strategy", "tpu_histogram_impl",
                                                       "tpu_hist_comm", "tpu_wave_kernel",
                                                       "tpu_serve_quantize",
+                                                      "tpu_serve_request_log",
                                                       "tpu_traverse_kernel",
                                                       "tpu_health_policy",
                                                       "tpu_telemetry",

@@ -20,15 +20,15 @@ Quickstart::
 
 from .bucketing import BucketLadder
 from .compile_cache import CompileCache
-from .metrics import ServeMetrics
+from .metrics import PhaseTrace, RequestTracer, ServeMetrics
 from .plan import (PredictPlan, cache_stats, clear_plan_cache,
                    plan_for_model)
 from .predictor import (MicroBatcher, Predictor, ServeDeadlineError,
                         ServeOverloadError)
 
 __all__ = [
-    "BucketLadder", "CompileCache", "MicroBatcher", "PredictPlan",
-    "Predictor", "ServeDeadlineError", "ServeMetrics",
-    "ServeOverloadError", "cache_stats", "clear_plan_cache",
-    "plan_for_model",
+    "BucketLadder", "CompileCache", "MicroBatcher", "PhaseTrace",
+    "PredictPlan", "Predictor", "RequestTracer", "ServeDeadlineError",
+    "ServeMetrics", "ServeOverloadError", "cache_stats",
+    "clear_plan_cache", "plan_for_model",
 ]

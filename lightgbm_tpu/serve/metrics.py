@@ -1,34 +1,209 @@
 """Serving metrics: per-request latency, batch size, queue depth, plan
 cache hits and compile counts — the gauges a serving process exports.
 
-Pure host-side bookkeeping (a lock, two bounded reservoirs, a handful of
-counters); nothing here touches the device, so observing a request costs
-nanoseconds next to the dispatch it measures.
+Pure host-side bookkeeping (a lock, bounded buckets/reservoirs, a handful
+of counters); nothing here touches the device, so observing a request
+costs nanoseconds next to the dispatch it measures.
 
 Unified telemetry (docs/OBSERVABILITY.md): every observation ALSO mirrors
 into the process-wide registry (``lightgbm_tpu.telemetry.registry()``)
 under ``serve.*`` names, so one scrape of the registry sees training,
 resilience and serving together; :meth:`ServeMetrics.render_prometheus`
 answers a Prometheus scrape from one call.
+
+Request-path observability (ISSUE-14):
+
+- **Per-tenant dimensions** — a :class:`ServeMetrics` built with
+  ``model="name"`` additionally publishes LABELED registry series
+  (``serve.requests{model="name"}``, per-tenant latency histogram, shed /
+  deadline counters), so a multi-Booster process's scrape distinguishes
+  tenants instead of aliasing them into one counter set.
+- **Full-run percentiles** — p50/p99/p999 come from fixed log-spaced
+  bucket counts over EVERY request this process served (the registry
+  ``Histogram``), not the trailing 4096-observation deque the original
+  scheme measured; the mean stays exact (sum/count).
+- **Per-request tracing** (:class:`RequestTracer`) — host-side phase
+  breakdown (queue-wait / bin+assemble / device dispatch / post-process)
+  recorded at dispatch boundaries only, deterministic-paced sampled
+  ``serve.request`` JSONL events (slow requests always sample), and a
+  bounded top-K slow-request exemplar ring surfaced in
+  :meth:`ServeMetrics.snapshot`.  Off by default and bitwise-inert.
+- **SLO accounting** — ``tpu_serve_slo_p99_ms`` arms rolling-window
+  SLO-attainment and error-budget-burn gauges with violation attribution
+  (latency / shed / deadline / fault).
 """
 
 from __future__ import annotations
 
+import math
 import threading
+import time
 from collections import deque
 from typing import Dict, Optional
 
 import numpy as np
 
 from ..telemetry import registry, render_prometheus
+from ..telemetry.events import emit as _emit_event
+from ..telemetry.registry import Histogram
+
+# phases a request trace decomposes into, in wall order
+TRACE_PHASES = ("queue_wait", "assemble", "dispatch", "post")
+
+# slow-request exemplar ring capacity (top-K by total latency)
+SLOW_RING_SIZE = 16
+
+# rolling SLO window (seconds): attainment/burn gauges cover requests
+# inside this trailing window, so a recovered incident stops burning
+_SLO_WINDOW_S = 300.0
+
+_SLO_CAUSES = ("latency", "shed", "deadline", "fault")
+
+
+class PhaseTrace:
+    """Host-side per-request phase marks.  ``mark(name)`` attributes the
+    wall time since the previous mark (or construction) to ``name`` —
+    pure ``perf_counter`` arithmetic at dispatch boundaries, never inside
+    a traced program."""
+
+    __slots__ = ("_t", "phases")
+
+    def __init__(self):
+        self._t = time.perf_counter()
+        self.phases: Dict[str, float] = {}
+
+    def mark(self, name: str) -> None:
+        now = time.perf_counter()
+        self.phases[name] = self.phases.get(name, 0.0) + (now - self._t)
+        self._t = now
+
+
+class RequestTracer:
+    """Sampling ``serve.request`` emitter + slow-request exemplar ring +
+    per-phase latency histograms for ONE predictor.
+
+    Armed by ``tpu_serve_request_log=on``; when off (default) every hot
+    path bails on one attribute read and the predict path is
+    bitwise-inert (pinned).  Sampling is DETERMINISTIC over the request
+    sequence — request ``n`` samples iff ``floor((n+1)*rate)`` crosses an
+    integer boundary — so a fixed request stream emits the same event set
+    every run; requests at/above ``slow_ms`` bypass the rate and also
+    enter the bounded top-K exemplar ring (latency-sorted, with phase
+    breakdown and batch context)."""
+
+    def __init__(self, *, armed: bool = False, sample: float = 0.01,
+                 slow_ms: float = 100.0, model: Optional[str] = None,
+                 ring_size: int = SLOW_RING_SIZE):
+        self.armed = bool(armed)
+        self.sample = float(sample)
+        self.slow_ms = float(slow_ms)
+        self.model = model
+        self.ring_size = int(ring_size)
+        self._lock = threading.Lock()
+        self._n = 0                      # requests traced (the id source)
+        self._ring: list = []            # slow exemplars, desc by total_ms
+        # Private full-run phase histograms (deliberately NOT registry
+        # instruments: phases are per-predictor — two tenants' queue
+        # waits must not blend — and tests/loadgen read them per handle).
+        self._phase_hist = {p: Histogram(f"phase.{p}", threading.Lock())
+                            for p in TRACE_PHASES}
+        self._h_total = Histogram("phase.total", threading.Lock())
+
+    # ------------------------------------------------------------- record
+    def record(self, phases: Dict[str, float], *, rows: int,
+               total_s: float, queue_wait_s: float = 0.0,
+               coalesced: int = 1,
+               batch_rows: Optional[int] = None) -> None:
+        """Record one completed request's phase breakdown.  ``phases``
+        carries the assemble/dispatch/post seconds the dispatch-boundary
+        marks measured (shared by every request of a coalesced batch);
+        ``queue_wait_s`` is this request's own queue time."""
+        if not self.armed:
+            return
+        ph = {"queue_wait": float(queue_wait_s)}
+        for name in ("assemble", "dispatch", "post"):
+            ph[name] = float(phases.get(name, 0.0))
+        for name, v in ph.items():
+            self._phase_hist[name].observe(v)
+        self._h_total.observe(total_s)
+        total_ms = total_s * 1e3
+        slow = self.slow_ms > 0 and total_ms >= self.slow_ms
+        with self._lock:
+            rid = self._n
+            self._n += 1
+            sampled = slow or (
+                math.floor((rid + 1) * self.sample)
+                > math.floor(rid * self.sample))
+            if slow:
+                self._ring_insert_locked({
+                    "req_id": rid, "model": self.model,
+                    "total_ms": round(total_ms, 4),
+                    "rows": int(rows),
+                    "batch_rows": int(batch_rows if batch_rows is not None
+                                      else rows),
+                    "coalesced": int(coalesced),
+                    **{f"{n}_ms": round(v * 1e3, 4)
+                       for n, v in ph.items()},
+                })
+        if sampled:
+            _emit_event(
+                "serve.request", req_id=rid, model=self.model,
+                rows=int(rows),
+                batch_rows=int(batch_rows if batch_rows is not None
+                               else rows),
+                coalesced=int(coalesced), slow=bool(slow),
+                total_s=round(total_s, 6),
+                **{f"{n}_s": round(v, 6) for n, v in ph.items()})
+
+    def _ring_insert_locked(self, entry: Dict) -> None:
+        ring = self._ring
+        ring.append(entry)
+        ring.sort(key=lambda e: -e["total_ms"])
+        del ring[self.ring_size:]
+
+    # ---------------------------------------------------------- reporting
+    def slow_requests(self) -> list:
+        """Top-K slowest traced requests (desc), each with its phase
+        breakdown and batch context — the exemplars a latency incident
+        triages from."""
+        with self._lock:
+            return [dict(e) for e in self._ring]
+
+    def phase_quantiles(self) -> Dict[str, Dict[str, Optional[float]]]:
+        """Full-run per-phase latency distribution (ms): count, mean and
+        bucket-estimated p50/p99 per phase plus the traced total."""
+        out = {}
+        for name, hist in list(self._phase_hist.items()) \
+                + [("total", self._h_total)]:
+            p50, p99 = hist.quantiles((0.5, 0.99))
+            out[name] = {
+                "count": hist.count,
+                "mean_ms": (hist.sum / hist.count * 1e3
+                            if hist.count else None),
+                "p50_ms": None if p50 is None else p50 * 1e3,
+                "p99_ms": None if p99 is None else p99 * 1e3,
+            }
+        return out
 
 
 class ServeMetrics:
     """Thread-safe request/latency/queue accounting for one Predictor."""
 
-    def __init__(self, reservoir: int = 4096):
+    def __init__(self, reservoir: int = 4096, *,
+                 model: Optional[str] = None,
+                 slo_p99_ms: float = 0.0,
+                 slo_window_s: float = _SLO_WINDOW_S,
+                 request_log: bool = False,
+                 request_sample: float = 0.01,
+                 slow_ms: float = 100.0):
         self._lock = threading.Lock()
-        self._latencies = deque(maxlen=reservoir)   # seconds
+        self.model = model
+        # Full-run latency buckets (ISSUE-14): the quantile source for
+        # p50/p99/p999 over EVERY request, not a trailing window; the
+        # deque stays as a bounded raw-value reservoir for exemplars.
+        self._lat_full = Histogram("latency_s", threading.Lock(),
+                                   reservoir=reservoir)
+        self._latencies = deque(maxlen=reservoir)   # seconds (reservoir)
         self._batch_sizes = deque(maxlen=reservoir)
         self.requests = 0
         self.rows = 0
@@ -55,6 +230,19 @@ class ServeMetrics:
         # landing without a restart).
         self.plan_swaps = 0
         self.model_swaps = 0
+        # Per-request tracing (RequestTracer): armed by
+        # tpu_serve_request_log=on, one-attribute-read inert otherwise.
+        self.tracer = RequestTracer(armed=request_log,
+                                    sample=request_sample,
+                                    slow_ms=slow_ms, model=model)
+        # SLO accounting (tpu_serve_slo_p99_ms > 0): a rolling window of
+        # (monotonic_t, ok) verdicts drives the attainment / error-budget
+        # burn gauges; violations attribute to latency/shed/deadline/fault.
+        self.slo_p99_ms = float(slo_p99_ms)
+        self.slo_window_s = float(slo_window_s)
+        self._slo_window: deque = deque()   # (t, ok)
+        self._slo_ok_in_window = 0
+        self._slo_causes = {c: 0 for c in _SLO_CAUSES}
         # Registry mirrors resolved ONCE (get-or-create instruments are
         # stable objects with their own locks): the serve hot path pays no
         # table lookup under the registry lock per observation.  Caveat:
@@ -73,16 +261,44 @@ class ServeMetrics:
         self._c_nan = reg.counter("serve.nan_scores")
         self._c_plan_swaps = reg.counter("serve.plan_swaps")
         self._c_model_swaps = reg.counter("serve.model_swaps")
+        # Labeled per-tenant mirrors (ISSUE-14): model-keyed series so a
+        # multi-Booster scrape separates tenants.  None model = process
+        # totals only (the original single-tenant schema, unchanged).
+        self._t_requests = self._t_rows = None
+        self._t_latency = self._t_shed = self._t_deadline = None
+        if model is not None:
+            lab = {"model": model}
+            self._t_requests = reg.counter("serve.requests", labels=lab)
+            self._t_rows = reg.counter("serve.rows", labels=lab)
+            self._t_latency = reg.histogram("serve.latency_s", labels=lab)
+            self._t_shed = reg.counter("serve.shed", labels=lab)
+            self._t_deadline = reg.counter("serve.deadline_misses",
+                                           labels=lab)
+        self._g_slo_att = self._g_slo_burn = None
+        if self.slo_p99_ms > 0:
+            lab = None if model is None else {"model": model}
+            self._g_slo_att = reg.gauge("serve.slo_attainment", labels=lab)
+            self._g_slo_burn = reg.gauge("serve.slo_budget_burn",
+                                         labels=lab)
 
     # ------------------------------------------------------------- recording
     def observe_request(self, rows: int, seconds: float) -> None:
+        seconds = float(seconds)
         with self._lock:
             self.requests += 1
             self.rows += int(rows)
-            self._latencies.append(float(seconds))
+            self._latencies.append(seconds)
+        self._lat_full.observe(seconds)
         self._c_requests.inc()
         self._c_rows.inc(int(rows))
-        self._h_latency.observe(float(seconds))
+        self._h_latency.observe(seconds)
+        if self._t_requests is not None:
+            self._t_requests.inc()
+            self._t_rows.inc(int(rows))
+            self._t_latency.observe(seconds)
+        if self.slo_p99_ms > 0:
+            ok = seconds * 1e3 <= self.slo_p99_ms
+            self._slo_record(ok, cause=None if ok else "latency")
 
     def observe_batch(self, rows: int, padded_to: int) -> None:
         with self._lock:
@@ -101,16 +317,28 @@ class ServeMetrics:
         with self._lock:
             self.shed += int(requests)
         self._c_shed.inc(int(requests))
+        if self._t_shed is not None:
+            self._t_shed.inc(int(requests))
+        if self.slo_p99_ms > 0:
+            for _ in range(int(requests)):
+                self._slo_record(False, cause="shed")
 
     def observe_deadline_miss(self, requests: int = 1) -> None:
         with self._lock:
             self.deadline_misses += int(requests)
         self._c_deadline.inc(int(requests))
+        if self._t_deadline is not None:
+            self._t_deadline.inc(int(requests))
+        if self.slo_p99_ms > 0:
+            for _ in range(int(requests)):
+                self._slo_record(False, cause="deadline")
 
     def observe_device_fault(self) -> None:
         with self._lock:
             self.device_faults += 1
         self._c_faults.inc()
+        if self.slo_p99_ms > 0:
+            self._slo_record(False, cause="fault")
 
     def observe_host_fallback(self) -> None:
         with self._lock:
@@ -132,16 +360,65 @@ class ServeMetrics:
             self.model_swaps += 1
         self._c_model_swaps.inc()
 
+    # ----------------------------------------------------------------- SLO
+    def _slo_record(self, ok: bool, cause: Optional[str] = None) -> None:
+        """One request verdict into the rolling SLO window; recomputes and
+        publishes the attainment/burn gauges (cheap: deque ops + two
+        divisions under the lock)."""
+        now = time.monotonic()
+        with self._lock:
+            self._slo_window.append((now, ok))
+            if ok:
+                self._slo_ok_in_window += 1
+            elif cause is not None:
+                self._slo_causes[cause] += 1
+            horizon = now - self.slo_window_s
+            win = self._slo_window
+            while win and win[0][0] < horizon:
+                _, was_ok = win.popleft()
+                if was_ok:
+                    self._slo_ok_in_window -= 1
+            total = len(win)
+            att = self._slo_ok_in_window / total if total else None
+        if self._g_slo_att is not None:
+            self._g_slo_att.set(att)
+            # Error budget for a p99 target: 1% of requests may violate.
+            # burn = violation_fraction / 0.01 — burn > 1 means the
+            # window is eating budget faster than the SLO allows.
+            self._g_slo_burn.set(None if att is None
+                                 else (1.0 - att) / 0.01)
+
+    def _slo_block(self) -> Optional[Dict]:
+        if self.slo_p99_ms <= 0:
+            return None
+        with self._lock:
+            total = len(self._slo_window)
+            ok = self._slo_ok_in_window
+            causes = dict(self._slo_causes)
+        return {
+            "target_p99_ms": self.slo_p99_ms,
+            "window_s": self.slo_window_s,
+            "window_requests": total,
+            "attainment": (ok / total) if total else None,
+            "budget_burn": ((1.0 - ok / total) / 0.01) if total else None,
+            "violations": causes,
+        }
+
     # ------------------------------------------------------------ reporting
     def latency_quantiles_ms(self) -> Dict[str, Optional[float]]:
-        with self._lock:
-            lat = np.asarray(self._latencies, np.float64)
-        if lat.size == 0:
-            return {"p50_ms": None, "p99_ms": None, "mean_ms": None}
+        """Full-run latency quantiles (ms) from the log-spaced buckets —
+        the whole process history, not the reservoir window — plus the
+        exact mean (sum/count)."""
+        hist = self._lat_full
+        if hist.count == 0:
+            return {"p50_ms": None, "p99_ms": None, "p999_ms": None,
+                    "mean_ms": None}
+        p50, p99, p999 = hist.quantiles((0.5, 0.99, 0.999))
         return {
-            "p50_ms": float(np.percentile(lat, 50) * 1e3),
-            "p99_ms": float(np.percentile(lat, 99) * 1e3),
-            "mean_ms": float(lat.mean() * 1e3),
+            "p50_ms": p50 * 1e3,
+            "p99_ms": p99 * 1e3,
+            "p999_ms": p999 * 1e3,
+            "mean_ms": hist.sum / hist.count * 1e3,
         }
 
     def snapshot(self, plan=None) -> Dict:
@@ -153,18 +430,21 @@ class ServeMetrics:
         ``aot``) are always present — ``None`` when no plan was passed
         (and ``aot`` is None without a persistent compile cache) — so
         scrapers and the Prometheus renderer see the same metric set
-        every call.  ``plan_bytes`` is THIS
+        every call.  Likewise ``model``/``slo``/``slow_requests``/
+        ``phases`` are always present: ``None`` for an unlabeled /
+        SLO-less / tracing-off instance.  ``plan_bytes`` is THIS
         plan's resident device bytes (tree pack + bin tables);
         ``plan_cache`` carries the process-global hit/miss counters plus
         ``size`` (entries) and ``bytes`` (resident bytes across every
-        cached plan — the byte totals, not just entry counts, are the
-        admission-control input ROADMAP item 1 consumes,
-        docs/SERVING.md).  Note ``plan_cache`` is PROCESS-GLOBAL: the
-        plan cache is shared by every Predictor and routed
-        ``Booster.predict`` in this process, never per-predictor."""
+        cached plan, with labeled per-tenant ``bytes{model="..."}``
+        entries — the byte totals are the admission-control input ROADMAP
+        item 1 consumes, docs/SERVING.md).  Note ``plan_cache`` is
+        PROCESS-GLOBAL: the plan cache is shared by every Predictor and
+        routed ``Booster.predict`` in this process, never per-predictor."""
         with self._lock:
             bs = np.asarray(self._batch_sizes, np.float64)
             out = {
+                "model": self.model,
                 "requests": self.requests,
                 "rows": self.rows,
                 "batches": self.batches,
@@ -181,6 +461,15 @@ class ServeMetrics:
                 "model_swaps": self.model_swaps,
             }
         out.update(self.latency_quantiles_ms())
+        out["slo"] = self._slo_block()
+        # tracing surfaces (None when the tracer is disarmed — the
+        # tracing-off schema carries the keys either way)
+        if self.tracer.armed:
+            out["slow_requests"] = self.tracer.slow_requests()
+            out["phases"] = self.tracer.phase_quantiles()
+        else:
+            out["slow_requests"] = None
+            out["phases"] = None
         out["compiles"] = None if plan is None else plan.compile_count()
         out["plan_bytes"] = (None if plan is None
                              else int(getattr(plan, "plan_bytes", 0)))
@@ -202,7 +491,10 @@ class ServeMetrics:
                           prefix: str = "lgbm_tpu_serve") -> str:
         """Prometheus text exposition of :meth:`snapshot` — a serving
         process answers a scrape from this one call
-        (docs/OBSERVABILITY.md scrape example)."""
+        (docs/OBSERVABILITY.md scrape example).  A ``model``-labeled
+        instance renders every series with ``{model="..."}`` — two
+        tenants' expositions are disjoint series sets
+        (``lgbm_tpu_serve_requests{model="a"}`` vs ``{model="b"}``)."""
         snap = self.snapshot(plan=plan)
         if snap["plan_cache"] is None:
             # stable exposition even plan-less: the cache counters render
@@ -210,12 +502,22 @@ class ServeMetrics:
             snap["plan_cache"] = {k: None for k in
                                   ("hits", "misses", "builds", "evictions",
                                    "size", "bytes")}
-        # Schema stability both ways: the quantize/traverse strings never
-        # render (the renderer skips non-numerics — they'd appear as NaN
-        # only when plan-less, flapping the series), and the aot block
-        # always carries the FULL counter shape so aot_* series exist on
-        # every scrape whether or not a compile cache is configured.
-        del snap["quantize"], snap["traverse"]
+        # Schema stability both ways: the quantize/traverse/model strings
+        # and the slow-request/phase structures never render (non-numeric
+        # — they'd flap the series set with arming state), and the
+        # slo/aot blocks always carry their FULL numeric shape so the
+        # series exist on every scrape whether or not the feature is on.
+        del snap["quantize"], snap["traverse"], snap["model"]
+        del snap["slow_requests"], snap["phases"]
+        slo = snap["slo"] or {}
+        snap["slo"] = {
+            "target_p99_ms": slo.get("target_p99_ms"),
+            "window_requests": slo.get("window_requests"),
+            "attainment": slo.get("attainment"),
+            "budget_burn": slo.get("budget_burn"),
+            "violations": {c: (slo.get("violations") or {}).get(c)
+                           for c in _SLO_CAUSES},
+        }
         aot = snap["aot"] or {}
         cache = aot.get("cache") or {}
         snap["aot"] = {
@@ -223,7 +525,16 @@ class ServeMetrics:
             "cache": {k: cache.get(k) for k in
                       ("hits", "misses", "stores", "errors")},
         }
-        return render_prometheus(snap, prefix=prefix)
+        labels = None if self.model is None else {"model": self.model}
+        # plan_cache is PROCESS-GLOBAL (shared by every predictor): its
+        # flat counters must NOT carry this tenant's label, or N scraped
+        # tenants render the same global value as N distinct series and
+        # sum() double-counts.  The per-tenant bytes{model=...} entries
+        # inside it carry their OWN correct label.  Everything else in
+        # the snapshot is per-predictor and labels cleanly.
+        plan_cache = snap.pop("plan_cache")
+        return render_prometheus(snap, prefix=prefix, labels=labels) \
+            + render_prometheus({"plan_cache": plan_cache}, prefix=prefix)
 
 
 def plan_cache_stats() -> Dict[str, int]:

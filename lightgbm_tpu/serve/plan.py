@@ -300,6 +300,16 @@ class PredictPlan:
     def is_for(self, model) -> bool:
         return self._model_ref() is model
 
+    @property
+    def tenant(self) -> Optional[str]:
+        """Model label for per-tenant attribution (ISSUE-14): the serve
+        label a named ``Predictor`` stamped on the model, read LIVE so a
+        cached plan follows a late naming.  ``None`` for unnamed models
+        (their bytes attribute to the ``_unnamed`` bucket)."""
+        model = self._model_ref()
+        return None if model is None else getattr(model, "_serve_label",
+                                                  None)
+
     # ------------------------------------------------------------ prediction
     def _pad(self, arrs, n: int):
         padded = self.ladder.bucket(n)
@@ -307,10 +317,14 @@ class PredictPlan:
             return arrs, padded
         return [np.pad(a, ((0, padded - n), (0, 0))) for a in arrs], padded
 
-    def raw_scores(self, X, metrics=None) -> np.ndarray:
+    def raw_scores(self, X, metrics=None, trace=None) -> np.ndarray:
         """(N, K) f64 raw scores (init scores included) for dense rows —
         host work is one bit-split view + ladder pad; binning, traversal
-        and per-class accumulation run as ONE jitted dispatch."""
+        and per-class accumulation run as ONE jitted dispatch.  ``trace``
+        (a ``serve.metrics.PhaseTrace``) marks the assemble/dispatch
+        boundary split — host ``perf_counter`` arithmetic only, the
+        compiled program is identical with or without it (ISSUE-14
+        inertness pin)."""
         X = np.asarray(X)
         n = X.shape[0]
         if X.ndim != 2 or X.shape[1] != self.num_features:
@@ -322,14 +336,19 @@ class PredictPlan:
         hi, lo = float_bits(X)
         (hi, lo), padded = self._pad([hi, lo], n)
         self._note_shape("bits", padded)
+        if trace is not None:
+            trace.mark("assemble")
         scores = self._call("bits", jnp.asarray(hi), jnp.asarray(lo))
         if metrics is not None:
             metrics.observe_batch(n, padded)
         out = np.asarray(jax.device_get(scores), np.float64)[:n]
+        if trace is not None:       # upload + launch + blocking fetch
+            trace.mark("dispatch")
         out += self.init_scores[None, :]
         return out
 
-    def raw_scores_binned(self, bins: np.ndarray, metrics=None) -> np.ndarray:
+    def raw_scores_binned(self, bins: np.ndarray, metrics=None,
+                          trace=None) -> np.ndarray:
         """(N, K) f64 raw scores from PRE-BINNED rows (the sparse-input
         path: host binning straight from CSC, device traversal from the
         resident pack — still no re-stacking)."""
@@ -340,10 +359,14 @@ class PredictPlan:
                 + self.init_scores[None, :]
         (bins,), padded = self._pad([bins], n)
         self._note_shape("binned", padded)
+        if trace is not None:
+            trace.mark("assemble")
         scores = self._call("binned", jnp.asarray(bins))
         if metrics is not None:
             metrics.observe_batch(n, padded)
         out = np.asarray(jax.device_get(scores), np.float64)[:n]
+        if trace is not None:
+            trace.mark("dispatch")
         out += self.init_scores[None, :]
         return out
 
@@ -596,28 +619,60 @@ def _cache_bytes_locked() -> int:
     return sum(p.plan_bytes for p in _CACHE.values())
 
 
+def _cache_bytes_by_tenant_locked() -> Dict[str, int]:
+    """Resident plan-cache bytes grouped by model label (``_unnamed``
+    for label-less models) — ROADMAP item 1's per-tenant admission input
+    (a byte budget can only evict per tenant if the bytes attribute per
+    tenant)."""
+    out: Dict[str, int] = {}
+    for p in _CACHE.values():
+        name = p.tenant or "_unnamed"
+        out[name] = out.get(name, 0) + p.plan_bytes
+    return out
+
+
+# tenant labels whose plan_cache_bytes gauge was ever published: an
+# evicted tenant's gauge drops to 0 instead of lingering at its last value
+_PUBLISHED_TENANTS: set = set()
+
+
 def _publish_bytes_locked() -> None:
     """Byte gauges (docs/OBSERVABILITY.md serve section): the
     most-recently-used cached plan's resident bytes
     (``serve.plan_bytes``, 0 when the cache is empty — an evicted pack's
-    bytes never linger in the gauge) and the cache-wide total
-    (``serve.plan_cache_bytes``) — the admission-control input ROADMAP
-    item 1's eviction-by-bytes will consume."""
+    bytes never linger in the gauge), the cache-wide total
+    (``serve.plan_cache_bytes``) and the per-tenant labeled split
+    (``serve.plan_cache_bytes{model="..."}``) — the admission-control
+    input ROADMAP item 1's eviction-by-bytes will consume."""
     from ..telemetry import registry
     reg = registry()
     mru = next(reversed(_CACHE)) if _CACHE else None
     reg.gauge("serve.plan_bytes").set(
         _CACHE[mru].plan_bytes if mru is not None else 0)
     reg.gauge("serve.plan_cache_bytes").set(_cache_bytes_locked())
+    by_tenant = _cache_bytes_by_tenant_locked()
+    for name in _PUBLISHED_TENANTS - set(by_tenant):
+        reg.gauge("serve.plan_cache_bytes",
+                  labels={"model": name}).set(0)
+    for name, nbytes in by_tenant.items():
+        _PUBLISHED_TENANTS.add(name)
+        reg.gauge("serve.plan_cache_bytes",
+                  labels={"model": name}).set(nbytes)
 
 
 def cache_stats() -> Dict[str, int]:
     """Hit/miss/build/eviction counters plus the live cache footprint:
     ``size`` (entries) AND ``bytes`` (resident device bytes across every
     cached plan — entry counts alone cannot drive byte-budget admission
-    control, docs/SERVING.md)."""
+    control, docs/SERVING.md), with labeled per-tenant
+    ``bytes{model="..."}`` entries that render as labeled Prometheus
+    series."""
+    from ..telemetry.registry import labeled_name
     with _CACHE_LOCK:
-        return dict(_STATS, size=len(_CACHE), bytes=_cache_bytes_locked())
+        out = dict(_STATS, size=len(_CACHE), bytes=_cache_bytes_locked())
+        for name, nbytes in _cache_bytes_by_tenant_locked().items():
+            out[labeled_name("bytes", {"model": name})] = nbytes
+    return out
 
 
 def clear_plan_cache() -> None:

@@ -39,7 +39,7 @@ from ..resilience import faults
 from ..telemetry import span
 from ..utils.log import Log
 from .bucketing import BucketLadder
-from .metrics import ServeMetrics
+from .metrics import PhaseTrace, ServeMetrics
 from .plan import plan_for_model
 
 
@@ -108,12 +108,21 @@ class Predictor:
                  host_fallback: bool = True,
                  quantize: Optional[str] = None,
                  traverse: Optional[str] = None,
-                 compile_cache: Optional[str] = None):
+                 compile_cache: Optional[str] = None,
+                 name: Optional[str] = None):
         """``quantize``/``traverse``/``compile_cache`` override the
         booster's ``tpu_serve_quantize`` / ``tpu_traverse_kernel`` /
         ``tpu_serve_compile_cache`` knobs for THIS predictor (per-tenant
-        pack formats and cache dirs; docs/SERVING.md)."""
+        pack formats and cache dirs; docs/SERVING.md).  ``name`` labels
+        the served model for per-tenant metrics (ISSUE-14): the
+        predictor's registry mirrors and Prometheus exposition gain
+        ``{model="<name>"}`` series, and plan-cache bytes attribute to
+        it — a multi-Booster process should name every tenant."""
         model = self._validate_model(booster)
+        if name is not None:
+            # stamped on the MODEL so cached plans (built by any
+            # predictor/route) attribute their bytes to this tenant
+            model._serve_label = str(name)
         if num_iteration is None and getattr(booster, "best_iteration", -1) > 0:
             num_iteration = booster.best_iteration
         self._model = model
@@ -134,7 +143,23 @@ class Predictor:
                 "device binning cannot reproduce this dataset's bin "
                 "mappers exactly (categorical values >= 2^31); use "
                 "Booster.predict")
-        self.metrics = ServeMetrics()
+        # Request-path observability knobs (ISSUE-14): per-tenant labeled
+        # metrics, SLO accounting and the sampled request tracer all live
+        # on the ServeMetrics; tracing off (default) is bitwise-inert.
+        cfg = model.cfg
+        request_log = str(getattr(cfg, "tpu_serve_request_log",
+                                  "off")).lower()
+        if request_log not in ("on", "off"):
+            raise ValueError(
+                f"tpu_serve_request_log={request_log!r}: expected on or "
+                "off")
+        self.metrics = ServeMetrics(
+            model=getattr(model, "_serve_label", None),
+            slo_p99_ms=float(getattr(cfg, "tpu_serve_slo_p99_ms", 0.0)),
+            request_log=request_log == "on",
+            request_sample=float(getattr(cfg, "tpu_serve_request_sample",
+                                         0.01)),
+            slow_ms=float(getattr(cfg, "tpu_serve_slow_ms", 100.0)))
         self.max_compiles = int(max_compiles)
         self._compile_warned = False
         # One-shot host fallback (docs/ROBUSTNESS.md): the request that
@@ -146,6 +171,11 @@ class Predictor:
         self._num_iteration = num_iteration
         self._start_iteration = max(int(start_iteration), 0)
         self._host_mirror_cache = None
+        # Per-thread in-flight PhaseTrace: threaded to the plan calls
+        # WITHOUT widening the _predict_device seam (tests and the fault
+        # machinery monkeypatch it with the historical (X, sparse)
+        # signature).
+        self._trace_tl = threading.local()
 
     @staticmethod
     def _validate_model(booster):
@@ -224,15 +254,21 @@ class Predictor:
         self.metrics.observe_model_swap()
 
     def predict(self, X, _record: bool = True,
-                _validated: bool = False) -> np.ndarray:
+                _validated: bool = False,
+                _phases_out: Optional[dict] = None) -> np.ndarray:
         """Scores for a batch of rows — one compiled dispatch, recorded in
         the serving metrics.  Accepts dense arrays (device binning) or
         scipy sparse (host binning from CSC, device traversal).  A faulted
         device dispatch is answered once from the host mirror
         (``host_fallback``) instead of failing the request.
         ``_validated`` skips the Inf-input scan for callers (the
-        MicroBatcher) that already door-step-checked every row."""
+        MicroBatcher) that already door-step-checked every row;
+        ``_phases_out`` (MicroBatcher, tracing armed) receives the phase
+        breakdown of this dispatch so the batcher can attribute it to
+        every coalesced caller."""
         t0 = time.perf_counter()
+        tracer = self.metrics.tracer
+        tr = PhaseTrace() if tracer.armed else None
         self._maybe_refresh_plan()
         sparse = _is_sparse(X)
         if sparse:
@@ -254,6 +290,7 @@ class Predictor:
             if not _validated:
                 _reject_inf_rows(X)
             n = X.shape[0]
+        self._trace_tl.current = tr
         try:
             with span("serve/predict"):
                 out = self._predict_device(X, sparse)
@@ -277,12 +314,26 @@ class Predictor:
             if not self._host_fallback:
                 raise
             out = self._predict_host(X, sparse, e)
+        finally:
+            self._trace_tl.current = None
+        if tr is not None:
+            # post-process: output transform, finite check, slicing —
+            # everything after the blocking fetch (or the whole host-
+            # fallback answer when the device path never marked)
+            tr.mark("post")
+            if _phases_out is not None:
+                _phases_out.update(tr.phases)
         if _record:   # the microbatcher records per-CALLER requests itself
-            self.metrics.observe_request(n, time.perf_counter() - t0)
+            dt = time.perf_counter() - t0
+            self.metrics.observe_request(n, dt)
+            if tr is not None:
+                tracer.record(tr.phases, rows=n, total_s=dt,
+                              queue_wait_s=0.0, coalesced=1, batch_rows=n)
         self._check_compile_guard()
         return out
 
     def _predict_device(self, X, sparse: bool) -> np.ndarray:
+        trace = getattr(self._trace_tl, "current", None)
         # fault seam (resilience/faults.py): a wedged or erroring device
         # dispatch enters serving exactly here
         faults.maybe_wedge("serve")
@@ -292,9 +343,11 @@ class Predictor:
                 "(LIGHTGBM_TPU_FAULTS=serve_device_error)")
         if sparse:
             bins = self._model.train_data.binned.apply(X)
-            raw = self.plan.raw_scores_binned(bins, metrics=self.metrics)
+            raw = self.plan.raw_scores_binned(bins, metrics=self.metrics,
+                                              trace=trace)
         else:
-            raw = self.plan.raw_scores(X, metrics=self.metrics)
+            raw = self.plan.raw_scores(X, metrics=self.metrics,
+                                       trace=trace)
         out = raw[:, 0] if self.plan.num_class == 1 else raw
         obj = getattr(self._model, "objective", None)
         if not self._raw_score and obj is not None:
@@ -526,16 +579,21 @@ class MicroBatcher:
             if not batch:
                 return
         xs = [x for x, _f, _t in batch]
+        tracer = self.predictor.metrics.tracer
+        ph: Optional[dict] = {} if tracer.armed else None
+        t_service = time.perf_counter()
         try:
             # _validated: every request was Inf-scanned at submit(), so
             # the coalesced batch skips the redundant second pass
             out = self.predictor.predict(np.concatenate(xs, axis=0),
-                                         _record=False, _validated=True)
+                                         _record=False, _validated=True,
+                                         _phases_out=ph)
         except Exception as e:  # noqa: BLE001 — fail every caller, not the loop
             for _x, fut, _t in batch:
                 self._settle(fut, exc=e)
             return
         done = time.perf_counter()
+        batch_rows = sum(x.shape[0] for x, _f, _t in batch)
         lo = 0
         for x, fut, t_in in batch:
             hi = lo + x.shape[0]
@@ -543,4 +601,12 @@ class MicroBatcher:
                 # queue wait + coalesced dispatch, from the caller's view
                 self.predictor.metrics.observe_request(x.shape[0],
                                                        done - t_in)
+                if ph is not None:
+                    # per-request trace: THIS caller's queue wait plus
+                    # the coalesced dispatch's shared phase breakdown
+                    tracer.record(ph, rows=x.shape[0],
+                                  total_s=done - t_in,
+                                  queue_wait_s=t_service - t_in,
+                                  coalesced=len(batch),
+                                  batch_rows=batch_rows)
             lo = hi

@@ -9,18 +9,28 @@ observations yet, or ``snapshot(plan=None)``'s plan-less counters) render
 as ``NaN`` — a gauge that vanishes between scrapes breaks rate() queries,
 a NaN one does not.  Nested dicts flatten with ``_`` (``plan_cache.hits``
 -> ``<prefix>_plan_cache_hits``).
+
+Labels (ISSUE-14): an instrument key carrying a ``{k="v"}`` suffix
+(``telemetry.registry.labeled_name``) renders as a labeled series —
+``serve.requests{model="a"}`` becomes
+``lgbm_tpu_serve_requests{model="a"}`` — with ONE ``# TYPE`` line per
+metric family; the ``labels=`` argument stamps a label set onto every
+series of a document (how a per-tenant ``ServeMetrics`` renders its whole
+snapshot as that tenant's series).
 """
 
 from __future__ import annotations
 
 import re
-from typing import Dict
+from typing import Dict, Optional
 
 # snapshot keys that are monotonic counts (everything else is a gauge)
 _COUNTER_KEYS = frozenset({
     "requests", "rows", "batches", "padded_rows", "shed", "deadline_misses",
     "device_faults", "host_fallbacks", "nan_scores", "compiles", "hits",
-    "misses", "builds", "evictions",
+    "misses", "builds", "evictions", "plan_swaps", "model_swaps",
+    # SLO violation-attribution leaves (snapshot["slo"]["violations"])
+    "latency", "deadline", "fault",
 })
 
 _NAME_OK = re.compile(r"[^a-zA-Z0-9_]")
@@ -41,17 +51,40 @@ def _flatten(d: Dict, path=()) -> list:
     return out
 
 
-def render_prometheus(snapshot: Dict, prefix: str = "lgbm_tpu_serve") -> str:
+def _merge_labels(inner: str, extra: Optional[Dict[str, str]]) -> str:
+    """Combine a series' own ``k="v"`` label body with document-level
+    labels; a series' own labels win on key clash (no duplicate keys —
+    Prometheus rejects them)."""
+    if not extra:
+        return inner
+    inner_keys = {part.partition("=")[0].strip()
+                  for part in inner.split(",") if part}
+    parts = [f'{k}="{str(v)}"' for k, v in sorted(extra.items())
+             if k not in inner_keys]
+    if inner:
+        parts.append(inner)
+    return ",".join(parts)
+
+
+def render_prometheus(snapshot: Dict, prefix: str = "lgbm_tpu_serve",
+                      labels: Optional[Dict[str, str]] = None) -> str:
     """One exposition document from a flat-or-nested snapshot dict.
     Non-numeric values (strings, lists) are skipped; ``None`` renders as
-    ``NaN`` so the metric set is identical every scrape."""
+    ``NaN`` so the metric set is identical every scrape.  ``labels``
+    stamps every series with the given label set."""
     lines = []
+    typed = set()
     for path, val in _flatten(snapshot):
         if isinstance(val, bool):
             val = int(val)
         if val is not None and not isinstance(val, (int, float)):
             continue
-        name = _metric_name(prefix, *path)
+        # a labeled instrument key ("bytes{model=\"a\"}") splits into the
+        # metric-family name and the label body; only the name sanitizes
+        leaf, _, label_part = path[-1].partition("{")
+        name = _metric_name(prefix, *path[:-1], leaf)
+        label_body = _merge_labels(label_part.rstrip("}"), labels)
+        series = f"{name}{{{label_body}}}" if label_body else name
         # A registry snapshot declares its sections ("counters" holds only
         # monotonic counts); flat snapshots (ServeMetrics) type by leaf key.
         if path[0] == "counters":
@@ -59,7 +92,9 @@ def render_prometheus(snapshot: Dict, prefix: str = "lgbm_tpu_serve") -> str:
         elif path[0] in ("gauges", "histograms"):
             mtype = "gauge"
         else:
-            mtype = "counter" if path[-1] in _COUNTER_KEYS else "gauge"
-        lines.append(f"# TYPE {name} {mtype}")
-        lines.append(f"{name} {'NaN' if val is None else repr(float(val))}")
+            mtype = "counter" if leaf in _COUNTER_KEYS else "gauge"
+        if name not in typed:
+            typed.add(name)
+            lines.append(f"# TYPE {name} {mtype}")
+        lines.append(f"{series} {'NaN' if val is None else repr(float(val))}")
     return "\n".join(lines) + "\n"

@@ -16,11 +16,55 @@ serialize against training spans or health counters.
 
 from __future__ import annotations
 
+import math
+import re
 import threading
 from collections import deque
-from typing import Dict, Optional
+from typing import Dict, List, Optional, Sequence
 
 import numpy as np
+
+# ---------------------------------------------------------------- buckets
+# Fixed log-spaced bucket ladder shared by every Histogram (ISSUE-14):
+# 24 buckets per decade across [1e-7, 1e5) — sub-microsecond latencies up
+# to ~28-hour durations land in a 288-int array, so percentiles cover the
+# FULL observation history (not a trailing reservoir window) at a bounded
+# resolution of one bucket ratio 10^(1/24) ~ 1.10 (estimates within ~5%
+# of the true quantile).  Values <= 0 or below the floor clamp into the
+# first bucket; values past the ceiling clamp into the last.
+BUCKETS_PER_DECADE = 24
+_BUCKET_LO_EXP = -7
+_BUCKET_HI_EXP = 5
+NUM_BUCKETS = (_BUCKET_HI_EXP - _BUCKET_LO_EXP) * BUCKETS_PER_DECADE
+
+
+def bucket_index(v: float) -> int:
+    """Bucket slot for one observation (clamped into the fixed ladder)."""
+    if not v > 0.0:
+        return 0
+    i = int(math.floor((math.log10(v) - _BUCKET_LO_EXP)
+                       * BUCKETS_PER_DECADE))
+    return min(max(i, 0), NUM_BUCKETS - 1)
+
+
+def bucket_value(i: int) -> float:
+    """Representative (geometric-midpoint) value of bucket ``i``."""
+    return 10.0 ** (_BUCKET_LO_EXP + (i + 0.5) / BUCKETS_PER_DECADE)
+
+
+_LABEL_BAD = re.compile(r"[\"\\\n]")
+
+
+def labeled_name(name: str, labels: Optional[Dict[str, str]]) -> str:
+    """Canonical ``name{key="value",...}`` instrument key (Prometheus
+    label syntax, keys sorted so one label set always maps to ONE
+    instrument).  ``None``/empty labels return the bare name."""
+    if not labels:
+        return name
+    inner = ",".join(
+        f'{k}="{_LABEL_BAD.sub("_", str(labels[k]))}"'
+        for k in sorted(labels))
+    return f"{name}{{{inner}}}"
 
 
 class Counter:
@@ -60,10 +104,13 @@ class Gauge:
 
 
 class Histogram:
-    """Duration/size distribution: exact count and sum plus a bounded
-    reservoir (newest ``reservoir`` observations) for the quantiles — the
-    same deque scheme ServeMetrics uses, so a long-lived process never
-    grows its telemetry footprint."""
+    """Duration/size distribution: exact count/sum/min/max, fixed
+    log-spaced bucket counts covering the FULL observation history (the
+    quantile source — a long-lived serving process's p99 is over every
+    request it ever served, not the trailing window the old
+    deque-reservoir scheme measured), plus a bounded reservoir (newest
+    ``reservoir`` observations) kept for exemplars, so the telemetry
+    footprint stays O(1) regardless of lifetime."""
 
     def __init__(self, name: str, lock: threading.Lock,
                  reservoir: int = 1024):
@@ -71,6 +118,9 @@ class Histogram:
         self._lock = lock
         self.count = 0
         self.sum = 0.0
+        self._min: Optional[float] = None
+        self._max: Optional[float] = None
+        self._buckets = [0] * NUM_BUCKETS
         self._values = deque(maxlen=reservoir)
 
     def observe(self, v: float) -> None:
@@ -78,24 +128,64 @@ class Histogram:
         with self._lock:
             self.count += 1
             self.sum += v
+            self._buckets[bucket_index(v)] += 1
+            if self._min is None or v < self._min:
+                self._min = v
+            if self._max is None or v > self._max:
+                self._max = v
             self._values.append(v)
+
+    def quantiles(self, qs: Sequence[float]) -> List[Optional[float]]:
+        """Full-history quantile estimates from the bucket counts, each
+        within one bucket ratio (~10%) of the exact value; estimates are
+        clamped into the observed [min, max] so small samples never report
+        a quantile outside the data.  ``None`` per entry when empty."""
+        with self._lock:
+            total = self.count
+            buckets = list(self._buckets)
+            vmin, vmax = self._min, self._max
+        if total == 0:
+            return [None for _ in qs]
+        out: List[Optional[float]] = []
+        for q in qs:
+            rank = max(min(float(q), 1.0), 0.0) * total
+            cum = 0
+            est = bucket_value(NUM_BUCKETS - 1)
+            for i, n in enumerate(buckets):
+                cum += n
+                if cum >= rank and n:
+                    est = bucket_value(i)
+                    break
+            out.append(min(max(est, vmin), vmax))
+        return out
+
+    def reservoir_values(self) -> np.ndarray:
+        """Newest raw observations (exemplar window, NOT the quantile
+        source — quantiles come from the full-history buckets)."""
+        with self._lock:
+            return np.asarray(self._values, np.float64)
 
     def summary(self) -> Dict[str, Optional[float]]:
         with self._lock:
-            vals = np.asarray(self._values, np.float64)
-            count, total = self.count, self.sum
+            count, total, vmax = self.count, self.sum, self._max
         out = {"count": count, "sum": total, "p50": None, "p99": None,
-               "max": None}
-        if vals.size:
-            out["p50"] = float(np.percentile(vals, 50))
-            out["p99"] = float(np.percentile(vals, 99))
-            out["max"] = float(vals.max())
+               "p999": None, "max": None}
+        if count:
+            out["p50"], out["p99"], out["p999"] = self.quantiles(
+                (0.5, 0.99, 0.999))
+            out["max"] = vmax
         return out
 
 
 class MetricsRegistry:
     """Named instrument table.  ``counter``/``gauge``/``histogram`` are
-    get-or-create (idempotent, shared instance per name)."""
+    get-or-create (idempotent, shared instance per name).
+
+    Labels (ISSUE-14): pass ``labels={"model": "tenant_a"}`` to get a
+    DISTINCT instrument keyed ``name{model="tenant_a"}`` — the serve
+    layer publishes per-tenant series this way so multi-Booster processes
+    stop aliasing into one counter set; the Prometheus renderer emits the
+    key verbatim as a labeled series."""
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -103,21 +193,27 @@ class MetricsRegistry:
         self._gauges: Dict[str, Gauge] = {}
         self._histograms: Dict[str, Histogram] = {}
 
-    def counter(self, name: str) -> Counter:
+    def counter(self, name: str,
+                labels: Optional[Dict[str, str]] = None) -> Counter:
+        name = labeled_name(name, labels)
         with self._lock:
             c = self._counters.get(name)
             if c is None:
                 c = self._counters[name] = Counter(name, threading.Lock())
             return c
 
-    def gauge(self, name: str) -> Gauge:
+    def gauge(self, name: str,
+              labels: Optional[Dict[str, str]] = None) -> Gauge:
+        name = labeled_name(name, labels)
         with self._lock:
             g = self._gauges.get(name)
             if g is None:
                 g = self._gauges[name] = Gauge(name, threading.Lock())
             return g
 
-    def histogram(self, name: str, reservoir: int = 1024) -> Histogram:
+    def histogram(self, name: str, reservoir: int = 1024,
+                  labels: Optional[Dict[str, str]] = None) -> Histogram:
+        name = labeled_name(name, labels)
         with self._lock:
             h = self._histograms.get(name)
             if h is None:

@@ -212,3 +212,74 @@ def test_trajectory_synthetic_regression_fails(tmp_path):
     r = _run("--trajectory", str(tmp_path))
     assert r.returncode == 1, r.stdout + r.stderr
     assert "REGRESSED" in r.stdout
+
+
+# --------------------------------------------------- serve trajectory (I-14)
+def _serve_blob(**top):
+    b = {
+        "metric": "BENCH_serve", "mode": "load",
+        "offered_qps": 100.0, "achieved_qps": 99.0,
+        "p50_ms": 2.0, "p99_ms": 9.0, "p999_ms": 14.0,
+        "slo_qps": 120.0,
+        "detail": {"platform": "cpu", "cpu_fallback": True},
+    }
+    b.update(top)
+    return b
+
+
+def test_serve_trajectory_committed_fixture():
+    """The committed serve-trajectory smoke (ISSUE-14 satellite): two
+    BENCH_serve_r*.json wrapper files walk through trajectory mode, the
+    load-gate metrics (achieved QPS / p999 / slo_qps) compare, rc 0."""
+    fix = os.path.join(REPO, "tests", "fixtures", "serve_traj")
+    files = sorted(os.listdir(fix))
+    assert files == ["BENCH_serve_r01.json", "BENCH_serve_r02.json"]
+    r = _run("--trajectory", fix)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "BENCH_serve_r01.json -> BENCH_serve_r02.json" in r.stdout
+    for metric in ("serve_achieved_qps", "serve_p999_ms", "serve_p99_ms"):
+        assert metric in r.stdout
+    assert "1 compared" in r.stdout
+    assert r.stdout.splitlines()[-1].endswith("OK")
+
+
+def test_serve_trajectory_families_never_cross_compare(tmp_path):
+    """A directory holding BOTH families compares train rounds against
+    train rounds and serve rounds against serve rounds — never across
+    (every cross metric would be n/a and the pair count would lie)."""
+    _write(tmp_path, "BENCH_r01.json",
+           {"n": 1, "rc": 0, "tail": "", "parsed": _blob()})
+    _write(tmp_path, "BENCH_r02.json",
+           {"n": 2, "rc": 0, "tail": "", "parsed": _blob()})
+    _write(tmp_path, "BENCH_serve_r01.json",
+           {"n": 3, "rc": 0, "tail": "", "parsed": _serve_blob()})
+    _write(tmp_path, "BENCH_serve_r02.json",
+           {"n": 4, "rc": 0, "tail": "", "parsed": _serve_blob()})
+    r = _run("--trajectory", str(tmp_path))
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "BENCH_r01.json -> BENCH_r02.json" in r.stdout
+    assert "BENCH_serve_r01.json -> BENCH_serve_r02.json" in r.stdout
+    assert "BENCH_r02.json -> BENCH_serve_r01.json" not in r.stdout
+    assert "2 compared" in r.stdout
+
+
+def test_serve_trajectory_regression_and_probe_refusal(tmp_path):
+    """The serve gate fails on a load-metric regression and keeps the
+    probe-honesty refusal: a CPU-fallback serve blob never compares
+    against a live-accelerator one."""
+    _write(tmp_path, "BENCH_serve_r01.json",
+           {"n": 1, "rc": 0, "tail": "", "parsed": _serve_blob()})
+    _write(tmp_path, "BENCH_serve_r02.json",
+           {"n": 2, "rc": 0, "tail": "",
+            "parsed": _serve_blob(p999_ms=28.0, achieved_qps=60.0)})
+    r = _run("--trajectory", str(tmp_path))
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "serve_p999_ms" in r.stdout and "REGRESSED" in r.stdout
+    # pair-mode refusal on a platform cliff (same rule as training blobs)
+    tpu = _serve_blob()
+    tpu["detail"] = {"platform": "tpu", "cpu_fallback": False,
+                     "probe": {"verdict": "live", "backend": "tpu"}}
+    a = _write(tmp_path, "serve_tpu.json", tpu)
+    b = _write(tmp_path, "serve_cpu.json", _serve_blob())
+    r = _run(a, b)
+    assert r.returncode == 3, r.stdout + r.stderr

@@ -1,0 +1,489 @@
+"""Serve request-path tracing, per-tenant metrics, SLO accounting and the
+open-loop load generator (ISSUE-14):
+
+- off-mode inertness: ``tpu_serve_request_log=off`` (default) lowers the
+  SAME predict HLO as ``on``, and ARMED tracing still costs exactly one
+  compiled dispatch + one host sync per raw predict (zero device work);
+- phase breakdown: queue-wait / assemble / dispatch / post sums match the
+  recorded total latency;
+- deterministic sampling (fixed request stream -> same sampled event set
+  every run) and the bounded top-K slow-request exemplar ring;
+- labeled Prometheus exposition: two named tenants render DISTINCT
+  ``{model="..."}`` series with a schema stable across scrapes;
+- registry ``Histogram`` log-bucket percentiles vs numpy on synthetic
+  data (full-run quantiles, not a reservoir window);
+- ``tools/serve_load.py``: byte-identical seeded arrival schedules, and
+  a deliberately-overloaded open-loop run whose p99 is dominated by
+  queue wait — the signal closed-loop timing structurally cannot see.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import serve, telemetry
+from lightgbm_tpu.serve.metrics import SLOW_RING_SIZE, ServeMetrics
+
+pytestmark = pytest.mark.serve
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+
+def _data(n=1200, f=8, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    y = (X[:, 0] + X[:, 1] > 0).astype(np.float64)
+    return X, y
+
+
+def _booster(extra=None, n=1200, seed=0, iters=3):
+    X, y = _data(n, seed=seed)
+    params = {"objective": "binary", "num_leaves": 7, "verbosity": -1,
+              "metric": "none"}
+    params.update(extra or {})
+    return X, lgb.train(params, lgb.Dataset(X, label=y), iters)
+
+
+TRACE_ON = {"tpu_serve_request_log": "on",
+            "tpu_serve_request_sample": 1.0,
+            "tpu_serve_slow_ms": 1e-7}
+
+
+# ----------------------------------------------------------- knob validation
+def test_request_log_knob_validated():
+    X, bst = _booster({"tpu_serve_request_log": "sometimes"})
+    with pytest.raises(ValueError, match="tpu_serve_request_log"):
+        serve.Predictor(bst)
+
+
+# -------------------------------------------------------- off-mode inertness
+def test_off_mode_lowered_hlo_identical():
+    """The tracing knob never enters a traced program: the plan's jitted
+    predict program lowers to IDENTICAL HLO text with tracing off
+    (default) vs armed — the PR-9 inertness contract extended to the
+    tpu_serve_* knobs."""
+    texts = []
+    for extra in ({}, TRACE_ON):
+        X, bst = _booster(extra)
+        serve.clear_plan_cache()
+        pred = serve.Predictor(bst, raw_score=True)
+        assert pred.metrics.tracer.armed == bool(extra)
+        plan = pred.plan
+        bins = np.zeros((32, plan.num_features), np.int32)
+        import jax.numpy as jnp
+        texts.append(plan._jit_binned.lower(
+            plan._arrays, jnp.asarray(bins)).as_text())
+    serve.clear_plan_cache()
+    assert texts[0] == texts[1]
+
+
+def test_armed_census_one_dispatch_one_sync():
+    """ARMED tracing adds ZERO device dispatches: a raw predict stays
+    exactly 1 compiled dispatch + 1 host sync per call with the request
+    log on (phase marks are host perf_counter reads at dispatch
+    boundaries)."""
+    import jax
+
+    X, bst = _booster(TRACE_ON)
+    pred = serve.Predictor(bst, raw_score=True)
+    assert pred.metrics.tracer.armed
+    plan = pred.plan
+    pred.predict(X[:64])                     # compile outside the census
+    counts = {"dispatch": 0, "sync": 0}
+    orig_call = plan._call
+    orig_get = jax.device_get
+
+    def counting_call(*a, **k):
+        counts["dispatch"] += 1
+        return orig_call(*a, **k)
+
+    def counting_get(x):
+        counts["sync"] += 1
+        return orig_get(x)
+
+    plan._call = counting_call
+    jax.device_get = counting_get
+    try:
+        for _ in range(4):
+            pred.predict(X[:64])
+    finally:
+        jax.device_get = orig_get
+        plan._call = orig_call
+    assert counts["dispatch"] == 4, counts
+    assert counts["sync"] == 4, counts
+    # ... and the tracer actually recorded those requests
+    assert pred.metrics.tracer._n >= 4
+
+
+# ----------------------------------------------------------- phase breakdown
+def test_phase_sum_matches_total_direct():
+    X, bst = _booster(TRACE_ON)
+    pred = serve.Predictor(bst)
+    for _ in range(6):
+        pred.predict(X[:32])
+    snap = pred.metrics_snapshot()
+    assert snap["phases"] is not None
+    for phase in ("queue_wait", "assemble", "dispatch", "post", "total"):
+        assert snap["phases"][phase]["count"] == 6
+    ring = snap["slow_requests"]             # slow_ms ~ 0: every request
+    assert ring, "exemplar ring empty with slow_ms ~ 0"
+    for entry in ring:
+        phase_sum = (entry["queue_wait_ms"] + entry["assemble_ms"]
+                     + entry["dispatch_ms"] + entry["post_ms"])
+        # marks are contiguous perf_counter deltas inside predict(): the
+        # sum reproduces the recorded total up to the record-path tail
+        assert abs(phase_sum - entry["total_ms"]) \
+            <= max(0.05 * entry["total_ms"], 0.5), entry
+        assert entry["queue_wait_ms"] == 0.0     # direct path: no queue
+
+
+def test_batcher_queue_wait_and_coalescing_context():
+    X, bst = _booster(TRACE_ON)
+    pred = serve.Predictor(bst)
+    pred.predict(X[:64])                     # absorb compiles
+    mb = pred.batcher(max_batch=256, max_wait_ms=30)
+    futs = [mb.submit(X[i:i + 2]) for i in range(0, 16, 2)]
+    for f in futs:
+        f.result(timeout=60)
+    mb.close()
+    ring = pred.metrics.tracer.slow_requests()
+    batched = [e for e in ring if e["coalesced"] > 1]
+    assert batched, ring
+    for entry in batched:
+        assert entry["batch_rows"] >= entry["rows"]
+        assert entry["queue_wait_ms"] >= 0.0
+        phase_sum = (entry["queue_wait_ms"] + entry["assemble_ms"]
+                     + entry["dispatch_ms"] + entry["post_ms"])
+        assert abs(phase_sum - entry["total_ms"]) \
+            <= max(0.10 * entry["total_ms"], 1.0), entry
+
+
+# ------------------------------------------------------------------ sampling
+def _sampled_ids(tmp_path, tag):
+    """Run a fixed 16-request stream at sample=0.25 (slow override off)
+    and return the req_ids that emitted serve.request events."""
+    log = str(tmp_path / f"req_{tag}.jsonl")
+    X, bst = _booster({"tpu_serve_request_log": "on",
+                       "tpu_serve_request_sample": 0.25,
+                       "tpu_serve_slow_ms": 0.0})
+    serve.clear_plan_cache()
+    pred = serve.Predictor(bst)
+    telemetry.configure_log(log)
+    try:
+        for _ in range(16):
+            pred.predict(X[:32])
+    finally:
+        telemetry.close_log()
+    ids = []
+    with open(log) as fh:
+        for line in fh:
+            e = json.loads(line)
+            if e.get("kind") == "serve.request":
+                ids.append(e["req_id"])
+                assert e["slow"] is False
+                assert e["total_s"] > 0
+    return ids
+
+
+def test_sampling_deterministic(tmp_path):
+    """rate=0.25 samples EXACTLY every 4th request of the sequence —
+    deterministic pacing, so two identical streams emit the same event
+    set (no RNG in the sampling decision)."""
+    first = _sampled_ids(tmp_path, "a")
+    second = _sampled_ids(tmp_path, "b")
+    assert first == [3, 7, 11, 15]
+    assert second == first
+
+
+def test_slow_requests_always_sampled_and_ring_bounded(tmp_path):
+    log = str(tmp_path / "slow.jsonl")
+    X, bst = _booster({"tpu_serve_request_log": "on",
+                       "tpu_serve_request_sample": 0.0,   # rate: never
+                       "tpu_serve_slow_ms": 1e-7})        # slow: always
+    serve.clear_plan_cache()
+    pred = serve.Predictor(bst)
+    telemetry.configure_log(log)
+    try:
+        n_req = SLOW_RING_SIZE + 8
+        for _ in range(n_req):
+            pred.predict(X[:32])
+    finally:
+        telemetry.close_log()
+    with open(log) as fh:
+        slow_events = [json.loads(line) for line in fh
+                       if '"serve.request"' in line]
+    assert len(slow_events) == n_req        # slow bypasses the 0.0 rate
+    assert all(e["slow"] for e in slow_events)
+    ring = pred.metrics.tracer.slow_requests()
+    assert len(ring) == SLOW_RING_SIZE      # bounded top-K
+    totals = [e["total_ms"] for e in ring]
+    assert totals == sorted(totals, reverse=True)
+
+
+# ------------------------------------------------- per-tenant labeled metrics
+def test_two_tenant_labeled_prometheus_stable():
+    """Two named tenants in one process render DISTINCT labeled series
+    (the multi-Booster aliasing fix), the registry carries both labeled
+    counter sets, per-tenant plan-cache bytes attribute correctly, and
+    the exposition schema is stable across scrapes."""
+    Xa, bst_a = _booster(seed=1)
+    Xb, bst_b = _booster(seed=2)
+    serve.clear_plan_cache()
+    pa = serve.Predictor(bst_a, name="tenant_a")
+    pb = serve.Predictor(bst_b, name="tenant_b")
+    for _ in range(3):
+        pa.predict(Xa[:32])
+    pb.predict(Xb[:32])
+
+    text_a = pa.metrics.render_prometheus(plan=pa.plan)
+    text_b = pb.metrics.render_prometheus(plan=pb.plan)
+    assert 'lgbm_tpu_serve_requests{model="tenant_a"} 3.0' in text_a
+    assert 'lgbm_tpu_serve_requests{model="tenant_b"} 1.0' in text_b
+    # per-PREDICTOR series never leak the other tenant (the process-
+    # global plan_cache block legitimately shows every tenant's bytes)
+    assert 'lgbm_tpu_serve_requests{model="tenant_b"}' not in text_a
+    assert 'lgbm_tpu_serve_rows{model="tenant_b"}' not in text_a
+    # ... and the process-global cache block attributes BOTH tenants
+    assert 'lgbm_tpu_serve_plan_cache_bytes{model="tenant_b"}' in text_a
+    # one scrape of the process registry sees BOTH tenants' series
+    reg_text = telemetry.render_prometheus(telemetry.registry().snapshot(),
+                                           prefix="lgbm_tpu")
+    assert 'lgbm_tpu_counters_serve_requests{model="tenant_a"}' in reg_text
+    assert 'lgbm_tpu_counters_serve_requests{model="tenant_b"}' in reg_text
+    # per-tenant plan-cache byte attribution (ROADMAP-1 admission input)
+    stats = serve.cache_stats()
+    key_a, key_b = 'bytes{model="tenant_a"}', 'bytes{model="tenant_b"}'
+    assert stats[key_a] == pa.plan.plan_bytes
+    assert stats[key_b] == pb.plan.plan_bytes
+    assert stats[key_a] + stats[key_b] <= stats["bytes"]
+    reg = telemetry.registry()
+    assert reg.gauge("serve.plan_cache_bytes",
+                     labels={"model": "tenant_a"}).value \
+        == pa.plan.plan_bytes
+    # schema stability: a second scrape renders the same series set
+    def series(text):
+        return sorted(line.split(" ")[0] for line in text.splitlines()
+                      if not line.startswith("#"))
+    assert series(text_a) == series(pa.metrics.render_prometheus(
+        plan=pa.plan))
+    serve.clear_plan_cache()
+    # evicted tenants' byte gauges drop to 0 instead of lingering
+    assert reg.gauge("serve.plan_cache_bytes",
+                     labels={"model": "tenant_a"}).value == 0
+
+
+# ------------------------------------------------------- bucket percentiles
+def test_bucket_percentiles_vs_numpy():
+    """Full-run log-bucket quantiles track numpy within the documented
+    bucket resolution (one 10^(1/24) ~ 1.10 ratio step) on synthetic
+    lognormal latencies — and cover ALL observations, unlike the old
+    4096-deque window."""
+    from lightgbm_tpu.telemetry.registry import Histogram
+    rng = np.random.RandomState(3)
+    vals = np.exp(rng.randn(30000) * 0.8 - 6.0)     # ~ms-scale latencies
+    h = Histogram("t", threading.Lock(), reservoir=128)
+    for v in vals:
+        h.observe(v)
+    for q, pct in ((0.5, 50), (0.99, 99), (0.999, 99.9)):
+        est = h.quantiles((q,))[0]
+        ref = float(np.percentile(vals, pct))
+        assert abs(est / ref - 1) < 0.12, (q, est, ref)
+    # the reservoir holds only 128 values — the buckets still aggregate
+    # the full 30k history (the window bug this replaces)
+    assert h.count == 30000
+    assert h.reservoir_values().size == 128
+    s = h.summary()
+    assert s["p999"] >= s["p99"] >= s["p50"]
+    assert s["max"] == float(vals.max())
+
+
+def test_serve_metrics_full_run_percentiles():
+    """ServeMetrics quantiles cover observations past the reservoir
+    window: 5000 fast requests then 100 slow ones — a trailing-4096
+    window would force p50 toward the recent mix; the full-run buckets
+    keep p50 at the fast mode."""
+    m = ServeMetrics(reservoir=64)
+    for _ in range(5000):
+        m.observe_request(1, 0.001)
+    for _ in range(100):
+        m.observe_request(1, 0.5)
+    q = m.latency_quantiles_ms()
+    assert q["p50_ms"] < 2.0, q            # fast mode, full history
+    assert q["p99_ms"] > 100.0, q          # tail sees the slow burst
+    assert q["p999_ms"] >= q["p99_ms"]
+
+
+# ------------------------------------------------------------ SLO accounting
+def test_slo_accounting_attainment_burn_and_attribution():
+    m = ServeMetrics(model="slo_tenant", slo_p99_ms=10.0)
+    for _ in range(18):
+        m.observe_request(1, 0.001)        # 1ms: meets the 10ms target
+    m.observe_request(1, 0.5)              # 500ms: latency violation
+    m.observe_shed()                       # shed: violation, attributed
+    snap = m.snapshot()
+    slo = snap["slo"]
+    assert slo["target_p99_ms"] == 10.0
+    assert slo["window_requests"] == 20
+    assert slo["attainment"] == pytest.approx(18 / 20)
+    # 10% violations against a 1% budget -> burning 10x
+    assert slo["budget_burn"] == pytest.approx(10.0)
+    assert slo["violations"] == {"latency": 1, "shed": 1, "deadline": 0,
+                                 "fault": 0}
+    reg = telemetry.registry()
+    g = reg.gauge("serve.slo_attainment", labels={"model": "slo_tenant"})
+    assert g.value == pytest.approx(18 / 20)
+    text = m.render_prometheus()
+    assert 'lgbm_tpu_serve_slo_budget_burn{model="slo_tenant"}' in text
+    assert 'lgbm_tpu_serve_slo_violations_shed{model="slo_tenant"} 1.0' \
+        in text
+
+
+def test_slo_off_keeps_stable_schema():
+    m = ServeMetrics()
+    snap = m.snapshot()
+    assert snap["slo"] is None
+    text = m.render_prometheus()
+    assert "lgbm_tpu_serve_slo_attainment NaN" in text
+    assert "lgbm_tpu_serve_slo_violations_latency NaN" in text
+
+
+# ------------------------------------------------------------ load generator
+def test_arrival_schedule_byte_identical():
+    from tools.serve_load import build_schedule, schedule_digest
+    a = build_schedule(11, 200.0, 2.0, n_tenants=3,
+                       weights=[0.5, 0.3, 0.2], req_max=8, rows=4096)
+    b = build_schedule(11, 200.0, 2.0, n_tenants=3,
+                       weights=[0.5, 0.3, 0.2], req_max=8, rows=4096)
+    assert schedule_digest(a) == schedule_digest(b)
+    for key in ("t", "sizes", "offsets", "tenant"):
+        assert a[key].tobytes() == b[key].tobytes()
+    c = build_schedule(12, 200.0, 2.0, n_tenants=3,
+                       weights=[0.5, 0.3, 0.2], req_max=8, rows=4096)
+    assert schedule_digest(c) != schedule_digest(a)
+    # arrivals start at 0, are sorted, and offer ~target_qps
+    assert a["t"][0] == 0.0
+    assert (np.diff(a["t"]) >= 0).all()
+    assert len(a["t"]) == 400
+
+
+def test_overloaded_run_queue_wait_dominates_p99():
+    """The coordinated-omission acceptance pin: drive an open-loop
+    schedule faster than the server can drain (service time padded to a
+    known floor) and check queue wait — measured because latency counts
+    from the SCHEDULED arrival — dominates p99.  A closed-loop generator
+    would never see this: it only issues a request when the previous one
+    finishes, so its 'latency' stays near the service time."""
+    from tools.serve_load import build_schedule, run_load, summarize
+
+    X, bst = _booster(TRACE_ON, n=2000)
+    serve.clear_plan_cache()
+    pred = serve.Predictor(bst, name="overload")
+    pred.warmup(16)
+    real_predict = pred.predict
+    service_s = 0.01
+
+    def slowed(Xb, **kw):
+        time.sleep(service_s)             # deterministic service floor
+        return real_predict(Xb, **kw)
+
+    pred.predict = slowed
+    # max_batch 8 rows -> ~3 requests per flush at ~10ms service: the
+    # server drains ~300 req/s while 800/s arrive, so the queue grows
+    # for the whole run REGARDLESS of host speed (the floor is a sleep)
+    mb = serve.MicroBatcher(pred, max_batch=8, max_wait_ms=0.5)
+    sched = build_schedule(5, 800.0, 1.0, req_max=4, rows=X.shape[0])
+    try:
+        result = run_load([mb], X, sched)
+    finally:
+        mb.close()
+        pred.predict = real_predict
+    summary = summarize(result, sched, ["overload"])
+    assert summary["completed"] == summary["requests"]
+    phases = pred.metrics_snapshot()["phases"]
+    queue_p99 = phases["queue_wait"]["p99_ms"]
+    total_p99 = summary["p99_ms"]
+    # queue wait IS the tail: it dwarfs the ~4ms service floor and makes
+    # up most of the open-loop p99
+    assert total_p99 > 10 * service_s * 1e3, summary
+    assert queue_p99 > 0.5 * total_p99, (queue_p99, total_p99, phases)
+    assert queue_p99 > 5 * phases["dispatch"]["p99_ms"], phases
+    # the driver itself kept pace: lateness is queueing, not submit lag
+    assert summary["submit_lag_p99_ms"] < 0.5 * total_p99, summary
+
+
+def test_serve_load_cli_blob_and_gate(tmp_path):
+    """CLI smoke: the extended BENCH_serve blob carries every load-gate
+    field, reproducibly-digested schedule included, and
+    tools/bench_compare.py extracts the new watched metrics from it."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=os.pathsep.join(
+                   [ROOT] + os.environ.get("PYTHONPATH",
+                                           "").split(os.pathsep)))
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "serve_load.py"),
+         "--qps", "40", "--duration", "1.0", "--rows", "900",
+         "--iters", "2", "--tenants", "2", "--weights", "0.7,0.3",
+         "--request-log"],
+        capture_output=True, text=True, timeout=600, env=env)
+    assert r.returncode == 0, r.stdout + r.stderr
+    blob = None
+    for line in r.stdout.splitlines():
+        if line.startswith("{"):
+            obj = json.loads(line)
+            if obj.get("metric") == "BENCH_serve":
+                blob = obj
+    assert blob is not None, r.stdout
+    assert blob["mode"] == "load"
+    assert blob["offered_qps"] > 0 and blob["achieved_qps"] > 0
+    assert blob["p999_ms"] >= blob["p99_ms"] >= blob["p50_ms"]
+    assert set(blob["per_tenant"]) == {"t0", "t1"}
+    for tb in blob["per_tenant"].values():
+        assert tb["requests"] > 0
+    assert len(blob["detail"]["schedule_sha256"]) == 64
+    assert blob["detail"]["phases"]["t0"]["queue_wait"]["count"] > 0
+    assert blob["detail"]["cpu_fallback"] is True
+    from tools.bench_compare import extract_metrics
+    m = extract_metrics(blob)
+    assert m["serve_achieved_qps"] == blob["achieved_qps"]
+    assert m["serve_p999_ms"] == blob["p999_ms"]
+    assert m["serve_p99_ms"] == blob["p99_ms"]
+
+
+# -------------------------------------------------------- telemetry report
+def test_telemetry_report_serve_cli(tmp_path):
+    """--serve replays serve.request events from the SAME JSONL artifact
+    the other report tools read into phase + tenant tables (subprocess,
+    unknown-kind tolerance preserved)."""
+    log = str(tmp_path / "serve_report.jsonl")
+    X, bst = _booster(TRACE_ON)
+    serve.clear_plan_cache()
+    pred = serve.Predictor(bst, name="report_tenant")
+    telemetry.configure_log(log)
+    try:
+        for _ in range(5):
+            pred.predict(X[:16])
+    finally:
+        telemetry.close_log()
+    with open(log, "a") as fh:   # unknown kinds must stay tolerated
+        fh.write(json.dumps({"schema": 99, "kind": "future.kind",
+                             "ts": 1.0}) + "\n")
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(ROOT, "tools", "telemetry_report.py"),
+         "--serve", log],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "serve request phases" in r.stdout
+    assert "serve tenants" in r.stdout
+    assert "report_tenant" in r.stdout
+    for phase in ("queue_wait", "assemble", "dispatch", "post", "total"):
+        assert phase in r.stdout
+    assert "skipped lines" in r.stdout     # the unknown-schema line

@@ -77,6 +77,13 @@ WATCHED: List[Tuple[str, bool]] = [
     ("serve_compiles", False),
     ("serve_plan_bytes", False),
     ("serve_restart_compiles", False),
+    # tools/serve_load.py (ISSUE-14): the open-loop load-generator blob —
+    # p999 tail, achieved throughput under the offered schedule, and the
+    # saturation-search headline (max QPS meeting the p99 SLO).  n/a on
+    # closed-loop serve_bench blobs and training blobs.
+    ("serve_p999_ms", False),
+    ("serve_achieved_qps", True),
+    ("serve_slo_qps", True),
     # detail.stream rung (ISSUE-13, lightgbm_tpu/stream/): the streaming
     # trajectory — per-iteration wall cost under the budget, prefetch
     # stall seconds (a pipeline that stops overlapping regresses here
@@ -158,6 +165,8 @@ def extract_metrics(blob: dict) -> Dict[str, Optional[float]]:
         "serve_warm_qps": None, "serve_p50_ms": None,
         "serve_p99_ms": None, "serve_compiles": None,
         "serve_plan_bytes": None, "serve_restart_compiles": None,
+        "serve_p999_ms": None, "serve_achieved_qps": None,
+        "serve_slo_qps": None,
         "stream_s_per_iter": _num(_dig(d, "stream", "s_per_iter")),
         "stream_stall_s": _num(_dig(d, "stream", "stall_s")),
         "stream_peak_bytes": _num(_dig(d, "stream",
@@ -173,6 +182,9 @@ def extract_metrics(blob: dict) -> Dict[str, Optional[float]]:
         out["serve_compiles"] = _num(blob.get("compiles"))
         out["serve_plan_bytes"] = _num(blob.get("plan_bytes"))
         out["serve_restart_compiles"] = _num(blob.get("restart_compiles"))
+        out["serve_p999_ms"] = _num(blob.get("p999_ms"))
+        out["serve_achieved_qps"] = _num(blob.get("achieved_qps"))
+        out["serve_slo_qps"] = _num(blob.get("slo_qps"))
     return out
 
 
@@ -278,14 +290,24 @@ def run_pair(path_old: str, path_new: str, max_regress: float,
 
 def trajectory_files(paths: List[str]) -> List[str]:
     """Explicit files in the given order, or a directory expanded to its
-    sorted ``BENCH_r*.json`` sequence."""
+    sorted ``BENCH_r*.json`` training sequence PLUS the sorted
+    ``BENCH_serve_r*.json`` serving sequence (ISSUE-14: the serve
+    trajectory gates beside the training one; the two families are
+    compared within themselves, never against each other)."""
     if len(paths) == 1 and os.path.isdir(paths[0]):
         found = sorted(glob.glob(os.path.join(paths[0], "BENCH_r*.json")))
+        found += sorted(glob.glob(os.path.join(paths[0],
+                                               "BENCH_serve_r*.json")))
         if not found:
             raise SystemExit(
-                f"bench_compare: no BENCH_r*.json under {paths[0]}")
+                f"bench_compare: no BENCH_r*.json or BENCH_serve_r*.json "
+                f"under {paths[0]}")
         return found
     return paths
+
+
+def _blob_family(blob: dict) -> str:
+    return "serve" if blob.get("metric") == "BENCH_serve" else "train"
 
 
 def run_trajectory(paths: List[str], max_regress: float,
@@ -305,8 +327,14 @@ def run_trajectory(paths: List[str], max_regress: float,
     metric_rounds = [(p, b) for p, b in loaded if b is not None]
     any_regress = False
     mismatches = 0
-    for (p_old, b_old), (p_new, b_new) in zip(metric_rounds,
-                                              metric_rounds[1:]):
+    # consecutive pairs WITHIN each blob family: a serving round never
+    # compares against a training round (every metric would be n/a)
+    pairs = []
+    for family in ("train", "serve"):
+        fam = [(p, b) for p, b in metric_rounds
+               if _blob_family(b) == family]
+        pairs.extend(zip(fam, fam[1:]))
+    for (p_old, b_old), (p_new, b_new) in pairs:
         name_old = os.path.basename(p_old)
         name_new = os.path.basename(p_new)
         if is_cpu_fallback(b_old) != is_cpu_fallback(b_new):
@@ -322,7 +350,7 @@ def run_trajectory(paths: List[str], max_regress: float,
         if regressed:
             any_regress = True
             print(f"REGRESSED: {', '.join(regressed)}")
-    n_cmp = max(len(metric_rounds) - 1 - mismatches, 0)
+    n_cmp = max(len(pairs) - mismatches, 0)
     print(f"\nbench_compare: {len(files)} rounds, "
           f"{len(metric_rounds)} with metrics, {n_cmp} compared, "
           f"{mismatches} probe-mismatch pair(s) skipped — "

@@ -119,6 +119,33 @@ _DESCRIPTIONS = {
         "(BENCH_serve's restart_compiles); corrupt/version-stale entries "
         "are detected, warned about and rebuilt; '' disables; the "
         "LIGHTGBM_TPU_SERVE_CACHE_DIR env var overrides"),
+    "tpu_serve_request_log": (
+        "per-request serve tracing (ISSUE-14, docs/OBSERVABILITY.md): on "
+        "= every Predictor.predict / MicroBatcher request gets a request "
+        "id and a host-side phase breakdown (queue-wait / bin+assemble / "
+        "device dispatch / post-process, marked at dispatch boundaries "
+        "only), sampled serve.request JSONL events and a bounded top-K "
+        "slow-request exemplar ring in ServeMetrics.snapshot(); off "
+        "(default) is bitwise-inert — identical lowered predict HLO, "
+        "and armed tracing still adds ZERO device dispatches (pinned in "
+        "tests/test_serve_tracing.py)"),
+    "tpu_serve_request_sample": (
+        "fraction of traced requests emitting a serve.request event — "
+        "DETERMINISTIC pacing over the request sequence (no RNG: a fixed "
+        "stream samples the same set every run); requests past "
+        "tpu_serve_slow_ms always sample regardless of the rate"),
+    "tpu_serve_slow_ms": (
+        "slow-request threshold (ms): traced requests at/above it bypass "
+        "the sample rate and enter the top-K exemplar ring surfaced by "
+        "ServeMetrics.snapshot()['slow_requests']; 0 disables the slow "
+        "override"),
+    "tpu_serve_slo_p99_ms": (
+        "p99 latency SLO target (ms): arms rolling-window SLO-attainment "
+        "and error-budget-burn gauges (serve.slo_attainment / "
+        "serve.slo_budget_burn; burn = violation fraction over the 1% "
+        "budget a p99 target grants) with per-cause violation "
+        "attribution (latency/shed/deadline/fault); also the target "
+        "tools/serve_load.py --saturate searches against; 0 disables"),
     "checkpoint_interval": (
         "atomic training snapshots (resilience/checkpoint.py, "
         "docs/ROBUSTNESS.md) every N committed boosting rounds, emitted at "

@@ -6,7 +6,10 @@ ladder, then times a mixed-batch-size request stream and emits ONE
 ``BENCH_serve`` JSON line carrying every field the
 ``tools/bench_compare.py`` serve gate watches:
 
-- ``warm_qps`` / ``p50_ms`` / ``p99_ms`` — the request-stream rate,
+- ``warm_qps`` / ``p50_ms`` / ``p99_ms`` / ``p999_ms`` — the
+  request-stream rate and latency percentiles computed over the FULL
+  per-call timing array (the batch schedule is pre-generated outside the
+  timed loop; ``detail.latency_window_calls`` records the window),
 - ``compiles`` — fresh XLA compiles this process paid,
 - ``plan_bytes`` — the served pack's resident device bytes (quantized
   when ``SERVE_BENCH_QUANTIZE`` != off, beside ``plan_bytes_fp32`` so the
@@ -19,7 +22,11 @@ ladder, then times a mixed-batch-size request stream and emits ONE
 Platform honesty rides ``detail.platform`` / ``detail.cpu_fallback`` —
 the same probe-honesty fields the training blobs carry, so
 ``bench_compare`` refuses to compare a CPU-fallback serve blob against a
-live-accelerator one.  Runnable hermetically::
+live-accelerator one.
+
+NOTE this is CLOSED-LOOP timing (warm-dispatch throughput); latency
+under a target arrival rate — where queueing dominates the tail — is
+``tools/serve_load.py``'s job (ISSUE-14).  Runnable hermetically::
 
     JAX_PLATFORMS=cpu python tools/serve_bench.py
 
@@ -51,18 +58,35 @@ FEATURES = 16
 def run_request_stream(pred, X, calls, max_batch, seed=7):
     """Timed mixed-batch-size request stream against a serve Predictor —
     the ONE measurement protocol shared by this tool and bench.py's
-    predict phase.  Returns ``(elapsed_s, served_rows)``."""
+    predict phase.  The batch schedule (sizes AND row offsets) is
+    pre-generated BEFORE the clock starts, so RNG draws and array
+    slicing never contaminate the timed loop (ISSUE-14 satellite), and
+    every call's latency is recorded so percentiles cover the FULL run —
+    not a trailing metrics-reservoir window.  Returns ``(elapsed_s,
+    served_rows, per_call_s)`` where ``per_call_s`` is the (calls,)
+    float64 latency array.
+
+    NOTE: this is CLOSED-LOOP timing (each call starts when the previous
+    finishes) — right for warm-dispatch throughput, structurally blind
+    to queueing.  Latency under a target arrival rate is
+    ``tools/serve_load.py``'s job."""
     rng = np.random.RandomState(seed)
     sizes = rng.randint(1, max_batch + 1, calls)
     rows = X.shape[0]
-    served = 0
-    t0 = time.time()
+    # schedule + slices assembled outside the timed region
+    batches = []
     for s in sizes:
         lo = int(rng.randint(0, max(rows - int(s), 1)))
-        batch = X[lo:lo + int(s)]           # may clip when rows < s
+        batches.append(X[lo:lo + int(s)])   # may clip when rows < s
+    served = 0
+    per_call = np.zeros(calls, np.float64)
+    t0 = time.perf_counter()
+    for i, batch in enumerate(batches):
+        c0 = time.perf_counter()
         pred.predict(batch)
+        per_call[i] = time.perf_counter() - c0
         served += batch.shape[0]
-    return time.time() - t0, served
+    return time.perf_counter() - t0, served, per_call
 
 
 def restart_sim(bst, serve, cache_dir, max_batch, quantize):
@@ -119,7 +143,8 @@ def main():
     warm_s = time.time() - t0
 
     # mixed request sizes, ladder-spanning (the serving traffic shape)
-    elapsed, served_rows = run_request_stream(pred, X, CALLS, MAX_BATCH)
+    elapsed, served_rows, per_call = run_request_stream(pred, X, CALLS,
+                                                        MAX_BATCH)
 
     # zero-cold-start restart simulation (persistent AOT compile cache);
     # a tool-created temp dir is removed afterwards, a user-provided
@@ -135,12 +160,18 @@ def main():
             shutil.rmtree(cache_dir, ignore_errors=True)
 
     snap = pred.metrics_snapshot()
+    # Percentiles from the FULL per-call timing array (ISSUE-14 satellite:
+    # with SERVE_BENCH_CALLS > the metrics reservoir, snapshot percentiles
+    # silently covered only the trailing window; these cover every call,
+    # and the blob records the measurement window explicitly).
+    lat_ms = per_call * 1e3
     blob = {
         "metric": "BENCH_serve",
         "warm_qps": round(CALLS / elapsed, 2),
         "warm_rows_per_sec": round(served_rows / elapsed, 1),
-        "p50_ms": round(snap["p50_ms"], 4),
-        "p99_ms": round(snap["p99_ms"], 4),
+        "p50_ms": round(float(np.percentile(lat_ms, 50)), 4),
+        "p99_ms": round(float(np.percentile(lat_ms, 99)), 4),
+        "p999_ms": round(float(np.percentile(lat_ms, 99.9)), 4),
         "compiles": snap["compiles"],
         "plan_bytes": snap["plan_bytes"],
         "plan_bytes_fp32": int(plan_bytes_fp32),
@@ -152,6 +183,9 @@ def main():
         "detail": {
             "train_rows": ROWS, "features": FEATURES, "iters": ITERS,
             "calls": CALLS, "served_rows": served_rows,
+            # measurement window: percentiles above cover ALL timed calls
+            "latency_window_calls": int(lat_ms.size),
+            "latency_source": "full_per_call_array",
             "max_batch": MAX_BATCH, "warmed_rungs": warmed,
             "warmup_s": round(warm_s, 3), "train_s": round(train_s, 3),
             "padded_rows": snap["padded_rows"],

@@ -1,0 +1,428 @@
+"""Coordinated-omission-free target-QPS serve load generator (ISSUE-14).
+
+``tools/serve_bench.py`` times back-to-back synchronous calls: the next
+request only starts when the previous one finishes, so the generator
+slows down exactly when the server does and queueing delay never shows up
+in the numbers — the classic *coordinated omission* trap.  This tool is
+the open-loop replacement:
+
+- a **deterministic seeded arrival schedule** (Poisson arrivals at a
+  target QPS, tenant mix, request sizes — byte-identical across runs for
+  a fixed seed, ``schedule_digest`` proves it) is generated BEFORE the
+  clock starts;
+- requests are driven through each tenant's :class:`MicroBatcher` at
+  their scheduled times — when the server falls behind, requests keep
+  arriving and queue (exactly like real traffic);
+- every latency is measured from the request's **scheduled arrival
+  time**, so queue wait — the dominant tail term under load — is in
+  every percentile (the signal closed-loop timing structurally cannot
+  see);
+- **tenant mixes**: multiple Boosters behind named Predictors with
+  weighted traffic, a per-tenant block in the blob;
+- **saturation search** (``--saturate``): geometric bracket + bisection
+  for the max target QPS whose measured p99 still meets
+  ``--slo-p99-ms`` — the ``slo_qps`` headline.
+
+Emits ONE extended ``BENCH_serve`` JSON line (offered vs achieved QPS,
+p50/p99/p999, slo_qps, shed/deadline counts, per-tenant block, platform
+honesty) that ``tools/bench_compare.py`` gates like the training
+trajectory.  Runnable hermetically::
+
+    JAX_PLATFORMS=cpu python tools/serve_load.py --qps 50 --duration 2
+
+Flags: --qps --duration --seed --tenants --weights --req-max --max-batch
+--max-queue --deadline-ms --slo-p99-ms --saturate --rows --iters
+--quantize --request-log.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+FEATURES = 16
+
+
+# ------------------------------------------------------------------ schedule
+def build_schedule(seed: int, target_qps: float, duration_s: float,
+                   n_tenants: int = 1, weights=None, req_max: int = 8,
+                   rows: int = 1024):
+    """Deterministic open-loop arrival schedule: Poisson (exponential
+    inter-arrival) request times at ``target_qps`` over ``duration_s``,
+    per-request batch sizes in [1, req_max], row offsets into the feature
+    matrix, and weighted tenant assignment.  Pure function of its
+    arguments — the same seed yields a byte-identical schedule
+    (:func:`schedule_digest`), which is what makes two load runs
+    comparable request-for-request."""
+    if target_qps <= 0 or duration_s <= 0:
+        raise ValueError("target_qps and duration_s must be > 0")
+    rng = np.random.RandomState(int(seed))
+    n = max(int(round(target_qps * duration_s)), 1)
+    gaps = rng.exponential(1.0 / target_qps, size=n)
+    t = np.cumsum(gaps)
+    t -= t[0]                        # first request fires immediately
+    sizes = rng.randint(1, int(req_max) + 1, size=n).astype(np.int64)
+    offsets = rng.randint(0, max(int(rows) - int(req_max), 1),
+                          size=n).astype(np.int64)
+    if weights is None:
+        weights = [1.0] * int(n_tenants)
+    w = np.asarray(weights, np.float64)
+    if w.size != n_tenants or (w < 0).any() or w.sum() <= 0:
+        raise ValueError(f"bad tenant weights {weights!r} for "
+                         f"{n_tenants} tenants")
+    tenant = rng.choice(int(n_tenants), size=n, p=w / w.sum()) \
+        .astype(np.int64)
+    return {"t": t, "sizes": sizes, "offsets": offsets, "tenant": tenant}
+
+
+def schedule_digest(sched) -> str:
+    """sha256 over the schedule's raw bytes — the reproducibility witness
+    recorded in the blob (two runs with the same seed carry the same
+    digest, so their latency distributions describe the SAME offered
+    load)."""
+    h = hashlib.sha256()
+    for key in ("t", "sizes", "offsets", "tenant"):
+        h.update(np.ascontiguousarray(sched[key]).tobytes())
+    return h.hexdigest()
+
+
+# ---------------------------------------------------------------- load drive
+def run_load(batchers, X, sched, result_timeout_s: float = 300.0):
+    """Drive the schedule through the tenants' MicroBatchers and measure
+    every request from its SCHEDULED arrival time.
+
+    Open-loop: the driver sleeps until each request's scheduled time and
+    submits regardless of how far behind the server is (submits are
+    non-blocking; a full queue sheds synchronously).  Returns per-request
+    arrays: ``lat_s`` (completion - scheduled arrival; NaN for
+    shed/failed), ``submit_lag_s`` (how late the driver itself submitted
+    — should stay near zero), ``status`` (0 ok, 1 shed, 2 deadline,
+    3 error) and the schedule's tenant assignment."""
+    from lightgbm_tpu.serve import ServeDeadlineError, ServeOverloadError
+
+    t_sched = sched["t"]
+    sizes = sched["sizes"]
+    offsets = sched["offsets"]
+    tenant = sched["tenant"]
+    n = len(t_sched)
+    done_at = [None] * n
+    futs = [None] * n
+    status = np.zeros(n, np.int64)
+    submit_lag = np.zeros(n, np.float64)
+    rows_total = X.shape[0]
+
+    base = time.perf_counter()
+    for i in range(n):
+        target = base + float(t_sched[i])
+        now = time.perf_counter()
+        if target > now:
+            time.sleep(target - now)
+        lo = int(offsets[i]) % rows_total
+        batch = X[lo:lo + int(sizes[i])]
+        t_sub = time.perf_counter()
+        submit_lag[i] = t_sub - target
+        try:
+            fut = batchers[int(tenant[i])].submit(batch)
+        except ServeOverloadError:
+            status[i] = 1            # shed at the door (counted, no wait)
+            continue
+
+        def _done(f, i=i):
+            done_at[i] = time.perf_counter()
+
+        fut.add_done_callback(_done)
+        futs[i] = fut
+
+    lat = np.full(n, np.nan)
+    for i, fut in enumerate(futs):
+        if fut is None:
+            continue
+        try:
+            fut.result(timeout=result_timeout_s)
+            # Future.result() wakes waiters BEFORE done callbacks run, so
+            # the callback may not have stamped done_at yet — fall back
+            # to "now" (µs late at worst, still after completion).
+            t_done = done_at[i]
+            if t_done is None:
+                t_done = time.perf_counter()
+            lat[i] = t_done - (base + float(t_sched[i]))
+        except ServeDeadlineError:
+            status[i] = 2
+        except Exception:  # noqa: BLE001 — a failed request is a data point
+            status[i] = 3
+    end = time.perf_counter()
+    return {"lat_s": lat, "status": status, "submit_lag_s": submit_lag,
+            "tenant": tenant, "sizes": sizes, "elapsed_s": end - base}
+
+
+def _pct(arr, q):
+    return None if arr.size == 0 else float(np.percentile(arr, q))
+
+
+def _ms(v):
+    return None if v is None else round(v * 1e3, 4)
+
+
+def summarize(result, sched, tenant_names):
+    """Aggregate one run: overall + per-tenant offered/achieved QPS and
+    full-array latency percentiles (measured from scheduled arrival)."""
+    lat = result["lat_s"]
+    status = result["status"]
+    ok = status == 0
+    lat_ok = lat[ok & np.isfinite(lat)]
+    n = len(lat)
+    offered = n / max(float(sched["t"][-1]), 1e-9)
+    achieved = int(ok.sum()) / max(result["elapsed_s"], 1e-9)
+    out = {
+        "requests": n,
+        "completed": int(ok.sum()),
+        "shed": int((status == 1).sum()),
+        "deadline_misses": int((status == 2).sum()),
+        "errors": int((status == 3).sum()),
+        "offered_qps": round(offered, 2),
+        "achieved_qps": round(achieved, 2),
+        "p50_ms": _ms(_pct(lat_ok, 50)),
+        "p99_ms": _ms(_pct(lat_ok, 99)),
+        "p999_ms": _ms(_pct(lat_ok, 99.9)),
+        "mean_ms": _ms(float(lat_ok.mean()) if lat_ok.size else None),
+        "submit_lag_p99_ms": _ms(_pct(result["submit_lag_s"], 99)),
+        "per_tenant": {},
+    }
+    for ti, name in enumerate(tenant_names):
+        mask = result["tenant"] == ti
+        t_ok = mask & ok & np.isfinite(lat)
+        t_lat = lat[t_ok]
+        out["per_tenant"][name] = {
+            "requests": int(mask.sum()),
+            "completed": int((mask & ok).sum()),
+            "rows": int(result["sizes"][mask & ok].sum()),
+            "achieved_qps": round(int((mask & ok).sum())
+                                  / max(result["elapsed_s"], 1e-9), 2),
+            "p50_ms": _ms(_pct(t_lat, 50)),
+            "p99_ms": _ms(_pct(t_lat, 99)),
+            "shed": int((mask & (status == 1)).sum()),
+            "deadline_misses": int((mask & (status == 2)).sum()),
+        }
+    return out
+
+
+# ---------------------------------------------------------- saturation search
+def saturation_search(trial, slo_p99_ms: float, start_qps: float = 20.0,
+                      max_qps: float = 100000.0, steps: int = 4):
+    """Max target QPS whose measured p99 meets the SLO: geometric
+    doubling until the SLO breaks (or ``max_qps``), then ``steps``
+    bisection rounds between the last passing and first failing rate.
+    ``trial(qps) -> p99_ms or None`` runs one short measured burst.
+    Returns ``(slo_qps or None, probe_log)``."""
+    log = []
+
+    def ok(qps):
+        p99 = trial(qps)
+        log.append({"qps": round(qps, 1),
+                    "p99_ms": None if p99 is None else round(p99, 3)})
+        return p99 is not None and p99 <= slo_p99_ms
+
+    qps = float(start_qps)
+    if not ok(qps):
+        return None, log             # SLO unmet even at the floor rate
+    good, bad = qps, None
+    while bad is None and good < max_qps:
+        qps = min(good * 2.0, max_qps)
+        if ok(qps):
+            good = qps
+            if qps >= max_qps:
+                break
+        else:
+            bad = qps
+    for _ in range(steps if bad is not None else 0):
+        mid = (good + bad) / 2.0
+        if ok(mid):
+            good = mid
+        else:
+            bad = mid
+    return round(good, 1), log
+
+
+# --------------------------------------------------------------------- main
+def _train_tenants(n_tenants, rows, iters, quantize, extra_params,
+                   seed=0):
+    import lightgbm_tpu as lgb
+
+    boosters, names = [], []
+    rng = np.random.RandomState(seed)
+    X = rng.randn(rows, FEATURES)
+    X[rng.rand(rows, FEATURES) < 0.02] = np.nan
+    for ti in range(n_tenants):
+        y = (X[:, ti % FEATURES] + np.nan_to_num(X[:, (ti + 1) % FEATURES])
+             > 0).astype(np.float64)
+        params = {"objective": "binary", "num_leaves": 31,
+                  "verbosity": -1, "seed": ti}
+        params.update(extra_params)
+        boosters.append(lgb.train(params, lgb.Dataset(X, label=y), iters))
+        names.append(f"t{ti}")
+    return X, boosters, names
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--qps", type=float, default=50.0,
+                    help="target offered QPS (open loop)")
+    ap.add_argument("--duration", type=float, default=2.0,
+                    help="schedule length, seconds")
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--tenants", type=int, default=1,
+                    help="number of model tenants (own Booster + "
+                         "Predictor + MicroBatcher each)")
+    ap.add_argument("--weights", type=str, default="",
+                    help="comma-separated tenant traffic weights")
+    ap.add_argument("--req-max", type=int, default=8,
+                    help="max rows per request (sizes uniform in "
+                         "[1, req_max])")
+    ap.add_argument("--max-batch", type=int, default=256,
+                    help="MicroBatcher coalescing cap (rows)")
+    ap.add_argument("--max-wait-ms", type=float, default=1.0,
+                    help="MicroBatcher coalescing window")
+    ap.add_argument("--max-queue", type=int, default=0,
+                    help="admission-control queue bound (0 = unbounded)")
+    ap.add_argument("--deadline-ms", type=float, default=0.0,
+                    help="per-request queue deadline (0 = none)")
+    ap.add_argument("--slo-p99-ms", type=float, default=0.0,
+                    help="p99 SLO target: arms the predictor SLO gauges "
+                         "and the saturation search")
+    ap.add_argument("--saturate", action="store_true",
+                    help="search the max target QPS meeting --slo-p99-ms")
+    ap.add_argument("--rows", type=int, default=20000,
+                    help="training rows per tenant model")
+    ap.add_argument("--iters", type=int, default=20,
+                    help="boosting rounds per tenant model")
+    ap.add_argument("--quantize", default="off",
+                    choices=("off", "int16", "int8"))
+    ap.add_argument("--request-log", action="store_true",
+                    help="arm tpu_serve_request_log (phase breakdown in "
+                         "detail.phases)")
+    args = ap.parse_args(argv)
+
+    import jax
+
+    from lightgbm_tpu import serve
+
+    platform = jax.default_backend()
+    extra = {}
+    if args.request_log:
+        extra.update(tpu_serve_request_log="on",
+                     tpu_serve_request_sample=0.0)
+    if args.slo_p99_ms > 0:
+        extra.update(tpu_serve_slo_p99_ms=args.slo_p99_ms)
+    t0 = time.time()
+    X, boosters, names = _train_tenants(args.tenants, args.rows,
+                                        args.iters, args.quantize, extra)
+    train_s = time.time() - t0
+
+    preds = [serve.Predictor(b, quantize=args.quantize, name=nm)
+             for b, nm in zip(boosters, names)]
+    for p in preds:
+        p.warmup(args.max_batch)
+
+    weights = ([float(w) for w in args.weights.split(",")]
+               if args.weights else None)
+
+    def make_batchers():
+        return [p.batcher(max_batch=args.max_batch,
+                          max_wait_ms=args.max_wait_ms,
+                          max_queue=args.max_queue,
+                          deadline_ms=args.deadline_ms) for p in preds]
+
+    def run_once(qps, duration):
+        sched = build_schedule(args.seed, qps, duration,
+                               n_tenants=args.tenants, weights=weights,
+                               req_max=args.req_max, rows=X.shape[0])
+        batchers = make_batchers()
+        try:
+            result = run_load(batchers, X, sched)
+        finally:
+            for b in batchers:
+                b.close()
+        return sched, result
+
+    if args.saturate and args.slo_p99_ms <= 0:
+        ap.error("--saturate needs --slo-p99-ms")
+
+    # The measured run comes FIRST: the tracer's phase histograms, the
+    # slow-request ring and the SLO window are cumulative per predictor,
+    # so the saturation probes (deliberately-overloaded bursts) must not
+    # contaminate the breakdown this blob reports for --qps traffic.
+    sched, result = run_once(args.qps, args.duration)
+    summary = summarize(result, sched, names)
+
+    phases = None
+    if args.request_log:
+        # per-phase breakdown over the measured run (queue-wait vs
+        # dispatch — the split the open loop exists to expose)
+        phases = {nm: p.metrics_snapshot()["phases"]
+                  for nm, p in zip(names, preds)}
+
+    slo_qps, probes = None, None
+    if args.saturate:
+
+        def trial(qps):
+            _, res = run_once(qps, min(args.duration, 1.5))
+            okmask = res["status"] == 0
+            lat = res["lat_s"][okmask & np.isfinite(res["lat_s"])]
+            if lat.size == 0 or okmask.mean() < 0.99:
+                return None          # shed/failed load can't meet an SLO
+            return float(np.percentile(lat, 99)) * 1e3
+
+        slo_qps, probes = saturation_search(trial, args.slo_p99_ms)
+
+    blob = {
+        "metric": "BENCH_serve",
+        "mode": "load",
+        "offered_qps": summary["offered_qps"],
+        "achieved_qps": summary["achieved_qps"],
+        "p50_ms": summary["p50_ms"],
+        "p99_ms": summary["p99_ms"],
+        "p999_ms": summary["p999_ms"],
+        "slo_qps": slo_qps,
+        "shed": summary["shed"],
+        "deadline_misses": summary["deadline_misses"],
+        "per_tenant": summary["per_tenant"],
+        "detail": {
+            "target_qps": args.qps, "duration_s": args.duration,
+            "seed": args.seed, "schedule_sha256": schedule_digest(sched),
+            "requests": summary["requests"],
+            "completed": summary["completed"],
+            "errors": summary["errors"],
+            "mean_ms": summary["mean_ms"],
+            "submit_lag_p99_ms": summary["submit_lag_p99_ms"],
+            "tenants": args.tenants,
+            "req_max": args.req_max, "max_batch": args.max_batch,
+            "max_queue": args.max_queue,
+            "deadline_ms": args.deadline_ms,
+            "slo_p99_ms": args.slo_p99_ms or None,
+            "saturation_probes": probes,
+            "quantize": args.quantize,
+            "train_rows": args.rows, "iters": args.iters,
+            "train_s": round(train_s, 3),
+            "phases": phases,
+            # platform honesty (bench_compare's probe machinery): a
+            # CPU-fallback load number must never compare against a
+            # live-accelerator one.
+            "platform": platform,
+            "cpu_fallback": platform == "cpu",
+        },
+    }
+    print(json.dumps(blob))
+    sys.stdout.flush()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -25,6 +25,14 @@ Three tables per log:
 - **compiles** — ``compile.end`` events per program label: count, total
   and max compile seconds.
 
+``--serve`` adds two more (ISSUE-14, ``tpu_serve_request_log``):
+
+- **serve request phases** — sampled ``serve.request`` events decomposed
+  into queue-wait / bin+assemble / device-dispatch / post-process
+  latency (count, mean, p50/p99/max ms per phase);
+- **serve tenants** — per-model-label traffic: sampled request count,
+  rows, event-window QPS, mean/p99 latency and slow-request count.
+
 Unknown schema versions and unparseable lines are reported, not fatal —
 a triage tool must read partial/torn logs.  Plain stdlib; safe anywhere
 the repo checks out.
@@ -35,6 +43,7 @@ from __future__ import annotations
 import argparse
 import collections
 import json
+import math
 import os
 import sys
 from typing import Dict, List, Tuple
@@ -186,6 +195,79 @@ def stream_rows(events: List[dict]) -> List[tuple]:
             for ci, a in sorted(per.items())]
 
 
+_SERVE_PHASES = ("queue_wait", "assemble", "dispatch", "post", "total")
+
+
+def _pctl(sorted_vals: List[float], q: float):
+    """Nearest-rank percentile over a pre-sorted list (stdlib-only):
+    rank ceil(q/100 * n), converted to a 0-based index."""
+    if not sorted_vals:
+        return None
+    k = max(math.ceil(q / 100.0 * len(sorted_vals)) - 1, 0)
+    return sorted_vals[min(k, len(sorted_vals) - 1)]
+
+
+def serve_phase_rows(events: List[dict]) -> List[tuple]:
+    """Per-phase latency breakdown replayed from ``serve.request`` events
+    (ISSUE-14): where a request's wall time went — queue wait vs
+    bin/assemble vs device dispatch vs post-process — as count / mean /
+    p50 / p99 / max milliseconds.  Only SAMPLED requests are in the log
+    (rate knob + always-sampled slow requests), so the distribution skews
+    toward the tail by design — the triage-relevant end."""
+    per: Dict[str, List[float]] = {p: [] for p in _SERVE_PHASES}
+    for e in events:
+        if e["kind"] != "serve.request":
+            continue
+        for p in _SERVE_PHASES:
+            v = e.get(f"{p}_s" if p != "total" else "total_s")
+            if v is not None:
+                per[p].append(float(v) * 1e3)
+    rows = []
+    for p in _SERVE_PHASES:
+        vals = sorted(per[p])
+        if not vals:
+            continue
+        rows.append((p, len(vals), _f(sum(vals) / len(vals)),
+                     _f(_pctl(vals, 50)), _f(_pctl(vals, 99)),
+                     _f(vals[-1])))
+    return rows
+
+
+def serve_tenant_rows(events: List[dict]) -> List[tuple]:
+    """Per-tenant traffic table from the same ``serve.request`` events:
+    sampled-request count, served rows, event-window QPS (count over the
+    first->last event timespan — a LOWER bound on real traffic when the
+    sample rate is < 1), mean/p99 total latency and slow-request count,
+    keyed by the model label (``-`` for unnamed predictors)."""
+    per: Dict[str, Dict] = {}
+    for e in events:
+        if e["kind"] != "serve.request":
+            continue
+        name = str(e.get("model") or "-")
+        agg = per.setdefault(name, {"n": 0, "rows": 0, "slow": 0,
+                                    "lat": [], "t0": None, "t1": None})
+        agg["n"] += 1
+        agg["rows"] += int(e.get("rows", 0))
+        if e.get("slow"):
+            agg["slow"] += 1
+        if e.get("total_s") is not None:
+            agg["lat"].append(float(e["total_s"]) * 1e3)
+        ts = e.get("ts")
+        if ts is not None:
+            agg["t0"] = ts if agg["t0"] is None else min(agg["t0"], ts)
+            agg["t1"] = ts if agg["t1"] is None else max(agg["t1"], ts)
+    rows = []
+    for name, a in sorted(per.items()):
+        span_s = (a["t1"] - a["t0"]) if a["t0"] is not None else None
+        qps = (a["n"] / span_s) if span_s else None
+        lat = sorted(a["lat"])
+        rows.append((name, a["n"], a["rows"],
+                     "-" if qps is None else f"{qps:.1f}",
+                     _f(sum(lat) / len(lat)) if lat else "-",
+                     _f(_pctl(lat, 99)), a["slow"]))
+    return rows
+
+
 def compile_rows(events: List[dict]) -> List[tuple]:
     """Per-label aggregation of ``compile.end`` events."""
     per: Dict[str, List[float]] = collections.defaultdict(list)
@@ -196,7 +278,7 @@ def compile_rows(events: List[dict]) -> List[tuple]:
             for label, secs in sorted(per.items())]
 
 
-def report(path: str, memory: bool = False) -> int:
+def report(path: str, memory: bool = False, serve: bool = False) -> int:
     """Print the triage tables for one log; returns 0 when the log held at
     least one valid event."""
     events, problems = load_events(path)
@@ -236,6 +318,13 @@ def report(path: str, memory: bool = False) -> int:
                 "max_delta"), memory_rows(events))
         _table("compiles", ("label", "count", "total_s", "max_s"),
                compile_rows(events))
+    if serve:
+        _table("serve request phases (ms, sampled serve.request events)",
+               ("phase", "count", "mean", "p50", "p99", "max"),
+               serve_phase_rows(events))
+        _table("serve tenants (sampled serve.request events)",
+               ("model", "events", "rows", "qps", "mean_ms", "p99_ms",
+                "slow"), serve_tenant_rows(events))
     return 0
 
 
@@ -245,6 +334,10 @@ def main(argv=None) -> int:
     ap.add_argument("--memory", action="store_true",
                     help="add the per-span memory-watermark and "
                          "per-label compile tables (ISSUE-10)")
+    ap.add_argument("--serve", action="store_true",
+                    help="add the serve request-phase breakdown and "
+                         "per-tenant traffic tables replayed from "
+                         "serve.request events (ISSUE-14)")
     args = ap.parse_args(argv)
     rc = 0
     for path in args.logs:
@@ -252,7 +345,7 @@ def main(argv=None) -> int:
             print(f"{path}: no such file", file=sys.stderr)
             rc = 1
             continue
-        rc = max(rc, report(path, memory=args.memory))
+        rc = max(rc, report(path, memory=args.memory, serve=args.serve))
     return rc
 
 
